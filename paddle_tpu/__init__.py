@@ -1,0 +1,65 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new framework with the capabilities of the PaddlePaddle reference
+(surveyed in /root/repo/SURVEY.md), designed TPU-first: eager execution and
+autograd over functional JAX/XLA computations, jit compilation of whole
+training steps, GSPMD sharding over device meshes instead of NCCL process
+groups, and Pallas kernels for fused hot ops.
+
+Public surface mirrors `paddle.*` so reference users can migrate:
+    import paddle_tpu as paddle
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# --- core types -----------------------------------------------------------
+from .core.dtype import (  # noqa: F401
+    DType, bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    float8_e4m3fn, get_default_dtype, int8, int16, int32, int64,
+    set_default_dtype, uint8,
+)
+from .core.dtype import bool_ as bool  # noqa: F401  (paddle.bool)
+from .core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_cuda,
+    is_compiled_with_tpu, set_device,
+)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .core.dispatch import no_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+
+# --- ops ------------------------------------------------------------------
+from . import ops as _ops_pkg
+
+_ops_pkg.monkey_patch()
+
+from .ops import *  # noqa: F401,F403
+from .ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# --- subsystems (grown as they land; see SURVEY.md §7 layer order) --------
+from . import autograd  # noqa: F401
+from .autograd import grad  # noqa: F401
+
+# paddle.linalg namespace is the ops.linalg module re-exported
+from .ops import linalg  # noqa: F401
+
+
+def disable_static(place=None):
+    """Eager mode is the default and only interactive mode."""
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "to compile functions/Layers to XLA")
+
+
+def in_dynamic_mode():
+    from .core.dispatch import in_static_trace
+
+    return not in_static_trace()
+
+
+def is_grad_enabled_():  # kept for parity with some callers
+    return is_grad_enabled()

@@ -1,0 +1,152 @@
+"""paddle.autograd analog: functional grad, PyLayer, backward.
+
+Reference: /root/reference/python/paddle/autograd/py_layer.py:202 (PyLayer),
+backward_mode.py (backward), and eager GeneralGrad
+(/root/reference/paddle/fluid/eager/backward.cc:37) for the partial-grad API.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.dispatch import no_grad, no_grad_ctx, enable_grad_ctx, is_grad_enabled, set_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+    "set_grad_enabled", "PyLayer", "PyLayerContext",
+]
+
+enable_grad = enable_grad_ctx
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    tape_mod.run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None) -> List[Optional[Tensor]]:
+    """Functional gradients of outputs w.r.t. inputs, without touching .grad."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet; "
+            "use paddle_tpu.jit.grad for higher-order derivatives of compiled fns")
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    retain = retain_graph if retain_graph is not None else False
+
+    capture = {}
+    capture_points = {}
+    for t in inputs:
+        capture[id(t)] = None
+        if t._grad_node is not None:
+            capture_points.setdefault(
+                (id(t._grad_node), t._output_index), []).append(id(t))
+
+    tape_mod.run_backward(outputs, grad_outputs, retain_graph=retain,
+                          capture=capture, capture_points=capture_points)
+
+    results = []
+    for t in inputs:
+        c = capture[id(t)]
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph (set allow_unused=True to allow)")
+            results.append(None)
+        else:
+            results.append(Tensor(c, stop_gradient=True))
+    return results
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer subclasses are used via .apply(...)")
+
+
+class PyLayer:
+    """User-defined forward/backward, recorded as one node on the tape."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import dispatch
+
+        ctx = PyLayerContext()
+        with no_grad_ctx():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = dispatch.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not record:
+            return outs
+
+        diff_inputs = [t for t in tensor_inputs
+                       if not t.stop_gradient
+                       and jnp.issubdtype(t._value.dtype, jnp.inexact)]
+
+        def vjp_fn(cotangents):
+            cots = (cotangents,) if not isinstance(cotangents, tuple) else cotangents
+            grad_wrapped = [Tensor(c, stop_gradient=True) for c in cots]
+            with no_grad_ctx():
+                grads = cls.backward(ctx, *grad_wrapped)
+            if isinstance(grads, Tensor) or grads is None:
+                grads = (grads,)
+            # backward returns one grad per *tensor input* of forward, in order
+            by_input = {id(t): g for t, g in zip(tensor_inputs, grads)}
+            vals = []
+            for t in diff_inputs:
+                g = by_input.get(id(t))
+                vals.append(g._value if isinstance(g, Tensor) else jnp.zeros(
+                    t.shape, t._value.dtype))
+            return tuple(vals)
+
+        node = tape_mod.GradNode(f"pylayer_{cls.__name__}", vjp_fn)
+        node.finalize(
+            out_avals=[(tuple(o.shape), o._value.dtype) for o in out_list],
+            single_output=single,
+            inputs=diff_inputs,
+        )
+        for i, o in enumerate(out_list):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._output_index = i
+        return outs
+
+
+class LegacyPyLayer(PyLayer):
+    pass

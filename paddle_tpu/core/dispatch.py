@@ -1,0 +1,197 @@
+"""Eager op dispatch + grad recording.
+
+This is the TPU-native replacement for the reference dygraph tracer
+(/root/reference/paddle/fluid/imperative/tracer.cc:186 TraceOpImpl and the
+eager engine /root/reference/paddle/fluid/eager/): every framework op is a
+functional JAX computation; when gradients are required we obtain the op's
+VJP closure via jax.vjp at call time (one forward execution, residuals live
+on device) and record a GradNode on the tape.  There is exactly ONE autograd
+engine — no legacy/eager split.
+
+Inside `paddle_tpu.jit.to_static` traces the tape is bypassed entirely:
+differentiation of compiled programs happens through jax.grad on the
+functionalized program, which is the idiomatic XLA path.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from . import tape as tape_mod
+from .flags import flag
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.in_static_trace = False
+
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and not _state.in_static_trace
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_ctx():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def static_trace_guard():
+    """Active while jit.to_static traces user code: tape off, ops trace into XLA."""
+    prev = _state.in_static_trace
+    _state.in_static_trace = True
+    try:
+        yield
+    finally:
+        _state.in_static_trace = prev
+
+
+def in_static_trace() -> bool:
+    return _state.in_static_trace
+
+
+class no_grad:
+    """Context manager AND decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad_ctx():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _differentiable_dtype(v) -> bool:
+    return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+
+def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
+    """Run op `fn` over args (Tensors possibly nested in lists/tuples) with
+    static keyword attrs; wrap outputs in Tensors and record the grad node.
+    """
+    from .tensor import Tensor
+
+    flat, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=_is_tensor
+    )
+    tensor_idx = [i for i, leaf in enumerate(flat) if _is_tensor(leaf)]
+
+    record = (
+        _differentiable
+        and is_grad_enabled()
+        and any(
+            not flat[i].stop_gradient and _differentiable_dtype(flat[i]._value)
+            for i in tensor_idx
+        )
+    )
+
+    # Partition tensor leaves: differentiable ones become vjp arguments, the
+    # rest are closed over as constants.
+    diff_idx = [
+        i
+        for i in tensor_idx
+        if record
+        and not flat[i].stop_gradient
+        and _differentiable_dtype(flat[i]._value)
+    ]
+
+    def raw_fn(*diff_vals):
+        new_flat = list(flat)
+        for pos, v in zip(diff_idx, diff_vals):
+            new_flat[pos] = v
+        for i in tensor_idx:
+            if i not in diff_idx:
+                new_flat[i] = new_flat[i]._value
+        new_args = jax.tree_util.tree_unflatten(treedef, new_flat)
+        return fn(*new_args, **attrs)
+
+    if record:
+        diff_vals = [flat[i]._value for i in diff_idx]
+        out_raw, vjp_fn = jax.vjp(raw_fn, *diff_vals)
+        node = tape_mod.GradNode(name, vjp_fn)
+    else:
+        out_raw = raw_fn()
+        node = None
+
+    single = not isinstance(out_raw, (tuple, list))
+    out_list = [out_raw] if single else list(out_raw)
+
+    outputs: List[Any] = []
+    for i, o in enumerate(out_list):
+        diff_out = record and _differentiable_dtype(o)
+        t = Tensor(o, stop_gradient=not diff_out)
+        if record:
+            t._grad_node = node
+            t._output_index = i
+        outputs.append(t)
+
+    if node is not None:
+        node.finalize(
+            out_avals=[(tuple(o.shape), o.dtype) for o in out_list],
+            single_output=single,
+            inputs=[flat[i] for i in diff_idx],
+        )
+
+    if flag("check_nan_inf"):
+        _check_nan_inf(name, outputs)
+
+    return outputs[0] if single else tuple(outputs)
+
+
+def _check_nan_inf(name, outputs):
+    """FLAGS_check_nan_inf analog (reference: details/nan_inf_utils_detail)."""
+    import numpy as np
+
+    for t in outputs:
+        v = t._value
+        if hasattr(v, "aval") and not hasattr(v, "addressable_shards"):
+            return  # tracer: skip
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            arr = np.asarray(v.astype(jnp.float32))
+            if not np.isfinite(arr).all():
+                raise FloatingPointError(f"op {name} produced nan/inf")

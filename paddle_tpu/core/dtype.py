@@ -1,0 +1,138 @@
+"""Data types for the TPU-native framework.
+
+Mirrors the capability of the reference dtype system
+(/root/reference/paddle/phi/common/data_type.h) — fp16/bf16/complex as
+first-class dtypes — but is expressed directly over numpy/JAX dtypes, since
+XLA is the only backend.  bfloat16 is the TPU-preferred half type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+
+    bfloat16_np = np.dtype(ml_dtypes.bfloat16)
+    float8_e4m3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    bfloat16_np = np.dtype("float32")
+    float8_e4m3 = np.dtype("float32")
+    float8_e5m2 = np.dtype("float32")
+
+
+class DType:
+    """A framework dtype: thin, hashable wrapper over a numpy dtype.
+
+    Compares equal to its string name ("float32"), to the numpy dtype, and to
+    other DType instances so user code can pass any of the three.
+    """
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or _ALIASES.get(other) == self.name
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    @property
+    def is_floating_point(self):
+        return self.np_dtype.kind == "f" or self.name in (
+            "bfloat16",
+            "float8_e4m3fn",
+            "float8_e5m2",
+        )
+
+    @property
+    def is_complex(self):
+        return self.np_dtype.kind == "c"
+
+    @property
+    def is_integer(self):
+        return self.np_dtype.kind in ("i", "u")
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", bfloat16_np)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+int8 = DType("int8", np.int8)
+uint8 = DType("uint8", np.uint8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+bool_ = DType("bool", np.bool_)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", float8_e4m3)
+float8_e5m2_t = DType("float8_e5m2", float8_e5m2)
+
+_ALL = [
+    float16, bfloat16, float32, float64, int8, uint8, int16, int32, int64,
+    bool_, complex64, complex128, float8_e4m3fn, float8_e5m2_t,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16",
+            "int": "int32", "long": "int64", "bool_": "bool"}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str / numpy dtype / DType / jax dtype to a framework DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        raise ValueError(f"unknown dtype {dtype!r}")
+    npd = np.dtype(dtype)
+    for d in _ALL:
+        if d.np_dtype == npd:
+            return d
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def to_np(dtype):
+    """Framework/str dtype -> numpy dtype usable by jax.numpy."""
+    d = convert_dtype(dtype)
+    return None if d is None else d.np_dtype
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not d.is_floating_point:
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name

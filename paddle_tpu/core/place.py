@@ -1,0 +1,108 @@
+"""Device placement.
+
+Capability parity with the reference Place system
+(/root/reference/paddle/phi/common/place.h, python/paddle/device) with TPU as
+the first-class device.  A Place names a JAX device; "tpu" maps to whatever
+accelerator platform the PJRT client exposes (tpu, or cpu when running the
+virtual-device test configuration).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = _devices_for(self.device_type)
+        if self.device_id >= len(devs):
+            raise RuntimeError(
+                f"device {self.device_type}:{self.device_id} not available "
+                f"({len(devs)} present)"
+            )
+        return devs[self.device_id]
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("cpu", device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _accelerator_platform() -> str:
+    """The platform name of the default (accelerator-preferred) backend."""
+    return jax.devices()[0].platform
+
+
+def _devices_for(device_type: str):
+    if device_type == "tpu":
+        # "tpu" means the accelerator backend; under the CPU test config this
+        # is the (possibly virtual multi-device) cpu platform.
+        return jax.devices()
+    return jax.devices(device_type)
+
+
+_current_place: Place = None
+
+
+def set_device(device: str) -> Place:
+    """paddle.device.set_device analog: "tpu", "tpu:0", "cpu"."""
+    global _current_place
+    if ":" in device:
+        dev_type, idx = device.split(":")
+        place = Place(dev_type, int(idx))
+    else:
+        place = Place(device, 0)
+    place.jax_device()  # validate
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    p = _get_current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _get_current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        plat = _accelerator_platform()
+        _current_place = Place("tpu" if plat != "cpu" else "cpu", 0)
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return len(jax.devices())

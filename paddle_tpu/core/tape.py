@@ -1,0 +1,214 @@
+"""Autograd tape: GradNode graph + backward engine.
+
+TPU-native analog of the reference eager autograd
+(/root/reference/paddle/fluid/eager/backward.cc:529 RunBackward,
+grad_node_info.h:165 GradNodeBase, imperative/basic_engine.cc:267
+PrepareDeps): reverse traversal with dependency counting and cotangent
+accumulation.  Each GradNode owns one jax VJP closure (residuals = saved
+tensors, the TensorWrapper analog); processing a node frees its residuals
+unless retain_graph is set.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+class GradNode:
+    """One recorded op: holds the vjp closure and links to producer nodes."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "out_avals",
+        "single_output",
+        "pending",
+        "edges",
+        "out_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, name: str, vjp_fn):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.out_avals: List[Tuple[tuple, Any]] = []
+        self.single_output = True
+        self.pending: Optional[List[Any]] = None
+        # edges[i] corresponds to the i-th differentiable input:
+        #   ("node", producer_node, out_index) or ("leaf", tensor)
+        self.edges: List[tuple] = []
+        self.out_hooks: Dict[int, list] = {}
+
+    def finalize(self, out_avals, single_output, inputs):
+        self.out_avals = out_avals
+        self.single_output = single_output
+        self.pending = [None] * len(out_avals)
+        for t in inputs:
+            if t._grad_node is not None:
+                self.edges.append(("node", t._grad_node, t._output_index))
+            else:
+                self.edges.append(("leaf", t))
+
+    def accumulate(self, idx: int, cotangent):
+        if self.pending[idx] is None:
+            self.pending[idx] = cotangent
+        else:
+            self.pending[idx] = self.pending[idx] + cotangent
+
+    def assembled_cotangents(self):
+        cots = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            c = self.pending[i]
+            if c is None:
+                c = _zero_cotangent(shape, dtype)
+            for hook in self.out_hooks.get(i, ()):
+                out = hook(_wrap(c))
+                if out is not None:
+                    c = _unwrap(out)
+            cots.append(c)
+        return cots
+
+    def release(self):
+        self.vjp_fn = None
+        self.pending = [None] * len(self.out_avals)
+
+
+def _wrap(raw):
+    from .tensor import Tensor
+
+    return Tensor(raw, stop_gradient=True)
+
+
+def _unwrap(t):
+    from .tensor import Tensor
+
+    return t._value if isinstance(t, Tensor) else t
+
+
+def _accumulate_leaf_grad(tensor, cotangent):
+    from .tensor import Tensor
+
+    c = cotangent
+    for hook in tensor._hooks:
+        out = hook(_wrap(c))
+        if out is not None:
+            c = _unwrap(out)
+    if tensor.grad is None:
+        tensor.grad = Tensor(c, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad._value + c, stop_gradient=True)
+
+
+def _discover(roots):
+    """BFS the node graph; return (all nodes, in-degree per node)."""
+    in_deg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes[id(node)] = node
+        for kind, *rest in node.edges:
+            if kind == "node":
+                prod = rest[0]
+                in_deg[id(prod)] = in_deg.get(id(prod), 0) + 1
+                stack.append(prod)
+    return nodes, in_deg
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 capture: Optional[Dict[int, Any]] = None,
+                 capture_points: Optional[Dict[Tuple[int, int], list]] = None):
+    """Reverse-mode sweep from `tensors`.
+
+    capture/capture_points support the functional paddle.grad API: when a
+    target tensor is an intermediate, its fully-assembled cotangent is
+    recorded at (producer node, output index) processing time.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g_val = jnp.ones(t.shape, t._value.dtype)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                _accumulate_leaf_grad(t, g_val)
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to run backward through the graph a second time "
+                "(pass retain_graph=True the first time)."
+            )
+        node.accumulate(t._output_index, g_val)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    nodes, in_deg = _discover(roots)
+    queue = deque(n for n in nodes.values() if in_deg.get(id(n), 0) == 0)
+    processed = set()
+
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        cots = node.assembled_cotangents()
+        if capture_points:
+            for (nid, idx), sinks in capture_points.items():
+                if nid == id(node):
+                    for sink in sinks:
+                        capture[sink] = cots[idx]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad node {node.name} already released; use retain_graph=True"
+            )
+        in_cots = node.vjp_fn(cots[0] if node.single_output else tuple(cots))
+
+        for (kind, *rest), cot in zip(node.edges, in_cots):
+            if cot is None or (hasattr(cot, "dtype") and cot.dtype == jax.dtypes.float0):
+                continue
+            if kind == "leaf":
+                tensor = rest[0]
+                if capture is not None and id(tensor) in capture:
+                    prev = capture[id(tensor)]
+                    capture[id(tensor)] = cot if prev is None else prev + cot
+                else:
+                    _accumulate_leaf_grad(tensor, cot)
+            else:
+                prod, idx = rest
+                prod.accumulate(idx, cot)
+                in_deg[id(prod)] -= 1
+                if in_deg[id(prod)] == 0:
+                    queue.append(prod)
+
+        if not retain_graph:
+            node.release()
+        else:
+            node.pending = [None] * len(node.out_avals)

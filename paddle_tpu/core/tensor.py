@@ -1,0 +1,282 @@
+"""The framework Tensor: a JAX array plus autograd metadata.
+
+Capability analog of the reference eager Tensor
+(/root/reference/paddle/phi/api/include/tensor.h:83 paddle::experimental::Tensor
++ /root/reference/paddle/fluid/eager/autograd_meta.h:61 AutogradMeta), with
+paddle semantics: `stop_gradient` defaults True for plain tensors and False
+for Parameters; `.grad` accumulates on leaves; in-place ops rebind the
+underlying buffer (XLA arrays are immutable — rebinding preserves tape
+correctness because each op is functional).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dispatch
+from .dtype import convert_dtype, get_default_dtype, to_np
+from .place import Place, _get_current_place
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_output_index",
+        "_hooks",
+        "name",
+        "persistable",
+        "trainable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_index = 0
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+        self.trainable = True
+
+    # ---------------------------------------------------------------- shape
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return convert_dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def place(self) -> Place:
+        return _get_current_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # ------------------------------------------------------------- host I/O
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.array2string(self.numpy(), precision=6, separator=", ")
+        except Exception:
+            data = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_info},\n       {data})"
+        )
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from . import tape
+
+        tape.run_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                          retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        if self._grad_node is not None:
+            self._grad_node.out_hooks.setdefault(self._output_index, []).append(hook)
+
+            node, idx = self._grad_node, self._output_index
+
+            class _Removable:
+                def remove(self_inner):
+                    node.out_hooks[idx].remove(hook)
+
+            return _Removable()
+        self._hooks.append(hook)
+        hooks = self._hooks
+
+        class _Removable:
+            def remove(self_inner):
+                hooks.remove(hook)
+
+        return _Removable()
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+
+        return ops.assign(self)
+
+    # ----------------------------------------------------------- conversion
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # device moves are no-ops in the single-client PJRT model; dtype casts real
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a not in ("cpu", "tpu", "gpu") and ":" not in a:
+                dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ----------------------------------------------------------- in-place
+    def _rebind(self, new_tensor: "Tensor"):
+        """In-place semantics over immutable XLA buffers: take over the new
+        value and its position in the autograd graph."""
+        self._value = new_tensor._value
+        self._grad_node = new_tensor._grad_node
+        self._output_index = new_tensor._output_index
+        if not new_tensor.stop_gradient:
+            self.stop_gradient = False
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif isinstance(value, np.ndarray):
+            value = jnp.asarray(value, dtype=self._value.dtype)
+        self._value = value
+        return self
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def copy_(self, other, blocking=True):
+        src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._value = jnp.asarray(src, dtype=self._value.dtype)
+        return self
+
+    # __getitem__/__setitem__ and arithmetic operators are attached by
+    # paddle_tpu.ops.monkey_patch() at import time, mirroring the reference's
+    # monkey-patching of math ops onto the C++ tensor
+    # (/root/reference/python/paddle/tensor/__init__.py).
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable, like
+    /root/reference/python/paddle/fluid/framework.py Parameter."""
+
+    def __init__(self, value, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _convert_data(data, dtype=None):
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(to_np(dtype))
+        return v
+    if isinstance(data, (list, tuple)):
+        data = np.asarray(data)
+        if data.dtype == np.float64 and dtype is None:
+            dtype = get_default_dtype()
+    if isinstance(data, np.ndarray):
+        if dtype is None and data.dtype == np.float64:
+            # paddle default: python floats -> default dtype
+            pass
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else None)
+    if isinstance(data, (int, np.integer)):
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else jnp.int64)
+    if isinstance(data, (float, np.floating)):
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else to_np(get_default_dtype()))
+    if isinstance(data, (bool, np.bool_)):
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else jnp.bool_)
+    if isinstance(data, complex):
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else jnp.complex64)
+    return jnp.asarray(data, dtype=to_np(dtype) if dtype else None)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor analog."""
+    value = _convert_data(data, dtype)
+    return Tensor(value, stop_gradient=stop_gradient)

@@ -1,0 +1,202 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, _t(x))
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def _softmax(v):
+        if dtype is not None:
+            from ...core.dtype import to_np
+
+            v = v.astype(to_np(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply("softmax", _softmax, _t(x))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def _lsm(v):
+        if dtype is not None:
+            from ...core.dtype import to_np
+
+            v = v.astype(to_np(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply("log_softmax", _lsm, _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), _t(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu",
+                 lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(v, w):
+        if w.size == 1:
+            alpha = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[ch_axis] = w.size
+            alpha = w.reshape(shape)
+        return jnp.where(v > 0, v, alpha * v)
+    return apply("prelu", _prelu, _t(x), _t(weight))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...ops import random as rnd
+
+    if training:
+        key = rnd.next_key()
+
+        def _rrelu(v):
+            alpha = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, alpha * v)
+        return apply("rrelu", _rrelu, _t(x))
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink",
+                 lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda v: jnp.clip(v, min, max), _t(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid",
+                 lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply("hardswish",
+                 lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def swish(x, name=None):
+    return apply("swish", jax.nn.silu, _t(x))
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, _t(x))
+
+
+def mish(x, name=None):
+    return apply("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), _t(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v,
+                            jax.nn.softplus(v * beta) / beta), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)), _t(x))
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, _t(x))
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda v: v - jnp.tanh(v), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu",
+                 lambda v: jnp.where(v > threshold, v, value), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda v: jax.nn.glu(v, axis=axis), _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply("maxout", _maxout, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops import random as rnd
+
+    key = rnd.next_key()
+
+    def _gumbel(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            # straight-through: hard value forward, soft gradient backward
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+    return apply("gumbel_softmax", _gumbel, _t(x))

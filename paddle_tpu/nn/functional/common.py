@@ -1,0 +1,251 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, etc.
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, is_grad_enabled
+from ...core.dtype import to_np
+from ...core.tensor import Tensor, to_tensor
+from ...ops import random as rnd
+from ...ops.manipulation import pad as _pad_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in_features, out_features]."""
+    if bias is None:
+        return apply("linear", lambda v, w: v @ w, _t(x), _t(weight))
+    return apply("linear", lambda v, w, b: v @ w + b, _t(x), _t(weight), _t(bias))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    key = rnd.next_key()
+
+    def _dropout(v):
+        if axis is None:
+            keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            mask_shape = [v.shape[i] if i in axes else 1 for i in range(v.ndim)]
+            keep = jax.random.bernoulli(key, 1.0 - p, tuple(mask_shape))
+        scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+        return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+    return apply("dropout", _dropout, _t(x))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    key = rnd.next_key()
+
+    def _ad(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / (1.0 - p) / jnp.sqrt(1.0 + p * alpha_p ** 2 / (1.0 - p)))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return apply("alpha_dropout", _ad, _t(x))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _embed(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply("embedding", _embed, _t(x), _t(weight))
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(lab):
+        k = lab.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * lab + epsilon * pd
+        return (1 - epsilon) * lab + epsilon / k
+    return apply("label_smooth", _ls, _t(label))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad_op(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cos(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", _cos, _t(x1), _t(x2))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _norm(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+    return apply("normalize", _norm, _t(x))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """nearest / bilinear / bicubic / trilinear / area resize via jax.image."""
+    def _interp(v):
+        is_nchw = data_format[1] == "C"
+        spatial = v.shape[2:] if is_nchw else v.shape[1:-1]
+        if size is not None:
+            out_spatial = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                                for s in (size if isinstance(size, (list, tuple))
+                                          else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_spatial = tuple(int(round(d * float(f)))
+                                for d, f in zip(spatial, sf))
+        if is_nchw:
+            out_shape = v.shape[:2] + out_spatial
+        else:
+            out_shape = (v.shape[0],) + out_spatial + (v.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "bicubic": "bicubic", "trilinear": "trilinear",
+                  "linear": "linear", "area": "linear"}[mode]
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        # jax.image.resize matches align_corners=False (half-pixel centers)
+        return jax.image.resize(v, out_shape, method=method).astype(v.dtype)
+    return apply("interpolate", _interp, _t(x))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", _ps, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _pu(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+    return apply("pixel_unshuffle", _pu, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        v = v.transpose(0, 2, 1, 3, 4)
+        return v.reshape(n, c, h, w)
+    return apply("channel_shuffle", _cs, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: paddle/fluid/operators/unfold_op.*)."""
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _unfold(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [n, c*kh*kw, oh, ow]
+        return patches.reshape(n, c * kh * kw, -1)
+    return apply("unfold", _unfold, _t(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def _fold(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        out_h = oh + p[0] + p[2]
+        out_w = ow + p[1] + p[3]
+        nh = (out_h - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (out_w - (dw * (kw - 1) + 1)) // sw + 1
+        v = v.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, out_h, out_w), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + nh * sh:sh, wj:wj + nw * sw:sw].add(
+                    v[:, :, i, j])
+        return out[:, :, p[0]:out_h - p[2], p[1]:out_w - p[3]]
+    return apply("fold", _fold, _t(x))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    if bias is not None:
+        return apply("bilinear", _bilinear, _t(x1), _t(x2), _t(weight), _t(bias))
+    return apply("bilinear", _bilinear, _t(x1), _t(x2), _t(weight))

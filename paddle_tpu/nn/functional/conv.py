@@ -1,0 +1,169 @@
+"""Convolution functionals lowered to XLA conv_general_dilated
+(reference: python/paddle/nn/functional/conv.py; kernels in
+/root/reference/paddle/phi/kernels/gpu/conv_*).  Paddle layouts: input NCHW
+(or NHWC via data_format), weight OIHW.  XLA's layout assignment re-tiles for
+the MXU, so we keep the API layout and let the compiler choose physical
+layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    """paddle padding: int, list of ints (per spatial dim), pairs, or SAME/VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims
+        if len(padding) == n + 2:
+            padding = padding[2:]
+        return [tuple(p) for p in padding]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, op_name):
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    pads = _padding(padding, n)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - n:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+
+    def _fn(v, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pads,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(op_name, _fn, _t(x), _t(weight), _t(bias))
+    return apply(op_name, _fn, _t(x), _t(weight))
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, op_name, output_size=None):
+    strides = _tuplize(stride, n)
+    dilations = _tuplize(dilation, n)
+    pads = _padding(padding, n)
+    out_pads = _tuplize(output_padding, n)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in_channels, out_channels/groups, *k]
+    dn = (lhs_spec, "IO" + spatial, lhs_spec)
+
+    def _fn(v, w, *maybe_b):
+        if isinstance(pads, str):
+            pad_cfg = pads
+        else:
+            # conv_transpose padding semantics: output trimmed by `pad` each side
+            k = [w.shape[2 + i] for i in range(n)]
+            pad_cfg = [
+                (dilations[i] * (k[i] - 1) - pads[i][0],
+                 dilations[i] * (k[i] - 1) - pads[i][1] + out_pads[i])
+                for i in range(n)
+            ]
+        if groups > 1:
+            # split the input-channel axis per group
+            ci_axis = 1 if not channel_last else v.ndim - 1
+            v_groups = jnp.split(v, groups, axis=ci_axis)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_transpose(
+                    vg, wg, strides=strides, padding=pad_cfg,
+                    rhs_dilation=dilations, dimension_numbers=dn)
+                for vg, wg in zip(v_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=ci_axis)
+        else:
+            out = jax.lax.conv_transpose(
+                v, w, strides=strides, padding=pad_cfg,
+                rhs_dilation=dilations, dimension_numbers=dn)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(op_name, _fn, _t(x), _t(weight), _t(bias))
+    return apply(op_name, _fn, _t(x), _t(weight))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, "conv3d_transpose")

@@ -1,0 +1,344 @@
+"""Layer base class.
+
+Capability analog of the reference dygraph Layer
+(/root/reference/python/paddle/fluid/dygraph/layers.py: parameters, sublayers,
+hooks, state_dict:1397, to, train/eval) — the module system every model is
+built on.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype, to_np
+from ...core.tensor import Parameter, Tensor
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        self.training = True
+        self._dtype = dtype or get_default_dtype()
+        self._parameters: Dict[str, Parameter] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------- attr magic
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        else:
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+                buffers.pop(name)
+            if params is not None and name in params and value is None:
+                params.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # --------------------------------------------------------------- building
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from .. import initializer as init
+
+        dtype = dtype or self._dtype
+        if default_initializer is None:
+            if is_bias:
+                default_initializer = init.Constant(0.0)
+            else:
+                default_initializer = init.XavierNormal()
+        # ParamAttr support: attr may carry name/initializer/trainable
+        trainable = True
+        if attr is not None and attr is not False:
+            if getattr(attr, "initializer", None) is not None:
+                default_initializer = attr.initializer
+            trainable = getattr(attr, "trainable", True)
+        if attr is False:
+            return None
+        data = default_initializer._generate(tuple(shape), to_np(dtype))
+        p = Parameter(data, trainable=trainable)
+        if attr is not None and getattr(attr, "name", None):
+            p.name = attr.name
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        return Tensor(jnp.zeros((), to_np(dtype or self._dtype)), name=name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor, persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            tensor.persistable = True
+        return tensor
+
+    # --------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    full = f"{layer_prefix}.{pname}" if layer_prefix else pname
+                    yield full, p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    full = f"{layer_prefix}.{bname}" if layer_prefix else bname
+                    yield full, b
+
+    def _walk(self, prefix: str = "", include_sublayers: bool = True):
+        yield self._name_scope, prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._walk(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = []
+        for _, _, layer in self._walk("", True):
+            if layer is self and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False):
+        for _, layer_prefix, layer in self._walk(prefix, True):
+            if layer is self and not include_self:
+                continue
+            yield layer_prefix, layer
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # --------------------------------------------------------------- modes
+    def train(self):
+        self.training = True
+        for sub in self.sublayers():
+            sub.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self.sublayers():
+            sub.training = False
+        return self
+
+    # --------------------------------------------------------------- state
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix,
+                                             include_sublayers):
+            dest[name] = p
+        for _, lp, layer in self._walk(structured_name_prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                full = f"{lp}.{bname}" if lp else bname
+                dest[full] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+                if list(arr.shape) != list(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: loaded {list(arr.shape)} "
+                        f"vs expected {list(target.shape)}")
+                target._value = jnp.asarray(arr, dtype=target._value.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(dtype)
+        return self
+
+    def _convert_dtype(self, dtype):
+        npd = to_np(dtype)
+        for p in self.parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._value = p._value.astype(npd)
+        for b in self.buffers():
+            if jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._value = b._value.astype(npd)
+        for layer in self.sublayers(include_self=True):
+            layer._dtype = convert_dtype(dtype).name
+        return self
+
+    def astype(self, dtype):
+        return self._convert_dtype(dtype)
+
+    def float(self):
+        return self._convert_dtype("float32")
+
+    def bfloat16(self):
+        return self._convert_dtype("bfloat16")
+
+    def half(self):
+        return self._convert_dtype("float16")
+
+    # --------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # --------------------------------------------------------------- forward
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class ParamAttr:
+    """paddle.ParamAttr analog (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

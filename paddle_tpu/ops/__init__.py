@@ -1,0 +1,206 @@
+"""Op library: the paddle.tensor.* surface, dispatched through the tape.
+
+monkey_patch() attaches operators and methods onto Tensor, mirroring the
+reference's monkey-patching of ~400 tensor methods
+(/root/reference/python/paddle/tensor/__init__.py tensor_method_func list).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_py_slice = slice  # builtin, captured before the paddle `slice` op shadows it
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random import (  # noqa: F401
+    bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
+    randn, randperm, seed, standard_normal, uniform, get_rng_state,
+    set_rng_state, shuffle,
+)
+from .einsum import einsum  # noqa: F401
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+from . import creation, logic, linalg, manipulation, math as math_mod, random  # noqa
+from . import search, stat  # noqa
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _unwrap_index(idx):
+    """Convert an indexing object possibly containing Tensors to raw values."""
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, _py_slice):
+        def iv(v):
+            if isinstance(v, Tensor):
+                return int(v.item())
+            return v
+        return _py_slice(iv(idx.start), iv(idx.stop), iv(idx.step))
+    return idx
+
+
+def _getitem(self, idx):
+    raw_idx = _unwrap_index(idx)
+    tensor_indices = [i for i in (idx if isinstance(idx, tuple) else (idx,))
+                      if isinstance(i, Tensor)]
+    # index tensors are non-differentiable closure constants
+    return apply("getitem", lambda v: v[raw_idx], self)
+
+
+def _setitem(self, idx, value):
+    raw_idx = _unwrap_index(idx)
+    v = _t(value) if not isinstance(value, (int, float, bool)) else value
+
+    if isinstance(v, Tensor):
+        out = apply("setitem",
+                    lambda x, val: x.at[raw_idx].set(val.astype(x.dtype)), self, v)
+    else:
+        out = apply("setitem", lambda x: x.at[raw_idx].set(v), self)
+    self._rebind(out)
+    return self
+
+
+_BINOPS = {
+    "__add__": math_mod.add,
+    "__radd__": lambda x, y: math_mod.add(_t(y), x),
+    "__sub__": math_mod.subtract,
+    "__rsub__": lambda x, y: math_mod.subtract(_t(y), x),
+    "__mul__": math_mod.multiply,
+    "__rmul__": lambda x, y: math_mod.multiply(_t(y), x),
+    "__truediv__": math_mod.divide,
+    "__rtruediv__": lambda x, y: math_mod.divide(_t(y), x),
+    "__floordiv__": math_mod.floor_divide,
+    "__rfloordiv__": lambda x, y: math_mod.floor_divide(_t(y), x),
+    "__mod__": math_mod.mod,
+    "__rmod__": lambda x, y: math_mod.mod(_t(y), x),
+    "__pow__": math_mod.pow,
+    "__rpow__": lambda x, y: math_mod.pow(_t(y), x),
+    "__matmul__": math_mod.matmul,
+    "__rmatmul__": lambda x, y: math_mod.matmul(_t(y), x),
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+}
+
+# Methods delegating to module functions with self as first argument.
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "scale", "maximum", "minimum", "fmax", "fmin", "sqrt", "rsqrt", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "abs", "sign", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "floor", "ceil", "round",
+    "trunc", "reciprocal", "square", "erf", "erfinv", "lgamma", "digamma",
+    "angle", "conj", "clip", "lerp", "nan_to_num", "sum", "mean", "max", "min",
+    "amax", "amin", "prod", "nansum", "nanmean", "logsumexp", "cumsum",
+    "cumprod", "diff", "trace", "matmul", "mm", "bmm", "dot", "inner", "outer",
+    "kron", "inverse", "isnan", "isinf", "isfinite", "sigmoid", "logit",
+    "atan2", "heaviside", "deg2rad", "rad2deg", "diagonal", "frac",
+    # manipulation
+    "reshape", "reshape_", "flatten", "transpose", "t", "moveaxis", "swapaxes",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "split", "chunk", "unbind",
+    "tile", "expand", "expand_as", "broadcast_to", "flip", "roll", "rot90",
+    "gather", "gather_nd", "take", "take_along_axis", "put_along_axis",
+    "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_fill", "masked_select", "masked_fill", "unique", "pad",
+    "repeat_interleave", "as_complex", "as_real", "cast", "view", "view_as",
+    "tensordot", "where",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor", "isclose",
+    "allclose", "equal_all", "any", "all",
+    # linalg
+    "norm", "dist", "cholesky", "matrix_power", "cross",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "bucketize",
+    # stat
+    "std", "var", "median", "nanmedian", "quantile", "nanquantile", "histogram",
+    "bincount",
+    # random
+    "bernoulli", "multinomial", "normal_", "uniform_", "bernoulli_",
+    "exponential_",
+]
+
+_INPLACE_ALIASES = {
+    "add_": math_mod.add, "subtract_": math_mod.subtract,
+    "multiply_": math_mod.multiply, "divide_": math_mod.divide,
+    "clip_": math_mod.clip, "scale_": math_mod.scale,
+    "floor_": math_mod.floor, "ceil_": math_mod.ceil, "round_": math_mod.round,
+    "exp_": math_mod.exp, "sqrt_": math_mod.sqrt, "rsqrt_": math_mod.rsqrt,
+    "abs_": math_mod.abs, "tanh_": math_mod.tanh, "reciprocal_": math_mod.reciprocal,
+    "neg_": math_mod.neg, "cast_": manipulation.cast,
+    "flatten_": manipulation.flatten, "transpose_": manipulation.transpose,
+    "fill_diagonal_": None,  # handled separately below
+}
+
+_patched = False
+
+
+def monkey_patch():
+    global _patched
+    if _patched:
+        return
+    _patched = True
+
+    import sys
+
+    mod = sys.modules[__name__]
+
+    for name, fn in _BINOPS.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__hash__ = lambda self: id(self)
+    Tensor.__neg__ = lambda self: math_mod.neg(self)
+    Tensor.__abs__ = lambda self: math_mod.abs(self)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+    for name in _METHODS:
+        fn = getattr(mod, name, None)
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, (lambda f: lambda self, *a, **k: f(self, *a, **k))(fn))
+
+    for name, fn in _INPLACE_ALIASES.items():
+        if fn is None:
+            continue
+        def make_inplace(f):
+            def ip(self, *a, **k):
+                return self._rebind(f(self, *a, **k))
+            return ip
+        setattr(Tensor, name, make_inplace(fn))
+
+    def fill_diagonal_(self, value, offset=0, wrap=False):
+        n = min(self.shape[-2], self.shape[-1])
+        idx = jnp.arange(n - abs(offset))
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        self._value = self._value.at[..., r, c].set(value)
+        return self
+
+    Tensor.fill_diagonal_ = fill_diagonal_
+    Tensor.T = property(lambda self: manipulation.transpose(
+        self, list(range(self.ndim))[::-1]))
+    Tensor.item_size = property(lambda self: self.dtype.itemsize)

@@ -1,0 +1,182 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import get_default_dtype, to_np
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+
+
+def _np_dtype(dtype, default_float=True):
+    if dtype is None:
+        return to_np(get_default_dtype()) if default_float else None
+    return to_np(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _np_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _np_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = get_default_dtype()
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, to_np(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("zeros_like", lambda v: jnp.zeros_like(v, dtype=to_np(dtype)), x,
+                 _differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("ones_like", lambda v: jnp.ones_like(v, dtype=to_np(dtype)), x,
+                 _differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply("full_like",
+                 lambda v: jnp.full_like(v, fill_value, dtype=to_np(dtype)), x,
+                 _differentiable=False)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+                 else get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=to_np(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_np_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(a):
+        return a.item() if isinstance(a, Tensor) else a
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_np_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_np_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(v, offset=offset)
+    return apply("diag", _diag, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    import numpy as _np
+
+    def _embed(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply("diag_embed", _embed, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_np(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_np(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply("meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = apply("assign", lambda v: v + 0, x)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    return apply("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def one_hot(x, num_classes, name=None):
+    import jax
+
+    return apply("one_hot",
+                 lambda v: jax.nn.one_hot(v, num_classes, dtype=to_np(get_default_dtype())),
+                 x, _differentiable=False)

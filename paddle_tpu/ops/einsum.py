@@ -1,0 +1,12 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py) — delegates to XLA dot lowering."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def einsum(equation, *operands):
+    ops = [o if isinstance(o, Tensor) else to_tensor(o) for o in operands]
+    return apply("einsum", lambda *vs: jnp.einsum(equation, *vs), *ops)

@@ -1,0 +1,199 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, paddle.linalg).
+
+All decompositions lower to XLA's linalg custom calls via jax.numpy.linalg.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _norm(v):
+        ord_ = p
+        if ord_ == "fro" or ord_ is None:
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            ord_ = None if isinstance(axis, (list, tuple)) else 2
+        if ord_ == "inf":
+            ord_ = jnp.inf
+        elif ord_ == "-inf":
+            ord_ = -jnp.inf
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if ax is None and ord_ is not None:
+            # vector norm over flattened input
+            return jnp.linalg.norm(v.reshape(-1), ord=ord_, keepdims=False)
+        return jnp.linalg.norm(v, ord=ord_, axis=ax, keepdims=keepdim)
+    return apply("norm", _norm, _t(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(
+        "matrix_norm",
+        lambda v: jnp.linalg.norm(
+            v, ord=p if p != "inf" else jnp.inf, axis=tuple(axis), keepdims=keepdim
+        ),
+        _t(x),
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return apply("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                 _t(x), _t(y))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def _slogdet(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply("slogdet", _slogdet, _t(x))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                 _t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+                 _t(x))
+
+
+def svdvals(x, name=None):
+    return apply("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), _t(x))
+
+
+def qr(x, mode="reduced", name=None):
+    out = apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), _t(x))
+    return out
+
+
+def eig(x, name=None):
+    return apply("eig", lambda v: tuple(jnp.linalg.eig(v)), _t(x),
+                 _differentiable=False)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), _t(x))
+
+
+def eigvals(x, name=None):
+    return apply("eigvals", jnp.linalg.eigvals, _t(x), _differentiable=False)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), _t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply("cholesky", _chol, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cs(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply("cholesky_solve", _cs, _t(x), _t(y))
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _ts(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", _ts, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply("lstsq", _lstsq, _t(x), _t(y), _differentiable=False)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank",
+                 lambda v: jnp.linalg.matrix_rank(v, rtol=tol),
+                 _t(x), _differentiable=False)
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply("cross", _cross, _t(x), _t(y))
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda vs: jnp.linalg.multi_dot(vs), list(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def _cov(v):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+    return apply("cov", _cov, _t(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), _t(x))
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda v: jnp.linalg.cond(v, p=p), _t(x),
+                 _differentiable=False)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, piv.astype(jnp.int32)
+    out = apply("lu", _lu, _t(x), _differentiable=False)
+    if get_infos:
+        return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
+    return out
+
+
+def householder_product(x, tau, name=None):
+    def _hp(v, t):
+        m, n = v.shape[-2], v.shape[-1]
+        eye = jnp.eye(m, dtype=v.dtype)
+        q = jnp.broadcast_to(eye, v.shape[:-2] + (m, m)).copy() if v.ndim > 2 else eye
+        for i in range(t.shape[-1]):
+            w = v[..., :, i]
+            w = jnp.where(jnp.arange(m) < i, 0.0, w)
+            w = w.at[..., i].set(1.0) if w.ndim == 1 else w
+            h = jnp.eye(m, dtype=v.dtype) - t[..., i] * jnp.outer(w, w)
+            q = q @ h
+        return q[..., :, :n]
+    return apply("householder_product", _hp, _t(x), _t(tau))

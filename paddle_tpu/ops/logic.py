@@ -1,0 +1,94 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return apply(name, fn, _t(x), _t(y), _differentiable=False)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, _t(x), _differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, _t(x), _differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose",
+                 lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 _t(x), _t(y), _differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose",
+                 lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 _t(x), _t(y), _differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b),
+                 _t(x), _t(y), _differentiable=False)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def in_dynamic_mode():
+    from ..core.dispatch import in_static_trace
+
+    return not in_static_trace()
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    def _axis(a):
+        if a is None:
+            return None
+        return tuple(a) if isinstance(a, (list, tuple)) else int(a)
+    return apply("any", lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), _differentiable=False)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    def _axis(a):
+        if a is None:
+            return None
+        return tuple(a) if isinstance(a, (list, tuple)) else int(a)
+    return apply("all", lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), _differentiable=False)

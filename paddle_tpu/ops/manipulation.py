@@ -1,0 +1,466 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+Ops whose output shape depends on data (nonzero, masked_select, unique) are
+eager-only: XLA requires static shapes, so under a to_static trace they raise —
+the reference has the same tension and resolves it with LoD/dynamic ops, we
+resolve it by keeping them at the host boundary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, in_static_trace
+from ..core.dtype import to_np
+from ..core.tensor import Tensor, to_tensor
+
+
+py_slice = slice  # captured before the paddle-style `slice` op shadows it
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def cast(x, dtype):
+    return apply("cast", lambda v: v.astype(to_np(dtype)), _t(x))
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    return apply("reshape", lambda v: jnp.reshape(v, shape), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return apply("flatten", _flatten, _t(x))
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = [int(p) for p in perm]
+    return apply("transpose", lambda v: jnp.transpose(v, perm), _t(x))
+
+
+def t(x, name=None):
+    return apply("t", lambda v: v.T, _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination), _t(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis1, axis2), _t(x))
+
+
+def squeeze(x, axis=None, name=None):
+    def _squeeze(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply("squeeze", _squeeze, _t(x))
+
+
+def unsqueeze(x, axis, name=None):
+    def _unsqueeze(v):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = v
+        for a in sorted(int(a) if not isinstance(a, Tensor) else int(a.item())
+                        for a in axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply("unsqueeze", _unsqueeze, _t(x))
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda vs: jnp.concatenate(vs, axis=ax), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda vs: jnp.stack(vs, axis=axis), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def _split(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        sections = [int(s) for s in num_or_sections]
+        total = v.shape[ax]
+        known = [s for s in sections if s != -1]
+        sections = [s if s != -1 else total - int(np.sum(known)) for s in sections]
+        points = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(v, points, axis=ax))
+    return list(apply("split", _split, _t(x)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    def _unbind(v):
+        return tuple(jnp.moveaxis(v, axis, 0))
+    return list(apply("unbind", _unbind, _t(x)))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply("tile", lambda v: jnp.tile(v, reps), _t(x))
+
+
+def expand(x, shape, name=None):
+    shape = _static_shape(shape)
+
+    def _expand(v):
+        tgt = list(shape)
+        # paddle: -1 keeps original dim
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return apply("expand", _expand, _t(x))
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda v, w: jnp.broadcast_to(v, w.shape), _t(x), _t(y))
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _static_shape(shape)
+    return apply("broadcast_to", lambda v: jnp.broadcast_to(v, shape), _t(x))
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply("broadcast_tensors",
+                 lambda vs: tuple(jnp.broadcast_arrays(*vs)), list(inputs))
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda v: jnp.flip(v, axis=tuple(axes)), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda v: jnp.roll(v, shifts, axis=axis), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), _t(x))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def _gather(v, idx):
+        return jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx, axis=ax)
+    return apply("gather", _gather, _t(x), _t(index))
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(v, idx):
+        # index [..., k] indexes first k dims of v
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return apply("gather_nd", _gather_nd, _t(x), _t(index))
+
+
+def take(x, index, mode="raise", name=None):
+    def _take(v, idx):
+        return jnp.take(v.reshape(-1), idx, mode="clip" if mode != "wrap" else "wrap")
+    return apply("take", _take, _t(x), _t(index))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply("take_along_axis",
+                 lambda v, idx: jnp.take_along_axis(v, idx, axis=axis),
+                 _t(arr), _t(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def _put(v, idx, val):
+        val = jnp.broadcast_to(val, idx.shape).astype(v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False)
+        updater = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+        # emulate via at-scatter
+        ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = list(ii)
+        full_idx[axis] = idx
+        if updater == "add":
+            return v.at[tuple(full_idx)].add(val)
+        return v.at[tuple(full_idx)].multiply(val)
+    return apply("put_along_axis", _put, _t(arr), _t(indices), _t(values))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(v, idx, upd):
+        if overwrite:
+            return v.at[idx].set(upd)
+        return v.at[idx].add(upd)
+    return apply("scatter", _scatter, _t(x), _t(index), _t(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(v, idx, upd):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply("scatter_nd_add", _snd, _t(x), _t(index), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _static_shape(shape)
+
+    def _snd(idx, upd):
+        z = jnp.zeros(shape, upd.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply("scatter_nd", _snd, _t(index), _t(updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda v, idx: jnp.take(v, idx, axis=axis),
+                 _t(x), _t(index))
+
+
+def index_sample(x, index, name=None):
+    return apply("index_sample",
+                 lambda v, idx: jnp.take_along_axis(v, idx, axis=1), _t(x), _t(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def _index_add(v, idx, val):
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[idx].add(jnp.moveaxis(val, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", _index_add, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _index_put(v, idxs, val):
+        key = tuple(idxs)
+        if accumulate:
+            return v.at[key].add(val)
+        return v.at[key].set(val)
+    return apply("index_put", _index_put, _t(x), [_t(i) for i in indices], _t(value))
+
+
+def slice(input, axes, starts, ends, name=None):
+    def _iv(a):
+        return int(a.item()) if isinstance(a, Tensor) else int(a)
+    axes = [_iv(a) for a in axes]
+    starts = [_iv(s) for s in starts]
+    ends = [_iv(e) for e in ends]
+
+    def _slice(v):
+        idx = [py_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = py_slice(s, e)
+        return v[tuple(idx)]
+    return apply("slice", _slice, _t(input))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _ss(v):
+        idx = [py_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(a)] = py_slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return apply("strided_slice", _ss, _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _static_shape(shape)
+    offsets = [0] * len(shape) if offsets is None else [int(o) for o in offsets]
+
+    def _crop(v):
+        idx = tuple(py_slice(o, o + s) for o, s in zip(offsets, shape))
+        return v[idx]
+    return apply("crop", _crop, _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def _ri(v):
+        return jnp.repeat(v, repeats, axis=axis)
+    return apply("repeat_interleave", _ri, _t(x))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b),
+                 _t(condition), _t(x), _t(y))
+
+
+def where_(condition, x, y, name=None):
+    return x._rebind(where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    if in_static_trace():
+        raise RuntimeError("nonzero has data-dependent shape; not supported under jit")
+    arr = np.asarray(x._value)
+    res = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(r)) for r in res)
+    return Tensor(jnp.asarray(np.stack(res, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    if in_static_trace():
+        raise RuntimeError("masked_select has data-dependent shape; not supported under jit")
+    arr = np.asarray(x._value)
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply("masked_fill",
+                 lambda v, m: jnp.where(m, jnp.asarray(value, v.dtype), v),
+                 _t(x), _t(mask))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    if in_static_trace():
+        raise RuntimeError("unique has data-dependent shape; not supported under jit")
+    arr = np.asarray(x._value)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    if in_static_trace():
+        raise RuntimeError("unique_consecutive: data-dependent shape under jit")
+    arr = np.asarray(x._value).flatten() if axis is None else np.asarray(x._value)
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]]) if arr.ndim == 1 else None
+    if keep is None:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = [Tensor(jnp.asarray(arr[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        out.append(Tensor(jnp.asarray(np.diff(np.append(idx, len(arr))))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def _pad(v):
+        p = [int(q.item()) if isinstance(q, Tensor) else int(q) for q in pad]
+        nd = v.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad applies to last len(p)//2 spatial dims,
+            # ordered (left, right, top, bottom, ...) from the last dim inward
+            width = [(0, 0)] * nd
+            npairs = len(p) // 2
+            if data_format.endswith("HWC") or data_format in ("NLC", "NHWC", "NDHWC"):
+                dims = list(range(1, 1 + npairs))
+            else:
+                dims = list(range(nd - npairs, nd))
+            for i, d in enumerate(dims):
+                width[d] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode=jmode, constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return apply("pad", _pad, _t(x))
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                 _t(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, _t(v)) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, _t(v)) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, _t(v)) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    def _td(a, b):
+        ax = axes
+        if isinstance(ax, Tensor):
+            ax = ax.numpy().tolist()
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(tuple(int(i) for i in a2) if isinstance(a2, (list, tuple))
+                       else int(a2) for a2 in ax)
+        return jnp.tensordot(a, b, axes=ax)
+    return apply("tensordot", _td, _t(x), _t(y))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def _shard(v):
+        size = index_num // nshards
+        lo = shard_id * size
+        in_shard = (v >= lo) & (v < lo + size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    return apply("shard_index", _shard, _t(input), _differentiable=False)

@@ -1,0 +1,345 @@
+"""Elementwise + reduction math ops (reference: python/paddle/tensor/math.py).
+
+Every op is a functional jnp computation dispatched through the tape
+(core/dispatch.py); XLA fuses elementwise chains automatically, which is what
+the reference's fusion passes (/root/reference/paddle/fluid/framework/ir) do
+by hand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import to_np
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return apply(name, fn, _t(x), _t(y))
+    op.__name__ = name
+    return op
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return apply(name, fn, _t(x))
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+heaviside = _binop("heaviside", jnp.heaviside)
+copysign = _binop("copysign", jnp.copysign)
+nextafter = _binop("nextafter", jnp.nextafter)
+ldexp = _binop("ldexp", jnp.ldexp)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+
+
+def pow(x, y, name=None):
+    return apply("pow", jnp.power, _t(x), _t(y))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    out = apply("scale", _scale, _t(x), _t(scale), _t(bias))
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+abs = _unop("abs", jnp.abs)
+neg = _unop("neg", jnp.negative)
+sign = _unop("sign", jnp.sign)
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda v: v - jnp.trunc(v))
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+square = _unop("square", jnp.square)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+logit = _unop("logit", jax.scipy.special.logit)
+isnan = _unop("isnan", jnp.isnan)
+isinf = _unop("isinf", jnp.isinf)
+isfinite = _unop("isfinite", jnp.isfinite)
+isneginf = _unop("isneginf", jnp.isneginf)
+isposinf = _unop("isposinf", jnp.isposinf)
+
+
+def clip(x, min=None, max=None, name=None):
+    def _v(a):
+        return a._value if isinstance(a, Tensor) else a
+    return apply("clip", lambda v: jnp.clip(v, _v(min), _v(max)), _t(x))
+
+
+def lerp(x, y, weight, name=None):
+    return apply("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y), _t(weight))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                 _t(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), _t(x))
+
+
+def multiplex(inputs, index, name=None):
+    def _mux(ins, idx):
+        stacked = jnp.stack(ins, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+    return apply("multiplex", _mux, list(inputs), _t(index))
+
+
+# ------------------------------------------------------------------ reductions
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply("sum",
+                 lambda v: jnp.sum(v, axis=_axis(axis), dtype=to_np(dtype),
+                                   keepdims=keepdim), _t(x))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean",
+                 lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("max", lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("min", lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply("prod",
+                 lambda v: jnp.prod(v, axis=_axis(axis), dtype=to_np(dtype),
+                                    keepdims=keepdim), _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply("nansum",
+                 lambda v: jnp.nansum(v, axis=_axis(axis), dtype=to_np(dtype),
+                                      keepdims=keepdim), _t(x))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean",
+                 lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero",
+                 lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim),
+                 _t(x), _differentiable=False)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp",
+                 lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis),
+                                                       keepdims=keepdim), _t(x))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _cumsum(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=to_np(dtype))
+        return jnp.cumsum(v, axis=_axis(axis), dtype=to_np(dtype))
+    return apply("cumsum", _cumsum, _t(x))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _cumprod(v):
+        if dim is None:
+            v = v.reshape(-1)
+            return jnp.cumprod(v, dtype=to_np(dtype))
+        return jnp.cumprod(v, axis=int(dim), dtype=to_np(dtype))
+    return apply("cumprod", _cumprod, _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        vals = jax.lax.cummax(v, axis=ax)
+        return vals
+    return apply("cummax", _cummax, _t(x))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(v):
+        ax = 0 if axis is None else int(axis)
+        v2 = v.reshape(-1) if axis is None else v
+        return jax.lax.cummin(v2, axis=ax)
+    return apply("cummin", _cummin, _t(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def _v(a):
+        return a._value if isinstance(a, Tensor) else a
+    return apply("diff",
+                 lambda v: jnp.diff(v, n=n, axis=axis, prepend=_v(prepend),
+                                    append=_v(append)), _t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace",
+                 lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal",
+                 lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x))
+
+
+# ------------------------------------------------------------------- matmul &c
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", _mm, _t(x), _t(y))
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    def _dot(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply("dot", _dot, _t(x), _t(y))
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, _t(x), _t(y))
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), _t(x), _t(y))
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                 _t(input), _t(x), _t(y))
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, _t(x))
+
+
+# ------------------------------------------------------------------ misc
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda v: v + value, _t(x))
+    x._rebind(out)
+    return x
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    def _acc(logits, lab):
+        topk_idx = jax.lax.top_k(logits, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk_idx == lab2, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply("accuracy", _acc, _t(input), _t(label), _differentiable=False)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,233 @@
+"""Random ops + global RNG state.
+
+The reference uses per-device stateful cuRAND generators
+(/root/reference/python/paddle/fluid/framework.py seed handling,
+paddle/phi/kernels gaussian kernels).  JAX randomness is functional; we keep a
+paddle-style *stateful* facade: a global Generator holding a jax PRNG key that
+splits on every draw.  Under a to_static trace, the key for each draw comes
+from a trace-key provider (the traced program takes the step key as an input —
+see paddle_tpu/jit/), so compiled programs get fresh randomness every step
+without recompiling.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.dtype import get_default_dtype, to_np
+from ..core.tensor import Tensor, to_tensor
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_default_generator = Generator(0)
+
+
+class _TraceKeyState(threading.local):
+    def __init__(self):
+        self.provider = None  # callable () -> key, set during to_static traces
+
+
+_trace_keys = _TraceKeyState()
+
+
+def set_trace_key_provider(provider):
+    prev = _trace_keys.provider
+    _trace_keys.provider = provider
+    return prev
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    if _trace_keys.provider is not None:
+        return _trace_keys.provider()
+    return _default_generator.next_key()
+
+
+def seed(value: int):
+    _default_generator.manual_seed(int(value))
+    return _default_generator
+
+
+def get_rng_state():
+    return [jnp.asarray(_default_generator._key)]
+
+
+def set_rng_state(state):
+    _default_generator._key = jnp.asarray(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _float_dtype(dtype):
+    return to_np(dtype) if dtype is not None else to_np(get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None):
+    key = next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _float_dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _float_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = next_key()
+    def _v(a):
+        return float(a.item()) if isinstance(a, Tensor) else float(a)
+    return Tensor(jax.random.uniform(key, _shape(shape), _float_dtype(dtype),
+                                     minval=_v(min), maxval=_v(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._value = out._value
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        return Tensor(jax.random.normal(key, shp, to_np(get_default_dtype())) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(key, shp, to_np(get_default_dtype())) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = next_key()
+    x._value = (jax.random.normal(key, tuple(x.shape), x._value.dtype) * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _float_dtype(dtype)) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, to_np(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = next_key()
+    dt = to_np(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.randint(key, tuple(x.shape), low, high, dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = next_key()
+    return Tensor(jax.random.permutation(key, n).astype(to_np(dtype)))
+
+
+def shuffle(x, name=None):
+    key = next_key()
+    return apply("shuffle", lambda v: jax.random.permutation(key, v, axis=0,
+                                                             independent=False), x)
+
+
+def bernoulli(x, name=None):
+    key = next_key()
+    return apply("bernoulli",
+                 lambda v: jax.random.bernoulli(key, v).astype(v.dtype), x,
+                 _differentiable=False)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = next_key()
+    x._value = jax.random.bernoulli(key, p, tuple(x.shape)).astype(x._value.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    key = next_key()
+    return apply("poisson",
+                 lambda v: jax.random.poisson(key, v).astype(v.dtype), x,
+                 _differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = next_key()
+
+    def _multinomial(v):
+        logits = jnp.log(jnp.clip(v, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(num_samples,) + v.shape[:-1]).T.astype(jnp.int64) \
+                if v.ndim > 1 else jax.random.categorical(
+                    key, logits, shape=(num_samples,)).astype(jnp.int64)
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return apply("multinomial", _multinomial, x, _differentiable=False)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = next_key()
+    x._value = jax.random.exponential(key, tuple(x.shape), x._value.dtype) / lam
+    return x
+
+
+def binomial(count, prob, name=None):
+    key = next_key()
+
+    def _binom(n, p):
+        return jax.random.binomial(key, n, p).astype(jnp.int64)
+    return apply("binomial", _binom, count, prob, _differentiable=False)
+
+
+def rand_like(x, dtype=None, name=None):
+    key = next_key()
+    dt = to_np(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.uniform(key, tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    key = next_key()
+    dt = to_np(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.normal(key, tuple(x.shape), dt))

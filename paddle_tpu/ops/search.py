@@ -1,0 +1,123 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.dtype import to_np
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmax(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(to_np(dtype))
+    return apply("argmax", _argmax, _t(x), _differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmin(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(to_np(dtype))
+    return apply("argmin", _argmin, _t(x), _differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def _argsort(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply("argsort", _argsort, _t(x), _differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def _sort(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+    return apply("sort", _sort, _t(x))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def _topk(v):
+        ax = axis if axis is not None else v.ndim - 1
+        vm = jnp.moveaxis(v, ax, -1)
+        src = vm if largest else -vm
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return apply("topk", _topk, _t(x))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(v):
+        ax = axis % v.ndim
+        vals = jnp.sort(v, axis=ax)
+        idxs = jnp.argsort(v, axis=ax)
+        take = jnp.take(vals, k - 1, axis=ax)
+        take_i = jnp.take(idxs, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            take = jnp.expand_dims(take, ax)
+            take_i = jnp.expand_dims(take_i, ax)
+        return take, take_i
+    return apply("kthvalue", _kth, _t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        sorted_v = jnp.sort(vm, axis=-1)
+        n = sorted_v.shape[-1]
+        runs = jnp.concatenate(
+            [jnp.ones(sorted_v.shape[:-1] + (1,), bool),
+             sorted_v[..., 1:] != sorted_v[..., :-1]], axis=-1)
+        run_id = jnp.cumsum(runs, axis=-1) - 1
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(axis=-2)
+        best_run = jnp.argmax(counts, axis=-1)
+        first_idx_of_run = jnp.argmax(run_id == best_run[..., None], axis=-1)
+        values = jnp.take_along_axis(sorted_v, first_idx_of_run[..., None], -1)[..., 0]
+        orig_idx = jnp.argmax(vm == values[..., None], axis=-1).astype(jnp.int64)
+        if keepdim:
+            return (jnp.expand_dims(jnp.moveaxis(values, -1, -1), ax),
+                    jnp.expand_dims(orig_idx, ax))
+        return values, orig_idx
+    return apply("mode", _mode, _t(x))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def _ss(seq, vals):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, vals, side=side)
+        else:
+            out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+                seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1]))
+            out = out.reshape(vals.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply("searchsorted", _ss, _t(sorted_sequence), _t(values),
+                 _differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def _fill(v, idx):
+        vm = jnp.moveaxis(v, axis, 0)
+        vm = vm.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(vm, 0, axis)
+    return apply("index_fill", _fill, _t(x), _t(index))

@@ -1,0 +1,89 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    return tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std",
+                 lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var",
+                 lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def _median(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # min mode: lower of the two middle values
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(v.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        srt = jnp.sort(v, axis=ax)
+        idx = (srt.shape[ax] - 1) // 2
+        out = jnp.take(srt, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply("median", _median, _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply("nanmedian",
+                 lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def _q(v):
+        qq = jnp.asarray(q)
+        return jnp.quantile(v, qq, axis=_axis(axis), keepdims=keepdim,
+                            method=interpolation)
+    return apply("quantile", _q, _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def _q(v):
+        return jnp.nanquantile(v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim,
+                               method=interpolation)
+    return apply("nanquantile", _q, _t(x))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def _hist(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        counts, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi),
+                                  density=density)
+        return counts if density else counts.astype(jnp.int64)
+    return apply("histogram", _hist, _t(input), _differentiable=False)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    from ..core.dispatch import in_static_trace
+    import numpy as np
+
+    if in_static_trace():
+        raise RuntimeError("bincount has data-dependent shape under jit")
+    arr = np.asarray(x._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))

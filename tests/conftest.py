@@ -1,0 +1,32 @@
+"""Test configuration: CPU backend with 8 virtual devices.
+
+Mirrors the reference strategy of testing distributed logic without a real
+cluster (SURVEY.md §4): the CPU XLA client is the "fake backend", and
+--xla_force_host_platform_device_count=8 gives a virtual 8-chip mesh for SPMD
+tests.  Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# XLA CPU lowers f32 dots to reduced precision by default; numeric comparisons
+# against numpy need exact f32 matmuls.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    np.random.seed(0)
+    import paddle_tpu
+
+    paddle_tpu.seed(0)
+    yield

@@ -1,0 +1,74 @@
+"""OpTest-style harness (reference:
+/root/reference/python/paddle/fluid/tests/unittests/op_test.py:292).
+
+check_output: run a framework op and compare against a numpy reference.
+check_grad: compare tape gradients against central finite differences
+(reference get_numeric_gradient, op_test.py:123).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, np_inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in np_inputs]
+    out = op_fn(*tensors, **kwargs)
+    expect = np_fn(*np_inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    expects = expect if isinstance(expect, (tuple, list)) else [expect]
+    for o, e in zip(outs, expects):
+        np.testing.assert_allclose(o.numpy(), np.asarray(e), atol=atol, rtol=rtol)
+    return out
+
+
+def numeric_grad(op_fn, np_inputs, input_index, eps=5e-3, kwargs=None,
+                 out_index=None):
+    """Central finite differences of sum(op(x)) w.r.t. inputs[input_index]."""
+    kwargs = kwargs or {}
+
+    def scalar_out(arrs):
+        tensors = [paddle.to_tensor(a) for a in arrs]
+        out = op_fn(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[out_index or 0]
+        return float(out.sum().numpy())
+
+    base = [np.array(a, dtype=np.float64) for a in np_inputs]
+    x = base[input_index]
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        plus = scalar_out([b.astype(np.float32) for b in base])
+        flat[i] = orig - eps
+        minus = scalar_out([b.astype(np.float32) for b in base])
+        flat[i] = orig
+        gflat[i] = (plus - minus) / (2 * eps)
+    return g
+
+
+def check_grad(op_fn, np_inputs, grad_input_indices=None, atol=1e-2, rtol=1e-2,
+               eps=5e-3, kwargs=None, out_index=None):
+    """Backward-pass gradients vs finite differences on sum(out)."""
+    kwargs = kwargs or {}
+    if grad_input_indices is None:
+        grad_input_indices = list(range(len(np_inputs)))
+
+    tensors = [paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=False)
+               for a in np_inputs]
+    out = op_fn(*tensors, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[out_index or 0]
+    out.sum().backward()
+
+    for idx in grad_input_indices:
+        analytic = tensors[idx].grad.numpy()
+        numeric = numeric_grad(op_fn, np_inputs, idx, eps=eps, kwargs=kwargs,
+                               out_index=out_index)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {idx}")

@@ -1,0 +1,227 @@
+"""Autograd engine: backward, accumulation, hooks, no_grad, paddle.grad, PyLayer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32) + 0.1
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-5)
+
+    def test_fanout_accumulation(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2.0
+        z = y + y * y  # z = 2x + 4x^2; dz/dx = 2 + 8x = 26
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [26.0], rtol=1e-5)
+
+    def test_multi_use_of_leaf(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        z = x * x + x  # dz/dx = 2x + 1 = 5
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0], rtol=1e-5)
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2.0).backward()
+        (x * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2.0).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3.0
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_second_backward_raises_without_retain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2.0).detach()
+        z = y * 3.0
+        assert z.stop_gradient
+
+    def test_deep_chain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x
+        for _ in range(50):
+            y = y * 1.1
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.1 ** 50], rtol=1e-4)
+
+    def test_branching_graph(self):
+        a = paddle.to_tensor(r(3, 3), stop_gradient=False)
+        b = paddle.to_tensor(r(3, 3), stop_gradient=False)
+        c = a @ b
+        d = a + c
+        e = (d * c).sum()
+        e.backward()
+        assert a.grad is not None and b.grad is not None
+        # numeric check on a
+        av, bv = a.numpy().astype(np.float64), b.numpy().astype(np.float64)
+
+        def f(av_):
+            c_ = av_ @ bv
+            return ((av_ + c_) * c_).sum()
+
+        eps = 1e-3
+        g = np.zeros_like(av)
+        for i in range(3):
+            for j in range(3):
+                p = av.copy(); p[i, j] += eps
+                m = av.copy(); m[i, j] -= eps
+                g[i, j] = (f(p) - f(m)) / (2 * eps)
+        np.testing.assert_allclose(a.grad.numpy(), g, atol=1e-2)
+
+
+class TestNoGrad:
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+
+    def test_no_grad_decorator(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+
+        @paddle.no_grad()
+        def f(v):
+            return v * 2.0
+
+        assert f(x).stop_gradient
+
+
+class TestFunctionalGrad:
+    def test_grad_wrt_leaf(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # functional API must not touch .grad
+
+    def test_grad_wrt_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3.0
+        z = y * y
+        (gy,) = paddle.grad(z, y, retain_graph=True)
+        np.testing.assert_allclose(gy.numpy(), [12.0])
+
+    def test_grad_unused_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        w = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, w, retain_graph=True)
+        (gw,) = paddle.grad(y, [w], allow_unused=True)
+        assert gw is None
+
+
+class TestHooks:
+    def test_leaf_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()) or (g * 2.0))
+        (x * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        assert len(seen) == 1
+
+    def test_intermediate_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2.0
+        y.register_hook(lambda g: g * 10.0)
+        (y * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [60.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 100.0)
+        h.remove()
+        (x * 2.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor
+                return grad * 3.0 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_pylayer_multi_output(self):
+        class SplitOp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2.0, x * 3.0
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                return g1 * 2.0 + g2 * 3.0
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        a, b = SplitOp.apply(x)
+        (a * a + b).backward()  # d/dx (4x^2 + 3x) = 8x + 3 = 11
+        np.testing.assert_allclose(x.grad.numpy(), [11.0])
+
+    def test_pylayer_no_grad_input(self):
+        class Mul(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 5.0
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 5.0
+
+        x = paddle.to_tensor([1.0])  # stop_gradient=True
+        y = Mul.apply(x)
+        assert y.stop_gradient
+
+
+class TestIntDtypeFlow:
+    def test_int_op_not_recorded(self):
+        x = paddle.to_tensor([1, 2, 3])
+        y = x + 1
+        assert y.stop_gradient
+
+    def test_argmax_not_differentiable(self):
+        x = paddle.to_tensor(r(3, 4), stop_gradient=False)
+        idx = paddle.argmax(x, axis=1)
+        assert idx.stop_gradient

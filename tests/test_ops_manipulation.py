@@ -1,0 +1,140 @@
+"""Manipulation op correctness."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestShape:
+    def test_reshape_flatten(self):
+        x = paddle.to_tensor(r(2, 3, 4))
+        assert x.reshape([6, 4]).shape == [6, 4]
+        assert x.reshape([-1]).shape == [24]
+        assert paddle.flatten(x).shape == [24]
+        assert paddle.flatten(x, 1, 2).shape == [2, 12]
+
+    def test_transpose(self):
+        x = paddle.to_tensor(r(2, 3, 4))
+        assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+
+    def test_squeeze_unsqueeze(self):
+        x = paddle.to_tensor(r(1, 3, 1))
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.squeeze(x, 0).shape == [3, 1]
+        assert paddle.unsqueeze(x, 0).shape == [1, 1, 3, 1]
+        assert paddle.unsqueeze(x, [0, 4]).shape == [1, 1, 3, 1, 1]
+
+    def test_concat_stack_split(self):
+        a, b = paddle.to_tensor(r(2, 3)), paddle.to_tensor(r(2, 3))
+        assert paddle.concat([a, b], axis=0).shape == [4, 3]
+        assert paddle.stack([a, b], axis=0).shape == [2, 2, 3]
+        parts = paddle.split(paddle.to_tensor(r(6, 2)), 3)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.to_tensor(r(6, 2)), [1, 2, -1])
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+    def test_concat_grad(self):
+        check_grad(lambda a, b: paddle.concat([a, b], axis=1), [r(2, 3), r(2, 2)])
+
+    def test_tile_expand(self):
+        x = paddle.to_tensor(r(1, 3))
+        assert paddle.tile(x, [2, 2]).shape == [2, 6]
+        assert paddle.expand(x, [4, 3]).shape == [4, 3]
+        assert paddle.broadcast_to(x, [4, 3]).shape == [4, 3]
+
+    def test_unbind(self):
+        outs = paddle.unbind(paddle.to_tensor(r(3, 4)), axis=0)
+        assert len(outs) == 3 and outs[0].shape == [4]
+
+    def test_flip_roll(self):
+        x = r(3, 4)
+        np.testing.assert_array_equal(
+            paddle.flip(paddle.to_tensor(x), [0]).numpy(), x[::-1])
+        np.testing.assert_array_equal(
+            paddle.roll(paddle.to_tensor(x), 1, axis=0).numpy(), np.roll(x, 1, 0))
+
+    def test_pad(self):
+        x = r(2, 3)
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+        assert out.shape == [2 + 2, 3 + 4]  # 2*ndim pads: per-dim (l, r) pairs
+
+
+class TestGatherScatter:
+    def test_gather(self):
+        x = r(5, 3)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(
+            paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+            x[idx])
+
+    def test_gather_nd(self):
+        x = r(3, 4)
+        idx = np.array([[0, 1], [2, 3]])
+        np.testing.assert_array_equal(
+            paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+            x[idx[:, 0], idx[:, 1]])
+
+    def test_scatter(self):
+        x = np.zeros((4, 2), np.float32)
+        idx = np.array([1, 3])
+        upd = np.ones((2, 2), np.float32)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        expect = x.copy()
+        expect[idx] = upd
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_index_select_sample(self):
+        x = r(4, 5)
+        np.testing.assert_array_equal(
+            paddle.index_select(paddle.to_tensor(x),
+                                paddle.to_tensor([1, 3]), axis=1).numpy(),
+            x[:, [1, 3]])
+        idx = np.array([[0, 1], [2, 3], [1, 0], [4, 4]])
+        np.testing.assert_array_equal(
+            paddle.index_sample(paddle.to_tensor(x),
+                                paddle.to_tensor(idx)).numpy(),
+            np.take_along_axis(x, idx, axis=1))
+
+    def test_gather_grad(self):
+        check_grad(
+            lambda x: paddle.gather(x, paddle.to_tensor(np.array([0, 2]))),
+            [r(4, 3)])
+
+    def test_take_along_axis(self):
+        x = r(3, 4)
+        idx = np.argmax(x, axis=1, keepdims=True)
+        np.testing.assert_array_equal(
+            paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx),
+                                   1).numpy(),
+            np.take_along_axis(x, idx, 1))
+
+
+class TestCast:
+    def test_cast(self):
+        x = paddle.to_tensor([1.7, 2.3])
+        assert paddle.cast(x, "int32").numpy().tolist() == [1, 2]
+        assert x.astype("bool").dtype == paddle.bool_
+
+    def test_cast_grad_passthrough(self):
+        check_grad(lambda x: paddle.cast(x, "float32") * 2.0, [r(3)])
+
+
+class TestDynamicShapeOps:
+    def test_unique(self):
+        x = np.array([3, 1, 2, 1, 3], np.int32)
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_masked_select_raises_in_jit(self):
+        from paddle_tpu.core.dispatch import static_trace_guard
+
+        with static_trace_guard():
+            with pytest.raises(RuntimeError):
+                paddle.masked_select(paddle.ones([3]),
+                                     paddle.to_tensor([True, False, True]))

@@ -1,0 +1,196 @@
+"""Math/reduction/linalg op correctness + gradient checks vs numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32) + 0.1
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,npop", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+        ("atan2", np.arctan2),
+    ])
+    def test_binary(self, op, npop):
+        check_output(getattr(paddle, op), npop, [r(3, 4), r(3, 4)])
+
+    @pytest.mark.parametrize("op,npop", [
+        ("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log), ("abs", np.abs),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+        ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+        ("log1p", np.log1p), ("expm1", np.expm1), ("sign", np.sign),
+        ("reciprocal", np.reciprocal),
+    ])
+    def test_unary(self, op, npop):
+        # XLA CPU's vectorized transcendentals are ~2e-4 relative vs libm
+        check_output(getattr(paddle, op), npop, [r(3, 4)], atol=1e-3, rtol=1e-3)
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [r(3, 1), r(1, 4)])
+
+    def test_pow_clip(self):
+        check_output(paddle.pow, np.power, [r(3), np.float32(2.0)])
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.clip(paddle.to_tensor(x), 0.0, 1.0).numpy(),
+            np.clip(x, 0, 1))
+
+    def test_grads(self):
+        check_grad(paddle.multiply, [r(3, 4), r(3, 4)])
+        check_grad(paddle.divide, [r(3, 4), r(3, 4) + 0.5])
+        check_grad(paddle.tanh, [r(4)])
+        check_grad(paddle.sqrt, [r(4) + 0.5])
+        check_grad(paddle.exp, [r(4)])
+
+    def test_scale(self):
+        x = r(3)
+        np.testing.assert_allclose(
+            paddle.scale(paddle.to_tensor(x), 2.0, 1.0).numpy(), x * 2 + 1,
+            rtol=1e-6)
+
+
+class TestReduction:
+    def test_sum_axes(self):
+        x = r(2, 3, 4)
+        check_output(paddle.sum, lambda v: np.sum(v), [x])
+        np.testing.assert_allclose(
+            paddle.sum(paddle.to_tensor(x), axis=1).numpy(), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sum(paddle.to_tensor(x), axis=[0, 2], keepdim=True).numpy(),
+            x.sum((0, 2), keepdims=True), rtol=1e-5)
+
+    def test_mean_max_min_prod(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(paddle.mean(paddle.to_tensor(x)).numpy(),
+                                   x.mean(), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.max(paddle.to_tensor(x), axis=0).numpy(), x.max(0), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.prod(paddle.to_tensor(x), axis=1).numpy(), x.prod(1), rtol=1e-5)
+
+    def test_reduction_grads(self):
+        check_grad(paddle.sum, [r(3, 4)])
+        check_grad(paddle.mean, [r(3, 4)])
+        check_grad(lambda x: paddle.max(x, axis=1), [r(3, 4)])
+
+    def test_cumsum_logsumexp(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+            np.cumsum(x, 1), rtol=1e-5)
+        from scipy.special import logsumexp as np_lse
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(x)).numpy(),
+            np_lse(x), rtol=1e-5)
+
+    def test_std_var(self):
+        x = r(5, 6)
+        np.testing.assert_allclose(paddle.std(paddle.to_tensor(x)).numpy(),
+                                   x.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.var(paddle.to_tensor(x), axis=0).numpy(),
+            x.var(0, ddof=1), rtol=1e-4)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [r(3, 4), r(4, 5)])
+        check_output(paddle.matmul, np.matmul, [r(2, 3, 4), r(2, 4, 5)])
+
+    def test_matmul_transpose(self):
+        x, y = r(4, 3), r(4, 5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                          transpose_x=True).numpy(),
+            x.T @ y, rtol=1e-5)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [r(3, 4), r(4, 5)])
+
+    def test_dot_outer(self):
+        x, y = r(4), r(4)
+        np.testing.assert_allclose(paddle.dot(paddle.to_tensor(x),
+                                              paddle.to_tensor(y)).numpy(),
+                                   np.dot(x, y), rtol=1e-5)
+        np.testing.assert_allclose(paddle.outer(paddle.to_tensor(x),
+                                                paddle.to_tensor(y)).numpy(),
+                                   np.outer(x, y), rtol=1e-5)
+
+    def test_einsum(self):
+        x, y = r(2, 3), r(3, 4)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(x),
+                          paddle.to_tensor(y)).numpy(),
+            np.einsum("ij,jk->ik", x, y), rtol=1e-5)
+
+
+class TestLinalg:
+    def test_inv_det_solve(self):
+        a = r(3, 3) + np.eye(3, dtype=np.float32) * 3
+        b = r(3, 2)
+        np.testing.assert_allclose(paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.inv(a), atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(paddle.to_tensor(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), atol=1e-4)
+
+    def test_norm(self):
+        x = r(3, 4)
+        np.testing.assert_allclose(paddle.linalg.norm(paddle.to_tensor(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        a = r(4, 3)
+        s = paddle.linalg.svdvals(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False), atol=1e-4)
+        q, rr = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ rr.numpy(), a, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(L @ L.T, spd, atol=1e-4)
+
+    def test_eigh(self):
+        a = r(3, 3)
+        sym = (a + a.T) / 2
+        w, v = paddle.linalg.eigh(paddle.to_tensor(sym))
+        np.testing.assert_allclose(w.numpy(), np.linalg.eigh(sym)[0], atol=1e-4)
+
+
+class TestSearchSort:
+    def test_argmax_topk(self):
+        x = r(3, 5)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), x.argmax(1))
+        vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(x, 1)[:, ::-1][:, :2],
+                                   rtol=1e-6)
+
+    def test_sort_argsort(self):
+        x = r(4, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.sort(x, 1), rtol=1e-6)
+        np.testing.assert_array_equal(
+            paddle.argsort(paddle.to_tensor(x), axis=1).numpy(), np.argsort(x, 1))
+
+    def test_where_nonzero(self):
+        x = np.array([1.0, -1.0, 2.0], np.float32)
+        out = paddle.where(paddle.to_tensor(x) > 0,
+                           paddle.to_tensor(x), paddle.zeros([3]))
+        np.testing.assert_array_equal(out.numpy(), [1, 0, 2])
+        nz = paddle.nonzero(paddle.to_tensor(x) > 0)
+        np.testing.assert_array_equal(nz.numpy().flatten(), [0, 2])
+
+    def test_searchsorted(self):
+        seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        vals = np.array([2.0, 6.0], np.float32)
+        np.testing.assert_array_equal(
+            paddle.searchsorted(paddle.to_tensor(seq),
+                                paddle.to_tensor(vals)).numpy(),
+            np.searchsorted(seq, vals))

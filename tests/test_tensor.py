@@ -1,0 +1,153 @@
+"""Tensor basics: creation, properties, indexing, in-place, conversion."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_to_tensor_dtype(self):
+        t = paddle.to_tensor([1, 2, 3], dtype="float64")
+        assert t.dtype == "float64" or t.dtype == "float32"  # x64 may be off
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7.0).numpy(), [7, 7])
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_diag_tril(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_array_equal(paddle.tril(x).numpy(), np.tril(x.numpy()))
+        np.testing.assert_array_equal(paddle.triu(x).numpy(), np.triu(x.numpy()))
+
+    def test_like_ops(self):
+        x = paddle.ones([2, 3])
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.full_like(x, 5).numpy()[0, 0] == 5
+
+    def test_one_hot(self):
+        out = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_array_equal(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestProperties:
+    def test_shape_ndim_numel(self):
+        t = paddle.ones([2, 3, 4])
+        assert t.shape == [2, 3, 4]
+        assert t.ndim == 3
+        assert t.numel() == 24
+        assert len(t) == 2
+
+    def test_item(self):
+        assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+
+    def test_astype(self):
+        t = paddle.ones([2]).astype("int32")
+        assert t.dtype == paddle.int32
+
+    def test_repr(self):
+        assert "Tensor" in repr(paddle.ones([2]))
+
+
+class TestIndexing:
+    def test_basic_getitem(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+        np.testing.assert_array_equal(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_array_equal(x[0, 1:3].numpy(), [1, 2])
+        np.testing.assert_array_equal(x[:, -1].numpy(), [3, 7, 11])
+
+    def test_tensor_index(self):
+        x = paddle.to_tensor(np.arange(10).astype(np.float32))
+        idx = paddle.to_tensor([1, 3, 5])
+        np.testing.assert_array_equal(x[idx].numpy(), [1, 3, 5])
+
+    def test_bool_mask_getitem(self):
+        x = paddle.to_tensor(np.arange(4).astype(np.float32))
+        # boolean masks are data-dependent: allowed eagerly
+        out = paddle.masked_select(x, paddle.to_tensor([True, False, True, False]))
+        np.testing.assert_array_equal(out.numpy(), [0, 2])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1, 1] = 5.0
+        assert x.numpy()[1, 1] == 5
+
+    def test_setitem_grad_flows(self):
+        x = paddle.ones([3], stop_gradient=False)
+        y = x * 2.0
+        y[0] = 0.0
+        y.sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [0, 2, 2])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.ones([2])
+        x.add_(paddle.ones([2]))
+        np.testing.assert_array_equal(x.numpy(), [2, 2])
+
+    def test_zero_fill(self):
+        x = paddle.ones([2])
+        x.zero_()
+        assert x.numpy().sum() == 0
+        x.fill_(3.0)
+        np.testing.assert_array_equal(x.numpy(), [3, 3])
+
+    def test_set_value(self):
+        x = paddle.ones([2])
+        x.set_value(np.array([5.0, 6.0], np.float32))
+        np.testing.assert_array_equal(x.numpy(), [5, 6])
+
+
+class TestOperators:
+    def test_arith(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_array_equal((x + y).numpy(), [4, 6])
+        np.testing.assert_array_equal((x - y).numpy(), [-2, -2])
+        np.testing.assert_array_equal((x * y).numpy(), [3, 8])
+        np.testing.assert_allclose((x / y).numpy(), [1 / 3, 0.5], rtol=1e-6)
+        np.testing.assert_array_equal((x ** 2).numpy(), [1, 4])
+        np.testing.assert_array_equal((-x).numpy(), [-1, -2])
+        np.testing.assert_array_equal((2.0 + x).numpy(), [3, 4])
+        np.testing.assert_array_equal((2.0 - x).numpy(), [1, 0])
+
+    def test_matmul_operator(self):
+        x = paddle.ones([2, 3])
+        y = paddle.ones([3, 4])
+        assert (x @ y).shape == [2, 4]
+
+    def test_comparison(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = paddle.to_tensor([2.0, 2.0])
+        np.testing.assert_array_equal((x == y).numpy(), [False, True])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False])
+
+    def test_methods(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.sum().item() == 10
+        assert x.mean().item() == 2.5
+        assert x.max().item() == 4
+        assert x.reshape([4]).shape == [4]
+        assert x.t().shape == [2, 2]
+        assert x.T.shape == [2, 2]
+
+
+class TestParameter:
+    def test_parameter(self):
+        p = paddle.Parameter(np.ones((2, 2), np.float32) * 0 + 1)
+        assert not p.stop_gradient
+        assert p.persistable
+        assert "Parameter" in repr(p)
