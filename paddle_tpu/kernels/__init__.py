@@ -1,0 +1,9 @@
+"""Pallas TPU kernel pack (SURVEY.md §7 step 8).
+
+Replaces the reference's hand-written CUDA fused ops
+(/root/reference/paddle/fluid/operators/fused/) with Mosaic-compiled Pallas
+kernels.  Every kernel has an XLA reference path used on CPU (tests run the
+Pallas interpreter) and as the recompute backward.
+"""
+from .flash_attention import flash_attention_bhtd, flash_attention_bthd  # noqa: F401
+from .rms_norm import rms_norm  # noqa: F401

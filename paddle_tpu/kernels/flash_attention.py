@@ -1,0 +1,180 @@
+"""Pallas flash attention for TPU.
+
+TPU-native replacement for the reference fused attention CUDA stack
+(/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu,
+fmha_ref.h): online-softmax tiling over the KV sequence so logits never
+materialize in HBM.  Grid = (batch*heads, q_blocks, k_blocks) with the KV
+axis innermost; m/l/acc accumulate in VMEM scratch across k steps and the
+output block is written on the last k step.
+
+Forward = Pallas kernel; backward recomputes through the XLA reference
+(flash-style recompute: no O(T^2) residuals are saved).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; import lazily-safe for CPU test runs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _attn_reference(q, k, v, causal, scale):
+    """[B, H, T, D] reference; also used for the recompute backward."""
+    logits = jnp.einsum(
+        "bhtd,bhsd->bhts", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        t, s = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, block_q, block_k, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_ref[:]  # [block_q, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+    l_ref[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    if Tq % bq or Tk % bk:
+        # shape not tileable: fall back
+        return _attn_reference(q, k, v, causal, scale)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+
+    grid = (B * H, Tq // bq, Tk // bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        kv_len=Tk)
+    scratch = [
+        pltpu.VMEM((bq, D), jnp.float32) if _HAS_PLTPU and not interpret
+        else pltpu.VMEM((bq, D), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not interpret) else None,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # flash-style: recompute attention under XLA and transpose (no O(T^2)
+    # residual was stored by the forward kernel)
+    _, vjp_fn = jax.vjp(
+        lambda q_, k_, v_: _attn_reference(q_, k_, v_, causal, scale), q, k, v)
+    return vjp_fn(g)
+
+
+_flash_attention_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_bhtd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=None):
+    """[B, H, T, D] flash attention."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not _HAS_PLTPU:
+        return _attn_reference(q, k, v, causal, scale)
+    return _flash_attention_bhtd(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
+
+
+def flash_attention_bthd(q, k, v, causal=False, scale=None, **kwargs):
+    """[B, T, H, D] layout (paddle flash_attention layout).  Supports GQA by
+    repeating KV heads when q heads are a multiple of kv heads."""
+    qh = q.shape[2]
+    kh = k.shape[2]
+    if qh != kh:
+        rep = qh // kh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhtd(qt, kt, vt, causal=causal, scale=scale, **kwargs)
+    return jnp.swapaxes(out, 1, 2)

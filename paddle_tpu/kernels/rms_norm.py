@@ -1,0 +1,85 @@
+"""Pallas fused RMSNorm for TPU.
+
+Replaces the reference's fused-norm CUDA kernels (the reference fuses
+LayerNorm into fused_attention/fused_feedforward ops,
+/root/reference/paddle/fluid/operators/fused/).  One pass over rows in VMEM:
+mean-square, rsqrt, scale — saving an HBM round trip vs unfused.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_ROWS = 512
+
+
+def _rms_ref(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(
+        x.dtype) * w
+
+
+def _kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    normed = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (normed * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm(x2d, w, eps, interpret):
+    return _rms_fwd_impl(x2d, w, eps, interpret)
+
+
+def _rms_fwd_impl(x2d, w, eps, interpret):
+    n, d = x2d.shape
+    rows = min(DEFAULT_BLOCK_ROWS, n)
+    if n % rows:
+        return _rms_ref(x2d, w, eps)
+    return pl.pallas_call(
+        functools.partial(_kernel, eps=eps),
+        grid=(n // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        interpret=interpret,
+    )(x2d, w)
+
+
+def _rms_vjp_fwd(x2d, w, eps, interpret):
+    return _rms_fwd_impl(x2d, w, eps, interpret), (x2d, w)
+
+
+def _rms_vjp_bwd(eps, interpret, res, g):
+    x2d, w = res
+    _, vjp_fn = jax.vjp(lambda x_, w_: _rms_ref(x_, w_, eps), x2d, w)
+    return vjp_fn(g)
+
+
+_rms_norm.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
+
+
+def rms_norm(x, weight, epsilon=1e-6, interpret=None):
+    """RMSNorm over the last axis; any leading shape."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not _HAS_PLTPU:
+        return _rms_ref(x, weight, epsilon)
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    out = _rms_norm(x2d, weight, epsilon, interpret)
+    return out.reshape(shape)

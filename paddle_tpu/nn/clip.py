@@ -1,0 +1,107 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip.  Under hybrid parallelism the distributed optimizer
+    extends the squared-norm sum with cross-mesh psums (reference:
+    HybridParallelOptimizer grad clip across mp/pp/sharding axes)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _global_norm(self, params_grads):
+        sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+              for p, g in params_grads
+              if g is not None and getattr(p, "trainable", True)]
+        if not sq:
+            return None
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def _clip(self, params_grads):
+        global_norm = self._global_norm(params_grads)
+        if global_norm is None:
+            return params_grads
+        scale = jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "trainable", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)),
+                                  norm_type)) for g in grads),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)

@@ -1,0 +1,282 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    def _ce(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            valid = None
+        else:
+            lab_idx = lab
+            if lab_idx.ndim == logp.ndim:
+                lab_idx = jnp.squeeze(lab_idx, axis)
+            lab_idx = lab_idx.astype(jnp.int32)
+            valid = lab_idx != ignore_index
+            safe = jnp.where(valid, lab_idx, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0.0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + \
+                    label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if maybe_w:
+                w = maybe_w[0][safe]
+                loss = loss * jnp.where(valid, w, 0.0)
+        if reduction == "mean":
+            if valid is not None:
+                if maybe_w:
+                    denom = jnp.sum(jnp.where(valid, maybe_w[0][jnp.where(
+                        valid, lab_idx, 0)], 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("cross_entropy", _ce, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def _nll(logp, lab, *maybe_w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        wsum = None
+        if maybe_w:
+            w = maybe_w[0][safe]
+            loss = loss * w
+            wsum = jnp.sum(jnp.where(valid, w, 0.0))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = wsum if wsum is not None else jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("nll_loss", _nll, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta
+        loss = loss * delta
+        return _reduce(loss, reduction)
+    return apply("smooth_l1_loss", _sl1, _t(input), _t(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def _huber(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply("huber_loss", _huber, _t(input), _t(label))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _bce(p, lab, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(lab * jnp.log(p) + (1 - lab) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply("binary_cross_entropy", _bce, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _bcewl(z, lab, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * lab + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_weight = (pw - 1.0) * lab + 1.0
+            base = ((1 - lab) * z + log_weight *
+                    (jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0)))
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply("bce_with_logits", _bcewl, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def _kl(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", _kl, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def _mrl(a, b, lab):
+        return _reduce(jnp.maximum(0.0, -lab * (a - b) + margin), reduction)
+    return apply("margin_ranking_loss", _mrl, _t(input), _t(other), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def _hel(a, lab):
+        loss = jnp.where(lab == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", _hel, _t(input), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def _cel(a, b, lab):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(lab == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", _cel, _t(input1), _t(input2), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _tml(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply("triplet_margin_loss", _tml, _t(input), _t(positive),
+                 _t(negative))
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b),
+                 _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def _ll(p, lab):
+        return -lab * jnp.log(p + epsilon) - (1 - lab) * jnp.log(1 - p + epsilon)
+    return apply("log_loss", _ll, _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _sfl(z, lab, *maybe_norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * lab + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_norm:
+            loss = loss / maybe_norm[0]
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply("sigmoid_focal_loss", _sfl, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (XLA-lowered dynamic program)."""
+    import optax
+
+    def _ctc(lp, lab, il, ll):
+        # optax expects [B, T, C] logits and paddings
+        logits = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        B, T, C = logits.shape
+        logit_paddings = (jnp.arange(T)[None, :] >= il[:, None]).astype(
+            logits.dtype)
+        Lmax = lab.shape[1]
+        label_paddings = (jnp.arange(Lmax)[None, :] >= ll[:, None]).astype(
+            logits.dtype)
+        loss = optax.ctc_loss(logits, logit_paddings, lab.astype(jnp.int32),
+                              label_paddings, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(loss / ll.astype(loss.dtype))
+        return _reduce(loss, reduction)
+    return apply("ctc_loss", _ctc, _t(log_probs), _t(labels),
+                 _t(input_lengths), _t(label_lengths))

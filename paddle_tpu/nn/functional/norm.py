@@ -1,0 +1,175 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm running-stat updates are expressed as in-place buffer rebinds; the
+to_static tracer captures them as extra program outputs so compiled training
+steps update state functionally (the XLA-idiomatic version of the reference's
+mutable inference/variance variables).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, is_grad_enabled
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    channel_axis = 1 if data_format[1] == "C" else -1
+    use_batch_stats = training and not use_global_stats
+
+    def _bn(v, rm, rv, *wb):
+        axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
+        if use_batch_stats:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * v.ndim
+        shape[channel_axis] = v.shape[channel_axis]
+        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        if wb:
+            w, b = wb
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out.astype(v.dtype)
+
+    args = [_t(x), _t(running_mean), _t(running_var)]
+    if weight is not None:
+        args += [_t(weight), _t(bias)]
+    out = apply("batch_norm", _bn, *args)
+
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        # update running stats (paddle: stat = momentum*stat + (1-m)*batch)
+        with_no_grad_update(x, running_mean, running_var, channel_axis, momentum)
+    return out
+
+
+def with_no_grad_update(x, running_mean, running_var, channel_axis, momentum):
+    from ...core.dispatch import no_grad_ctx
+
+    with no_grad_ctx():
+        v = x._value
+        axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+        var = jnp.var(v.astype(jnp.float32), axis=axes)
+        running_mean._value = (momentum * running_mean._value
+                               + (1.0 - momentum) * mean.astype(
+                                   running_mean._value.dtype))
+        running_var._value = (momentum * running_var._value
+                              + (1.0 - momentum) * var.astype(
+                                  running_var._value.dtype))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim_norm = len(normalized_shape)
+
+    def _ln(v, *wb):
+        axes = tuple(range(v.ndim - ndim_norm, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            w = wb[0].reshape(tuple(normalized_shape))
+            out = out * w
+            if len(wb) > 1:
+                out = out + wb[1].reshape(tuple(normalized_shape))
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+        if bias is not None:
+            args.append(_t(bias))
+    return apply("layer_norm", _ln, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def _in(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = (1, v.shape[1]) + (1,) * (v.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+        if bias is not None:
+            args.append(_t(bias))
+    return apply("instance_norm", _in, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def _gn(v, *wb):
+        if data_format[-1] == "C":
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        spatial = v.shape[2:]
+        g = v.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        if wb:
+            shape = (1, c) + (1,) * len(spatial)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        if data_format[-1] == "C":
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(v.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+        if bias is not None:
+            args.append(_t(bias))
+    return apply("group_norm", _gn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(v):
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        sq = jnp.pad(sq, pads)
+        window = [1] * v.ndim
+        window[ch_axis] = size
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, tuple(window), (1,) * v.ndim, "VALID")
+        return v / jnp.power(k + alpha * summed, beta)
+    return apply("local_response_norm", _lrn, _t(x))
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
+    def _sn(w, u_, v_):
+        w_mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v_ = w_mat.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = w_mat @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        sigma = u_ @ w_mat @ v_
+        return w / sigma
+    return apply("spectral_norm", _sn, _t(weight), _t(u), _t(v))

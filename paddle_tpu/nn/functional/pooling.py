@@ -1,0 +1,229 @@
+"""Pooling functionals via XLA reduce_window
+(reference: python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v) if len(v) == n else tuple(
+            int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool_pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        if len(padding) == n + 2:
+            padding = padding[2:]
+        return [tuple(p) for p in padding]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _reduce_window(v, init, op, window, strides, pads, channel_last, n):
+    if channel_last:
+        dims = (1,) + window + (1,)
+        strd = (1,) + strides + (1,)
+        padc = [(0, 0)] + list(pads) + [(0, 0)] if not isinstance(pads, str) else pads
+    else:
+        dims = (1, 1) + window
+        strd = (1, 1) + strides
+        padc = [(0, 0), (0, 0)] + list(pads) if not isinstance(pads, str) else pads
+    if isinstance(padc, str):
+        return jax.lax.reduce_window(v, init, op, dims, strd, padc)
+    return jax.lax.reduce_window(v, init, op, dims, strd, tuple(padc))
+
+
+def _max_pool(x, kernel_size, stride, padding, ceil_mode, data_format, n,
+              return_mask=False):
+    window = _tuplize(kernel_size, n)
+    strides = _tuplize(stride if stride is not None else kernel_size, n)
+    pads = _pool_pads(padding, n)
+    channel_last = data_format[-1] == "C"
+
+    def _fn(v):
+        out = _reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                             else jnp.iinfo(v.dtype).min,
+                             jax.lax.max, window, strides, pads, channel_last, n)
+        return out.astype(v.dtype)
+    out = apply(f"max_pool{n}d", _fn, _t(x))
+    if return_mask:
+        # indices computed separately (flat index within each window's input)
+        idx = _max_pool_indices(x, window, strides, pads, channel_last, n)
+        return out, idx
+    return out
+
+
+def _max_pool_indices(x, window, strides, pads, channel_last, n):
+    """Flat input-spatial index of each window max (for MaxUnpool)."""
+    def _fn(v):
+        if channel_last or n != 2:
+            raise NotImplementedError("return_mask only for NCHW 2d pooling")
+        kh, kw = window
+        pad_cfg = pads if isinstance(pads, str) else tuple(pads)
+        patches = jax.lax.conv_general_dilated_patches(
+            v, window, strides, pad_cfg,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        nb, ckk, oh, ow = patches.shape
+        c = v.shape[1]
+        patches = patches.reshape(nb, c, kh * kw, oh, ow)
+        widx = jnp.argmax(patches, axis=2)  # index within window
+        wi, wj = widx // kw, widx % kw
+        pt = 0 if isinstance(pads, str) else pads[0][0]
+        pl = 0 if isinstance(pads, str) else pads[1][0]
+        oh_idx = jnp.arange(oh).reshape(1, 1, oh, 1)
+        ow_idx = jnp.arange(ow).reshape(1, 1, 1, ow)
+        h = oh_idx * strides[0] - pt + wi
+        w_ = ow_idx * strides[1] - pl + wj
+        return (h * v.shape[3] + w_).astype(jnp.int64)
+    return apply("max_pool_indices", _fn, _t(x), _differentiable=False)
+
+
+def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+              data_format, n, divisor_override=None):
+    window = _tuplize(kernel_size, n)
+    strides = _tuplize(stride if stride is not None else kernel_size, n)
+    pads = _pool_pads(padding, n)
+    channel_last = data_format[-1] == "C"
+
+    def _fn(v):
+        summed = _reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window,
+                                strides, pads, channel_last, n)
+        if divisor_override:
+            denom = float(divisor_override)
+            out = summed / denom
+        elif exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(v, jnp.float32)
+            denom = _reduce_window(ones, 0.0, jax.lax.add, window, strides, pads,
+                                   channel_last, n)
+            out = summed / denom
+        else:
+            out = summed / float(np.prod(window))
+        return out.astype(v.dtype)
+    return apply(f"avg_pool{n}d", _fn, _t(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, df, 1,
+                     return_mask)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, data_format, 2,
+                     return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, data_format, 3,
+                     return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, df, 1)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     data_format, 2, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     data_format, 3, divisor_override)
+
+
+def _adaptive_starts_ends(in_size, out_size):
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, reduce_fn, data_format):
+    if data_format[-1] == "C":
+        raise NotImplementedError("adaptive pool with channel_last")
+    out_sizes = _tuplize(output_size, n)
+
+    def _fn(v):
+        spatial = v.shape[2:]
+        if all(s % o == 0 for s, o in zip(spatial, out_sizes)):
+            # uniform windows: single reshape+reduce (fast path; global pool is
+            # out_size=1)
+            new_shape = list(v.shape[:2])
+            red_axes = []
+            for i, (s, o) in enumerate(zip(spatial, out_sizes)):
+                new_shape += [o, s // o]
+                red_axes.append(2 + 2 * i + 1)
+            return reduce_fn(v.reshape(new_shape), tuple(red_axes))
+        # general case: per-output-cell windows (static python loop, XLA unrolls)
+        slices = [_adaptive_starts_ends(s, o) for s, o in zip(spatial, out_sizes)]
+
+        def cell(idx):
+            sl = tuple(
+                slice(slices[d][0][idx[d]], slices[d][1][idx[d]])
+                for d in range(n))
+            return reduce_fn(v[(slice(None), slice(None)) + sl],
+                             tuple(range(2, 2 + n)))
+        from itertools import product
+
+        cells = [cell(idx) for idx in product(*[range(o) for o in out_sizes])]
+        out = jnp.stack(cells, axis=-1)
+        return out.reshape(v.shape[:2] + out_sizes)
+    return apply(f"adaptive_pool{n}d", _fn, _t(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, lambda v, a: jnp.mean(v, axis=a),
+                          "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, lambda v, a: jnp.mean(v, axis=a),
+                          data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, lambda v, a: jnp.mean(v, axis=a),
+                          data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, lambda v, a: jnp.max(v, axis=a),
+                          "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, lambda v, a: jnp.max(v, axis=a),
+                          "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, lambda v, a: jnp.max(v, axis=a),
+                          "NCDHW")

@@ -1,0 +1,342 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+The reference dispatches to cuDNN RNN kernels; the TPU-native design lowers
+the time loop to XLA While via jax.lax.scan, which is how recurrences are
+expressed for the MXU (weights stay resident, steps pipeline).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as init
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import zeros
+
+        B = batch_ref.shape[batch_dim_idx]
+        return zeros([B, self.hidden_size])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+        h = apply("simple_rnn_cell", _cell, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def _cell(x, h_, c_, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h_ @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c_ + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply("lstm_cell", _cell, inputs, h, c, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * h
+        h = apply("gru_cell", _cell, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over time via lax.scan (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # straightforward python loop (eager) — static unroll under jit;
+        # the stacked _RNNBase below uses lax.scan for the fused path
+        from ...ops.manipulation import stack
+
+        if not self.time_major:
+            steps = inputs.shape[1]
+            get = lambda t: inputs[:, t]
+        else:
+            steps = inputs.shape[0]
+            get = lambda t: inputs[t]
+        states = initial_states
+        outs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            out, states = self.cell(get(t), states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = stack(outs, axis=0 if self.time_major else 1)
+        return outputs, states
+
+
+class _RNNBase(Layer):
+    """Stacked multi-layer bi-directional RNN lowered with lax.scan."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_idx in range(self.bidirect):
+                in_size = input_size if layer == 0 \
+                    else hidden_size * self.bidirect
+                suffix = "_reverse" if direction_idx else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_size],
+                                           weight_ih_attr,
+                                           default_initializer=u)
+                wh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                bi = self.create_parameter([gate_mult * hidden_size],
+                                           bias_ih_attr, is_bias=True,
+                                           default_initializer=u)
+                bh = self.create_parameter([gate_mult * hidden_size],
+                                           bias_hh_attr, is_bias=True,
+                                           default_initializer=u)
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                for n, p in zip(names, [wi, wh, bi, bh]):
+                    self.add_parameter(n, p)
+                self._all_weights.append(names)
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(x, state, wi, wh, bi, bh):
+                h, c = state
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                c = f * c + i * g
+                h = o * jnp.tanh(c)
+                return h, (h, c)
+        elif mode == "GRU":
+            def step(x, state, wi, wh, bi, bh):
+                h = state
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ig = jnp.split(gi, 3, axis=-1)
+                hr, hz, hg = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                g = jnp.tanh(ig + r * hg)
+                h = (1 - z) * g + z * h
+                return h, h
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(x, state, wi, wh, bi, bh):
+                h = act(x @ wi.T + bi + state @ wh.T + bh)
+                return h, h
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        is_lstm = mode == "LSTM"
+        step = self._cell_step(mode)
+        time_major = self.time_major
+        nl, bd, hs = self.num_layers, self.bidirect, self.hidden_size
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] for n in names)
+
+        def _run(v, *flat_w):
+            x = v if time_major else jnp.swapaxes(v, 0, 1)  # [T, B, I]
+            B = x.shape[1]
+            idx = 0
+            final_h, final_c = [], []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(bd):
+                    wi, wh, bi, bh = flat_w[idx:idx + 4]
+                    idx += 4
+                    h0 = jnp.zeros((B, hs), v.dtype)
+                    state0 = (h0, h0) if is_lstm else h0
+                    xs = x[::-1] if d == 1 else x
+
+                    def scan_fn(state, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        out, new_state = step(xt, state, wi, wh, bi, bh)
+                        return new_state, out
+
+                    last_state, ys = jax.lax.scan(scan_fn, state0, xs)
+                    if d == 1:
+                        ys = ys[::-1]
+                    outs_dir.append(ys)
+                    if is_lstm:
+                        final_h.append(last_state[0])
+                        final_c.append(last_state[1])
+                    else:
+                        final_h.append(last_state)
+                x = outs_dir[0] if bd == 1 else jnp.concatenate(outs_dir, -1)
+            out = x if time_major else jnp.swapaxes(x, 0, 1)
+            h_stack = jnp.stack(final_h, 0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(final_c, 0)
+            return out, h_stack
+
+        res = apply(f"rnn_{mode.lower()}", _run, inputs, *weights)
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.fw(inputs, states_fw)
+        out_bw, st_bw = self.bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -18,6 +18,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 import jax  # noqa: E402
 
+# The machine's sitecustomize registers an accelerator platform and overrides
+# JAX_PLATFORMS; force CPU again post-import so tests use the virtual 8-device
+# mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # XLA CPU lowers f32 dots to reduced precision by default; numeric comparisons
 # against numpy need exact f32 matmuls.
 jax.config.update("jax_default_matmul_precision", "highest")

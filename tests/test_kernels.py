@@ -1,0 +1,64 @@
+"""Pallas kernel pack vs XLA references (interpreter mode on CPU)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+def r(*shape):
+    return jnp.asarray(np.random.randn(*shape).astype(np.float32))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        from paddle_tpu.kernels.flash_attention import (
+            _attn_reference, flash_attention_bhtd)
+
+        q, k, v = r(1, 2, 128, 32), r(1, 2, 128, 32), r(1, 2, 128, 32)
+        out = flash_attention_bhtd(q, k, v, causal=causal, block_q=64,
+                                   block_k=64)
+        ref = _attn_reference(q, k, v, causal, 1.0 / np.sqrt(32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_grad_matches_reference(self):
+        from paddle_tpu.kernels.flash_attention import (
+            _attn_reference, flash_attention_bhtd)
+
+        q, k, v = r(1, 1, 64, 16), r(1, 1, 64, 16), r(1, 1, 64, 16)
+        g = jax.grad(lambda q_: flash_attention_bhtd(
+            q_, k, v, causal=True, block_q=32, block_k=32).sum())(q)
+        gr = jax.grad(lambda q_: _attn_reference(
+            q_, k, v, True, 0.25).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=2e-4)
+
+    def test_gqa_bthd(self):
+        from paddle_tpu.kernels.flash_attention import flash_attention_bthd
+
+        q = r(1, 64, 8, 16)
+        k = r(1, 64, 2, 16)  # 2 kv heads, 8 q heads
+        v = r(1, 64, 2, 16)
+        out = flash_attention_bthd(q, k, v, causal=True)
+        assert out.shape == (1, 64, 8, 16)
+
+    def test_non_tileable_falls_back(self):
+        from paddle_tpu.kernels.flash_attention import flash_attention_bhtd
+
+        q, k, v = r(1, 1, 37, 16), r(1, 1, 37, 16), r(1, 1, 37, 16)
+        out = flash_attention_bhtd(q, k, v, block_q=32, block_k=32)
+        assert out.shape == (1, 1, 37, 16)
+
+
+class TestRMSNorm:
+    def test_matches_reference(self):
+        from paddle_tpu.kernels.rms_norm import _rms_ref, rms_norm
+
+        x, w = r(256, 64), r(64)
+        np.testing.assert_allclose(np.asarray(rms_norm(x, w)),
+                                   np.asarray(_rms_ref(x, w, 1e-6)), atol=1e-6)
+
+    def test_3d_input(self):
+        from paddle_tpu.kernels.rms_norm import rms_norm
+
+        x, w = r(2, 128, 32), r(32)
+        assert rms_norm(x, w).shape == (2, 128, 32)

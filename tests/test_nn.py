@@ -1,0 +1,355 @@
+"""nn.Layer system, layers, functional ops, initializers, clip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(net.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        a = nn.Linear(4, 3)
+        b = nn.Linear(4, 3)
+        b.set_state_dict(a.state_dict())
+        np.testing.assert_array_equal(a.weight.numpy(), b.weight.numpy())
+
+    def test_state_dict_includes_buffers(self):
+        bn = nn.BatchNorm1D(5)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval_recursive(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_apply_and_children(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        count = []
+        net.apply(lambda l: count.append(type(l).__name__))
+        assert "Linear" in count and "Sequential" in count
+        assert len(list(net.children())) == 2
+
+    def test_to_dtype(self):
+        lin = nn.Linear(2, 2)
+        lin.bfloat16()
+        assert lin.weight.dtype == paddle.bfloat16
+
+    def test_layerlist_dict(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+    def test_clear_gradients(self):
+        lin = nn.Linear(2, 2)
+        lin(paddle.ones([1, 2])).sum().backward()
+        assert lin.weight.grad is not None
+        lin.clear_gradients()
+        assert lin.weight.grad is None
+
+
+class TestLinearConv:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(4, 3)
+        x = r(2, 4)
+        out = lin(paddle.to_tensor(x))
+        expect = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+    def test_conv2d_shape_and_grad(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.to_tensor(r(2, 3, 8, 8))
+        out = conv(x)
+        assert out.shape == [2, 8, 4, 4]
+        out.sum().backward()
+        assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+    def test_conv2d_matches_simple_numpy(self):
+        # 1x1 conv == pointwise matmul
+        conv = nn.Conv2D(2, 4, 1, bias_attr=False)
+        x = r(1, 2, 3, 3)
+        out = conv(paddle.to_tensor(x)).numpy()
+        w = conv.weight.numpy()[:, :, 0, 0]
+        expect = np.einsum("oc,nchw->nohw", w, x)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_conv_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        out = conv(paddle.to_tensor(r(1, 4, 5, 5)))
+        assert out.shape == [1, 8, 5, 5]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        out = deconv(paddle.to_tensor(r(1, 4, 3, 3)))
+        assert out.shape == [1, 2, 6, 6]
+
+    def test_conv1d_3d(self):
+        assert nn.Conv1D(2, 4, 3, padding=1)(
+            paddle.to_tensor(r(1, 2, 10))).shape == [1, 4, 10]
+        assert nn.Conv3D(1, 2, 3, padding=1)(
+            paddle.to_tensor(r(1, 1, 4, 4, 4))).shape == [1, 2, 4, 4, 4]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([0, 3]))
+        assert out.shape == [2, 4]
+        np.testing.assert_array_equal(out.numpy()[0], np.zeros(4, np.float32))
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(r(4, 3, 5, 5) * 3 + 1)
+        out = bn(x)
+        # train mode: output normalized per-batch
+        np.testing.assert_allclose(out.numpy().mean(axis=(0, 2, 3)),
+                                   np.zeros(3), atol=1e-4)
+        # running stats updated
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm_matches_numpy(self):
+        ln = nn.LayerNorm(8)
+        x = r(2, 8)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sig = x.var(-1, keepdims=True)
+        expect = (x - mu) / np.sqrt(sig + 1e-5)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_groupnorm_instancenorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.to_tensor(r(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+        inorm = nn.InstanceNorm2D(4)
+        assert inorm(paddle.to_tensor(r(2, 4, 3, 3))).shape == [2, 4, 3, 3]
+
+    def test_rmsnorm(self):
+        rms = nn.RMSNorm(8)
+        x = r(2, 8)
+        out = rms(paddle.to_tensor(x)).numpy()
+        expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestPooling:
+    def test_maxpool_avgpool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        np.testing.assert_array_equal(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_pool(self):
+        x = paddle.to_tensor(r(2, 3, 8, 8))
+        out = nn.AdaptiveAvgPool2D(1)(x)
+        assert out.shape == [2, 3, 1, 1]
+        np.testing.assert_allclose(out.numpy()[..., 0, 0],
+                                   x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+        out2 = nn.AdaptiveAvgPool2D(3)(x)  # 8 not divisible by 3
+        assert out2.shape == [2, 3, 3, 3]
+
+    def test_pool_grad(self):
+        x = paddle.to_tensor(r(1, 2, 4, 4))
+        x.stop_gradient = False
+        F.max_pool2d(x, 2, 2).sum().backward()
+        assert x.grad.shape == [1, 2, 4, 4]
+
+
+class TestActivationsLosses:
+    def test_activations(self):
+        x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy(), np.exp(x) / np.exp(x).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.leaky_relu(t).numpy(), np.where(x > 0, x, 0.01 * x), rtol=1e-5)
+        assert F.gelu(t).shape == [5]
+        assert F.silu(t).shape == [5]
+
+    def test_cross_entropy_matches_numpy(self):
+        logits = r(4, 5)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels)).item()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        assert abs(loss - expect) < 1e-5
+
+    def test_cross_entropy_ignore_index(self):
+        logits = r(4, 5)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels),
+                               ignore_index=-100).item()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[[0, 2], [0, 1]]).mean()
+        assert abs(loss - expect) < 1e-5
+
+    def test_cross_entropy_soft_label(self):
+        logits = r(3, 4)
+        soft = np.full((3, 4), 0.25, np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        assert loss.shape == []
+
+    def test_mse_l1_bce(self):
+        a, b = r(3, 4), r(3, 4)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            np.abs(a - b).mean(), rtol=1e-5)
+        lab = (r(3, 4) > 0.5).astype(np.float32)
+        bce = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(a), paddle.to_tensor(lab)).item()
+        expect = (np.maximum(a, 0) - a * lab + np.log1p(np.exp(-np.abs(a)))).mean()
+        assert abs(bce - expect) < 1e-5
+
+    def test_loss_layers(self):
+        loss = nn.CrossEntropyLoss()
+        out = loss(paddle.to_tensor(r(2, 3)), paddle.to_tensor([0, 1]))
+        assert out.shape == []
+
+
+class TestDropoutInterp:
+    def test_dropout_train_eval(self):
+        x = paddle.ones([100, 100])
+        out = F.dropout(x, 0.5, training=True)
+        frac = (out.numpy() == 0).mean()
+        assert 0.3 < frac < 0.7
+        out_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_array_equal(out_eval.numpy(), x.numpy())
+
+    def test_interpolate(self):
+        x = paddle.to_tensor(r(1, 2, 4, 4))
+        assert F.interpolate(x, scale_factor=2, mode="nearest").shape == \
+            [1, 2, 8, 8]
+        assert F.interpolate(x, size=[6, 6], mode="bilinear").shape == \
+            [1, 2, 6, 6]
+
+    def test_pixel_shuffle(self):
+        x = paddle.to_tensor(r(1, 8, 2, 2))
+        assert F.pixel_shuffle(x, 2).shape == [1, 2, 4, 4]
+
+
+class TestAttentionTransformer:
+    def test_mha_forward(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        q = paddle.to_tensor(r(2, 5, 16))
+        out = mha(q)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_grad(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        q = paddle.to_tensor(r(1, 3, 8))
+        mha(q).sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.to_tensor(r(2, 6, 16)))
+        assert out.shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.to_tensor(r(2, 4, 16))
+        tgt = paddle.to_tensor(r(2, 3, 16))
+        assert model(src, tgt).shape == [2, 3, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        out, (h, c) = lstm(paddle.to_tensor(r(2, 5, 4)))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8]
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(paddle.to_tensor(r(2, 5, 4)))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        out, (h, c) = cell(paddle.to_tensor(r(2, 4)))
+        assert out.shape == [2, 8]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(3, 4)
+        out, _ = lstm(paddle.to_tensor(r(2, 5, 3)))
+        out.sum().backward()
+        assert lstm._parameters["weight_ih_l0"].grad is not None
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        g1 = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        p1 = paddle.Parameter(np.zeros(2, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, g1)])
+        np.testing.assert_allclose(np.linalg.norm(out[0][1].numpy()), 1.0,
+                                   rtol=1e-5)
+
+    def test_clip_by_value(self):
+        g = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32))
+        p = paddle.Parameter(np.zeros(3, np.float32))
+        out = nn.ClipGradByValue(1.0)([(p, g)])
+        np.testing.assert_array_equal(out[0][1].numpy(), [-1, 0.5, 1])
+
+
+class TestInitializers:
+    def test_constant_normal_uniform(self):
+        from paddle_tpu.nn import initializer as init
+
+        lin = nn.Linear(100, 100,
+                        weight_attr=nn.ParamAttr(initializer=init.Normal(0, 0.02)))
+        assert abs(lin.weight.numpy().std() - 0.02) < 0.005
+        lin2 = nn.Linear(10, 10,
+                         weight_attr=nn.ParamAttr(initializer=init.Constant(3.0)))
+        assert (lin2.weight.numpy() == 3.0).all()
+
+    def test_kaiming_xavier(self):
+        from paddle_tpu.nn import initializer as init
+
+        for cls in (init.XavierNormal, init.XavierUniform, init.KaimingNormal,
+                    init.KaimingUniform):
+            lin = nn.Linear(64, 64, weight_attr=nn.ParamAttr(initializer=cls()))
+            assert np.isfinite(lin.weight.numpy()).all()

@@ -85,7 +85,8 @@ class TestIndexing:
         assert x.numpy()[1, 1] == 5
 
     def test_setitem_grad_flows(self):
-        x = paddle.ones([3], stop_gradient=False)
+        x = paddle.ones([3])
+        x.stop_gradient = False
         y = x * 2.0
         y[0] = 0.0
         y.sum().backward()
