@@ -39,6 +39,11 @@ from .ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
 # --- subsystems (grown as they land; see SURVEY.md §7 layer order) --------
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
+from . import nn  # noqa: F401
+from .nn.layer.layers import Layer, ParamAttr  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import kernels  # noqa: F401
 
 # paddle.linalg namespace is the ops.linalg module re-exported
 from .ops import linalg  # noqa: F401

@@ -1,0 +1,191 @@
+"""Automatic mixed precision (reference: python/paddle/amp/auto_cast.py:21,
+grad_scaler.py:26, fluid/dygraph/amp/loss_scaler.py:40).
+
+TPU-native stance: bf16 is the native half type — it shares the f32 exponent
+range, so dynamic loss scaling is numerically unnecessary.  The full
+GradScaler API is kept for parity (and for fp16 use), implementing the
+reference's dynamic scale / inf-check / skip-step state machine
+(check_finite_and_unscale + update_loss_scaling ops).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_np
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "is_auto_cast_enabled", "get_amp_dtype",
+           "white_list", "black_list"]
+
+# O1 lists (reference: fluid/dygraph/amp/auto_cast.py WHITE_LIST/BLACK_LIST)
+white_list = {"matmul", "bmm", "mm", "linear", "conv1d", "conv2d", "conv3d",
+              "einsum", "scaled_dot_product_attention"}
+black_list = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
+              "mean", "sum", "norm", "cumsum", "logsumexp", "erfinv",
+              "layer_norm", "batch_norm"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype if _state.enabled else None
+
+
+def amp_op_dtype(op_name: str):
+    """Consulted by dispatch for O1 cast decisions."""
+    if not _state.enabled:
+        return None
+    if _state.level == "O2":
+        return _state.dtype
+    wl = (white_list | _state.custom_white) - _state.custom_black
+    bl = black_list | _state.custom_black
+    if op_name in wl:
+        return _state.dtype
+    if op_name in bl:
+        return "float32"
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to half dtype (reference amp.decorate)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._convert_dtype(dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference AmpScaler loss_scaler.py:40)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        self._unscale(optimizer)
+
+    def _unscale(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+
+        found_inf = False
+        for p, g, _ in optimizer._collect_params_grads():
+            if g is None:
+                continue
+            arr = g._value / self._scale
+            if not bool(jnp.isfinite(arr).all()):
+                found_inf = True
+            g._value = arr
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))

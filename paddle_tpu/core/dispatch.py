@@ -140,13 +140,34 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
         and _differentiable_dtype(flat[i]._value)
     ]
 
+    # AMP O1/O2: per-op cast decision (reference: imperative/tracer.cc:224
+    # AutoCastInputs / amp_auto_cast.cc).  The cast happens inside raw_fn so
+    # the vjp closure differentiates through it.
+    amp_np_dtype = None
+    try:
+        from ..amp import amp_op_dtype
+
+        amp_target = amp_op_dtype(name)
+        if amp_target is not None:
+            from .dtype import to_np
+
+            amp_np_dtype = to_np(amp_target)
+    except ImportError:  # during early package import
+        pass
+
+    def _amp_cast(v):
+        if amp_np_dtype is not None and jnp.issubdtype(
+                jnp.result_type(v), jnp.floating):
+            return v.astype(amp_np_dtype)
+        return v
+
     def raw_fn(*diff_vals):
         new_flat = list(flat)
         for pos, v in zip(diff_idx, diff_vals):
-            new_flat[pos] = v
+            new_flat[pos] = _amp_cast(v)
         for i in tensor_idx:
             if i not in diff_idx:
-                new_flat[i] = new_flat[i]._value
+                new_flat[i] = _amp_cast(new_flat[i]._value)
         new_args = jax.tree_util.tree_unflatten(treedef, new_flat)
         return fn(*new_args, **attrs)
 

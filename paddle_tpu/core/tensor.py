@@ -266,7 +266,8 @@ def _convert_data(data, dtype=None):
             pass
         return jnp.asarray(data, dtype=to_np(dtype) if dtype else None)
     if isinstance(data, (int, np.integer)):
-        return jnp.asarray(data, dtype=to_np(dtype) if dtype else jnp.int64)
+        # paddle defaults python ints to int64; int32 is the TPU-native width
+        return jnp.asarray(data, dtype=to_np(dtype) if dtype else jnp.int32)
     if isinstance(data, (float, np.floating)):
         return jnp.asarray(data, dtype=to_np(dtype) if dtype else to_np(get_default_dtype()))
     if isinstance(data, (bool, np.bool_)):

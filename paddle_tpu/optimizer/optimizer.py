@@ -1,0 +1,428 @@
+"""Optimizer base + concrete optimizers.
+
+Capability analog of the reference optimizer stack
+(/root/reference/python/paddle/optimizer/optimizer.py: _create_accumulators,
+_append_optimize_op; the reference implements each update as a CUDA op in
+paddle/fluid/operators/optimizers/).  Here each update rule is ONE jitted
+functional XLA computation per (shape, dtype) — donated buffers, fused
+multiply-adds, no per-element Python.  Under jit.to_static the same rules
+inline into the whole-step program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad_ctx
+from ..core.tensor import Parameter, Tensor
+
+
+class LRSchedulerRef:
+    pass
+
+
+def _get_lr_value(lr):
+    from .lr import LRScheduler
+
+    if isinstance(lr, LRScheduler):
+        return lr()
+    return float(lr)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._name = name
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (float, int)):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:  # L2Decay-like object with a coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        # per-parameter accumulator slots: name -> {id(param): jnp array}
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------ accumulators
+    def _add_accumulator(self, name, param, fill=0.0, dtype=None, shape=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(param) not in store:
+            shp = tuple(shape) if shape is not None else tuple(param.shape)
+            dt = dtype or (jnp.float32 if self._multi_precision
+                           else param._value.dtype)
+            store[id(param)] = jnp.full(shp, fill, dt)
+        return store[id(param)]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][id(param)]
+
+    def _set_accumulator(self, name, param, value):
+        self._accumulators[name][id(param)] = value
+
+    # ---------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        return _get_lr_value(self._learning_rate)
+
+    def set_lr(self, value: float):
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        from .lr import LRScheduler
+
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # ---------------------------------------------------------------- step
+    def _collect_params_grads(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("Optimizer created without parameters")
+        out = []
+        for p in params:
+            if isinstance(p, dict):
+                # parameter group dict {'params': [...], 'learning_rate'/'weight_decay': ...}
+                for q in p["params"]:
+                    out.append((q, q.grad, p))
+            else:
+                out.append((p, p.grad, None))
+        return out
+
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        with no_grad_ctx():
+            params_grads = [(p, g) for p, g, _grp in self._collect_params_grads()
+                            if g is not None and getattr(p, "trainable", True)]
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr = self.get_lr()
+            for p, g in params_grads:
+                self._update_param(p, g._value if isinstance(g, Tensor) else g,
+                                   lr)
+        self._step_count += 1
+
+    def _update_param(self, param, grad, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p, _, _ in self._collect_params_grads():
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ---------------------------------------------------------------- state
+    def state_dict(self):
+        state = {}
+        params = {id(p): name_i for name_i, (p, _, _) in
+                  enumerate(self._collect_params_grads())}
+        for acc_name, store in self._accumulators.items():
+            for pid, arr in store.items():
+                state[f"{acc_name}_{params.get(pid, pid)}"] = Tensor(arr)
+        state["@step"] = self._step_count
+        if self._lr_scheduler is not None:
+            state["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("@step", 0))
+        params = {name_i: p for name_i, (p, _, _) in
+                  enumerate(self._collect_params_grads())}
+        for acc_name, store in self._accumulators.items():
+            pass
+        for key, value in state.items():
+            if key in ("@step",):
+                continue
+            if key == "LR_Scheduler" and self._lr_scheduler is not None:
+                self._lr_scheduler.set_state_dict(value)
+                continue
+            name, _, idx = key.rpartition("_")
+            try:
+                p = params[int(idx)]
+            except (ValueError, KeyError):
+                continue
+            arr = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+            self._accumulators.setdefault(name, {})[id(p)] = arr
+
+
+# --------------------------------------------------------------------- rules
+# Jitted update rules (module-level so jax caches one executable per shape).
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_rule(p, g, lr, wd):
+    g = g + wd * p
+    return (p - lr * g.astype(p.dtype)).astype(p.dtype)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("use_nesterov",))
+def _momentum_rule(p, vel, g, lr, mu, wd, use_nesterov=False):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    vel = mu * vel + g
+    if use_nesterov:
+        upd = g + mu * vel
+    else:
+        upd = vel
+    return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), vel
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adam_rule(p, m, v, g, lr, beta1, beta2, eps, step, wd_l2):
+    g = g.astype(jnp.float32)
+    if wd_l2 is not None:
+        g = g + wd_l2 * p.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** step)
+    vhat = v / (1 - beta2 ** step)
+    new_p = p.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p.astype(p.dtype), m, v
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adamw_rule(p, m, v, g, lr, beta1, beta2, eps, step, wd):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    pf = pf * (1.0 - lr * wd)  # decoupled decay
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** step)
+    vhat = v / (1 - beta2 ** step)
+    new_p = pf - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p.astype(p.dtype), m, v
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adagrad_rule(p, moment, g, lr, eps, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    moment = moment + jnp.square(g)
+    new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(moment) + eps)
+    return new_p.astype(p.dtype), moment
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adadelta_rule(p, avg_sq_grad, avg_sq_update, g, lr, rho, eps, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    avg_sq_grad = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(avg_sq_grad + eps) * g
+    avg_sq_update = rho * avg_sq_update + (1 - rho) * jnp.square(update)
+    return (p.astype(jnp.float32) - lr * update).astype(p.dtype), \
+        avg_sq_grad, avg_sq_update
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("centered",))
+def _rmsprop_rule(p, mean_sq, mom, g, lr, rho, eps, momentum, wd, mean_g,
+                  centered=False):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    mean_sq = rho * mean_sq + (1 - rho) * jnp.square(g)
+    if centered:
+        mean_g = rho * mean_g + (1 - rho) * g
+        denom = jnp.sqrt(mean_sq - jnp.square(mean_g) + eps)
+    else:
+        denom = jnp.sqrt(mean_sq + eps)
+    mom = momentum * mom + lr * g / denom
+    return (p.astype(jnp.float32) - mom).astype(p.dtype), mean_sq, mom, mean_g
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _adamax_rule(p, m, u, g, lr, beta1, beta2, eps, step, wd):
+    g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    u = jnp.maximum(beta2 * u, jnp.abs(g))
+    new_p = p.astype(jnp.float32) - lr / (1 - beta1 ** step) * m / (u + eps)
+    return new_p.astype(p.dtype), m, u
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _lamb_rule(p, m, v, g, lr, beta1, beta2, eps, step, wd):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** step)
+    vhat = v / (1 - beta2 ** step)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+    p_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return (pf - lr * trust * r).astype(p.dtype), m, v
+
+
+# ------------------------------------------------------------------ classes
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update_param(self, p, g, lr):
+        p._value = _sgd_rule(p._value, g, lr, self._weight_decay)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr):
+        vel = self._add_accumulator("velocity", p, dtype=jnp.float32)
+        p._value, vel = _momentum_rule(p._value, vel, g, lr, self._momentum,
+                                       self._weight_decay,
+                                       use_nesterov=self._use_nesterov)
+        self._set_accumulator("velocity", p, vel)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        m = self._add_accumulator("moment1", p, dtype=jnp.float32)
+        v = self._add_accumulator("moment2", p, dtype=jnp.float32)
+        wd = self._weight_decay if self._weight_decay else None
+        p._value, m, v = _adam_rule(p._value, m, v, g, lr, self._beta1,
+                                    self._beta2, self._epsilon,
+                                    self._step_count + 1, wd)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+
+
+class AdamW(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update_param(self, p, g, lr):
+        m = self._add_accumulator("moment1", p, dtype=jnp.float32)
+        v = self._add_accumulator("moment2", p, dtype=jnp.float32)
+        wd = self._weight_decay
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(getattr(p, "name", None) or ""):
+            wd = 0.0
+        p._value, m, v = _adamw_rule(p._value, m, v, g, lr, self._beta1,
+                                     self._beta2, self._epsilon,
+                                     self._step_count + 1, wd)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _update_param(self, p, g, lr):
+        mom = self._add_accumulator("moment", p, fill=self._initial,
+                                    dtype=jnp.float32)
+        p._value, mom = _adagrad_rule(p._value, mom, g, lr, self._epsilon,
+                                      self._weight_decay)
+        self._set_accumulator("moment", p, mom)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, g, lr):
+        asg = self._add_accumulator("avg_squared_grad", p, dtype=jnp.float32)
+        asu = self._add_accumulator("avg_squared_update", p, dtype=jnp.float32)
+        p._value, asg, asu = _adadelta_rule(p._value, asg, asu, g, lr,
+                                            self._rho, self._epsilon,
+                                            self._weight_decay)
+        self._set_accumulator("avg_squared_grad", p, asg)
+        self._set_accumulator("avg_squared_update", p, asu)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g, lr):
+        ms = self._add_accumulator("mean_square", p, dtype=jnp.float32)
+        mom = self._add_accumulator("momentum", p, dtype=jnp.float32)
+        mg = self._add_accumulator("mean_grad", p, dtype=jnp.float32)
+        p._value, ms, mom, mg = _rmsprop_rule(
+            p._value, ms, mom, g, lr, self._rho, self._epsilon, self._momentum,
+            self._weight_decay, mg, centered=self._centered)
+        self._set_accumulator("mean_square", p, ms)
+        self._set_accumulator("momentum", p, mom)
+        self._set_accumulator("mean_grad", p, mg)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        m = self._add_accumulator("moment", p, dtype=jnp.float32)
+        u = self._add_accumulator("inf_norm", p, dtype=jnp.float32)
+        p._value, m, u = _adamax_rule(p._value, m, u, g, lr, self._beta1,
+                                      self._beta2, self._epsilon,
+                                      self._step_count + 1,
+                                      self._weight_decay)
+        self._set_accumulator("moment", p, m)
+        self._set_accumulator("inf_norm", p, u)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr):
+        m = self._add_accumulator("moment1", p, dtype=jnp.float32)
+        v = self._add_accumulator("moment2", p, dtype=jnp.float32)
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        p._value, m, v = _lamb_rule(p._value, m, v, g, lr, self._beta1,
+                                    self._beta2, self._epsilon,
+                                    self._step_count + 1, wd)
+        self._set_accumulator("moment1", p, m)
+        self._set_accumulator("moment2", p, v)

@@ -1,0 +1,225 @@
+"""Optimizers, LR schedulers, grad clip integration, AMP."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
+                                  Lamb, Momentum, RMSProp)
+from paddle_tpu.optimizer import lr as lr_sched
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def quadratic_setup():
+    """min ||w - target||^2 via the optimizer."""
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.Parameter(np.zeros(3, np.float32))
+    return w, target
+
+
+def run_steps(opt_cls, n=300, lr=0.1, **kwargs):
+    w, target = quadratic_setup()
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kwargs)
+    for _ in range(n):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+class TestConvergence:
+    @pytest.mark.parametrize("opt_cls,kw", [
+        (SGD, {}),
+        (Momentum, {"momentum": 0.9}),
+        (Adam, {}),
+        (AdamW, {"weight_decay": 0.0}),
+        (RMSProp, {}),
+        (Adamax, {}),
+    ])
+    def test_converges(self, opt_cls, kw):
+        w, target = run_steps(opt_cls, **kw)
+        np.testing.assert_allclose(w, target, atol=0.05)
+
+    def test_lamb_converges(self):
+        # lamb's trust ratio scales steps by ||w||; needs a smaller lr here
+        w, target = run_steps(Lamb, n=800, lr=0.01, lamb_weight_decay=0.0)
+        np.testing.assert_allclose(w, target, atol=0.1)
+
+    def test_adagrad_adadelta_steps(self):
+        w, target = run_steps(Adagrad, n=500, lr=0.5)
+        np.testing.assert_allclose(w, target, atol=0.2)
+        # adadelta is slow by design; just check movement + finiteness
+        w2, _ = run_steps(Adadelta, n=100, lr=1.0)
+        assert np.isfinite(w2).all() and np.abs(w2).sum() > 0
+
+
+class TestAdamMatchesNumpy:
+    def test_adam_step_exact(self):
+        w0 = r(4)
+        g = r(4)
+        p = paddle.Parameter(w0.copy())
+        opt = Adam(learning_rate=0.01, parameters=[p])
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        # numpy adam, step 1
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        expect = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+    def test_adamw_decoupled_decay(self):
+        w0 = np.ones(3, np.float32)
+        p = paddle.Parameter(w0.copy())
+        opt = AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        p.grad = paddle.to_tensor(np.zeros(3, np.float32))
+        opt.step()
+        # zero grad → only decay: w *= (1 - lr*wd)
+        np.testing.assert_allclose(p.numpy(), w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+
+
+class TestOptimizerAPI:
+    def test_clear_grad(self):
+        p = paddle.Parameter(r(3))
+        opt = SGD(0.1, parameters=[p])
+        p.grad = paddle.to_tensor(r(3))
+        opt.clear_grad()
+        assert p.grad is None
+
+    def test_minimize(self):
+        p = paddle.Parameter(np.array([2.0], np.float32))
+        opt = SGD(0.5, parameters=[p])
+        loss = (p * p).sum()
+        opt.minimize(loss)
+        np.testing.assert_allclose(p.numpy(), [2.0 - 0.5 * 4.0])
+
+    def test_state_dict_roundtrip(self):
+        p = paddle.Parameter(r(3))
+        opt = Adam(0.01, parameters=[p])
+        p.grad = paddle.to_tensor(r(3))
+        opt.step()
+        sd = opt.state_dict()
+        p2 = paddle.Parameter(r(3))
+        opt2 = Adam(0.01, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+
+    def test_grad_clip_integration(self):
+        p = paddle.Parameter(np.zeros(2, np.float32))
+        opt = SGD(1.0, parameters=[p],
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        p.grad = paddle.to_tensor(np.array([30.0, 40.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
+
+    def test_lr_scheduler_integration(self):
+        sched = lr_sched.StepDecay(0.1, step_size=2, gamma=0.5)
+        p = paddle.Parameter(r(2))
+        opt = SGD(sched, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_sched.StepDecay(1.0, step_size=3, gamma=0.1)
+        lrs = [s()]
+        for _ in range(6):
+            s.step()
+            lrs.append(s())
+        assert lrs[0] == 1.0 and abs(lrs[3] - 0.1) < 1e-9
+
+    def test_cosine(self):
+        s = lr_sched.CosineAnnealingDecay(1.0, T_max=10)
+        s.step(10)
+        assert s() == pytest.approx(0.0, abs=1e-9)
+
+    def test_linear_warmup(self):
+        s = lr_sched.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                  end_lr=0.1)
+        s.step(5)
+        assert s() == pytest.approx(0.05)
+        s.step(20)
+        assert s() == pytest.approx(0.1)
+
+    def test_warmup_cosine(self):
+        s = lr_sched.WarmupCosine(1.0, warmup_steps=10, total_steps=110,
+                                  min_ratio=0.1)
+        s.step(10)
+        assert s() == pytest.approx(1.0)
+        s.step(110)
+        assert s() == pytest.approx(0.1)
+
+    def test_piecewise_polynomial_noam(self):
+        s = lr_sched.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1])
+        s.step(4)
+        assert s() == 0.5
+        s2 = lr_sched.PolynomialDecay(1.0, decay_steps=10, end_lr=0.0)
+        s2.step(5)
+        assert s2() == pytest.approx(0.5)
+        s3 = lr_sched.NoamDecay(d_model=512, warmup_steps=100)
+        assert s3() > 0
+
+    def test_reduce_on_plateau(self):
+        s = lr_sched.ReduceOnPlateau(1.0, patience=1, factor=0.1)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s() == pytest.approx(0.1)
+
+
+class TestAMP:
+    def test_auto_cast_o1(self):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            x = paddle.ones([4, 4])
+            y = paddle.matmul(x, x)
+            assert y.dtype == paddle.bfloat16
+            # blacklisted op stays f32
+            z = paddle.sum(x)
+            assert z.dtype == paddle.float32
+
+    def test_auto_cast_disabled_outside(self):
+        x = paddle.ones([2, 2])
+        assert paddle.matmul(x, x).dtype == paddle.float32
+
+    def test_grad_scaler_scale_unscale(self):
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = SGD(0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (p * 2.0).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        np.testing.assert_allclose(p.grad.numpy(), [8.0, 8.0])
+        scaler.step(opt)
+        # after unscale: grad 2.0, sgd step 0.1 → 1 - 0.2
+        np.testing.assert_allclose(p.numpy(), [0.8, 0.8], rtol=1e-6)
+
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.Parameter(np.ones(1, np.float32))
+        opt = SGD(0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       decr_every_n_nan_or_inf=1)
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+        assert scaler.get_init_loss_scaling() == pytest.approx(2.0)
+
+    def test_amp_training_loop(self):
+        net = nn.Linear(4, 4)
+        opt = Adam(0.01, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler()
+        x = paddle.to_tensor(r(2, 4))
+        for _ in range(3):
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                loss = net(x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            opt.clear_grad()
+        assert np.isfinite(net.weight.numpy()).all()
