@@ -44,6 +44,10 @@ from .nn.layer.layers import Layer, ParamAttr  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import amp  # noqa: F401
 from . import kernels  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import load, save  # noqa: F401
 
 # paddle.linalg namespace is the ops.linalg module re-exported
 from .ops import linalg  # noqa: F401

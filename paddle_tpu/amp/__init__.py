@@ -134,14 +134,18 @@ class GradScaler:
     def _unscale(self, optimizer):
         if not self._enable:
             return
-        import numpy as np
+        from ..core.dispatch import in_static_trace
 
+        traced = in_static_trace()
         found_inf = False
         for p, g, _ in optimizer._collect_params_grads():
             if g is None:
                 continue
             arr = g._value / self._scale
-            if not bool(jnp.isfinite(arr).all()):
+            if not traced and not bool(jnp.isfinite(arr).all()):
+                # eager: host-side inf check drives the skip/update machine.
+                # Under to_static (bf16-first) the check is skipped — bf16
+                # shares the f32 exponent range so scaling is a no-op there.
                 found_inf = True
             g._value = arr
         self._found_inf = found_inf

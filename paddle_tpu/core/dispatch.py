@@ -35,7 +35,11 @@ _state = _State()
 
 
 def is_grad_enabled() -> bool:
-    return _state.grad_enabled and not _state.in_static_trace
+    # NB: the tape keeps recording inside to_static traces — jax.vjp over
+    # tracers is what lets loss.backward() + optimizer.step() compile into
+    # the one traced program.  in_static_trace only gates data-dependent-shape
+    # ops (nonzero/unique/...), which must raise under a trace.
+    return _state.grad_enabled
 
 
 def set_grad_enabled(mode: bool):

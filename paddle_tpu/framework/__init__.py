@@ -1,0 +1,6 @@
+"""paddle.framework namespace parity."""
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401
+from .io import load, save  # noqa: F401
+from ..ops.random import seed  # noqa: F401
+from .random import get_rng_state, set_rng_state  # noqa: F401

@@ -1,0 +1,61 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:572,788).
+
+Pickles nested state structures with tensors converted to numpy, protocol 4
+chunking like the reference.  Async sharded distributed checkpoints live in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+def _tree_to_numpy(obj: Any):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_tree_to_numpy(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+class _TensorPayload:
+    """Marks arrays that were Tensors so load() can rewrap them."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = np.asarray(array)
+
+
+def _tree_from_numpy(obj: Any, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        return obj.array if return_numpy else Tensor(jnp.asarray(obj.array))
+    if isinstance(obj, dict):
+        return {k: _tree_from_numpy(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_tree_from_numpy(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_tree_to_numpy(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _tree_from_numpy(data, return_numpy)

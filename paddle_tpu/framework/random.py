@@ -1,0 +1,2 @@
+"""RNG state helpers (reference: python/paddle/framework/random.py)."""
+from ..ops.random import get_rng_state, set_rng_state, seed  # noqa: F401

@@ -1,0 +1,482 @@
+"""paddle.jit analog: compile eager code to one XLA executable.
+
+Replaces the reference dy2static stack
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py ProgramTranslator, ConcreteProgram input-spec cache,
+partial_program.py) the TPU-native way: instead of AST-rewriting Python into
+a ProgramDesc, the function is traced with JAX abstract values straight to
+StableHLO and compiled by XLA.
+
+What the trace captures as *program state* (inputs AND outputs):
+  - every Parameter of the layers involved (so weight updates inside the
+    traced fn — optimizer.step() — become functional outputs)
+  - every Layer buffer (BN running stats etc.)
+  - optimizer accumulator slots + device step counter
+  - the RNG key (dropout draws fold_in from a per-call key input)
+  - each optimizer's learning rate (a dynamic scalar input, so LR schedules
+    don't retrace)
+
+The eager tape keeps working inside the trace (jax.vjp over tracers), so a
+whole train_step — forward, loss.backward(), optimizer.step() — compiles to
+one fused XLA program.  Data-dependent Python control flow must use
+paddle_tpu.jit.cond/while_loop/scan (→ XLA control flow), matching the
+reference's static control-flow ops (fluid/layers/control_flow.py While:1024).
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import to_np
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops import random as rnd
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "save", "load", "cond",
+           "while_loop", "scan", "StaticFunction"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec analog."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _is_arrayish(v):
+    return isinstance(v, (jnp.ndarray, np.ndarray)) or (
+        hasattr(v, "aval") and hasattr(v, "dtype"))
+
+
+def _referenced_objects(obj):
+    """Objects a function can reach: bound self, closure cells, and the
+    module globals its code names.  This is how the trace discovers which
+    Layers/Optimizers hold state (the reference discovers them through
+    ProgramTranslator's parameter recorder)."""
+    out = []
+    bound_self = getattr(obj, "__self__", None)
+    if bound_self is not None:
+        out.append(bound_self)
+    fn = getattr(obj, "__func__", obj)
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        g = getattr(fn, "__globals__", {})
+        for name in code.co_names:
+            if name in g:
+                out.append(g[name])
+        for cell in (fn.__closure__ or ()):
+            try:
+                out.append(cell.cell_contents)
+            except ValueError:
+                pass
+    for d in (getattr(fn, "__defaults__", None) or ()):
+        out.append(d)
+    return out
+
+
+def _flatten_candidates(objs):
+    flat = []
+    for v in objs:
+        flat.append(v)
+        if isinstance(v, (list, tuple)):
+            flat.extend(v)
+        elif isinstance(v, dict):
+            flat.extend(v.values())
+    return flat
+
+
+def _find_layers(obj, seen=None) -> List[Layer]:
+    seen = seen if seen is not None else set()
+    out = []
+    if isinstance(obj, Layer):
+        if id(obj) not in seen:
+            seen.add(id(obj))
+            out.append(obj)
+        return out
+    for v in _flatten_candidates(_referenced_objects(obj)):
+        if isinstance(v, Layer) and id(v) not in seen:
+            seen.add(id(v))
+            out.append(v)
+    return out
+
+
+def _find_optimizers(obj) -> list:
+    from ..optimizer.optimizer import Optimizer
+
+    out = []
+    seen = set()
+    for v in _flatten_candidates(_referenced_objects(obj)):
+        if isinstance(v, Optimizer) and id(v) not in seen:
+            seen.add(id(v))
+            out.append(v)
+    return out
+
+
+class _State:
+    """Handles to every mutable array a trace must thread through."""
+
+    def __init__(self, layers, optimizers):
+        self.params: List[Tensor] = []
+        self.buffers: List[Tensor] = []
+        seen = set()
+        for layer in layers:
+            for _, p in layer.named_parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    self.params.append(p)
+            for _, b in layer.named_buffers():
+                if id(b) not in seen:
+                    seen.add(id(b))
+                    self.buffers.append(b)
+        self.optimizers = list(optimizers)
+
+    def opt_slots(self):
+        slots = []
+        for opt in self.optimizers:
+            for name in sorted(opt._accumulators):
+                store = opt._accumulators[name]
+                for pid in sorted(store):
+                    slots.append((store, pid))
+            for key in sorted(opt._global_state):
+                slots.append((opt._global_state, key))
+        return slots
+
+    def read(self):
+        return ([p._value for p in self.params]
+                + [b._value for b in self.buffers]
+                + [store[k] for store, k in self.opt_slots()])
+
+    def write(self, vals, slots=None):
+        n_p, n_b = len(self.params), len(self.buffers)
+        for p, v in zip(self.params, vals[:n_p]):
+            p._value = v
+            p.grad = None
+            p._grad_node = None
+        for b, v in zip(self.buffers, vals[n_p:n_p + n_b]):
+            b._value = v
+        slots = slots if slots is not None else self.opt_slots()
+        for (store, k), v in zip(slots, vals[n_p + n_b:]):
+            store[k] = v
+
+    def signature(self):
+        return (len(self.params), len(self.buffers),
+                tuple((id(s), k) for s, k in self.opt_slots()))
+
+
+def _spec_key(flat_static, treedef, dyn_leaves):
+    dyn = tuple((tuple(v.shape), str(v.dtype)) for v in dyn_leaves)
+    stat = tuple(
+        v if isinstance(v, (int, float, bool, str, bytes, type(None)))
+        else repr(v) for v in flat_static)
+    return (dyn, stat, str(treedef))
+
+
+class StaticFunction:
+    """Compiled callable with an input-spec cache (the ConcreteProgram cache
+    analog, reference: program_translator.py)."""
+
+    def __init__(self, fn, input_spec=None, **unused):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}
+        self._bound_cache: Dict[int, "StaticFunction"] = {}
+        self._layers = None
+        self._optimizers = None
+        functools.update_wrapper(self, fn, updated=[])
+
+    def __get__(self, instance, owner=None):
+        if instance is None:
+            return self
+        bound = self._bound_cache.get(id(instance))
+        if bound is None:
+            bound = StaticFunction(self._fn.__get__(instance, owner),
+                                   self._input_spec)
+            self._bound_cache[id(instance)] = bound
+        return bound
+
+    def _discover(self, args, kwargs):
+        layers = _find_layers(self._fn)
+        opts = _find_optimizers(self._fn)
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, Layer):
+                for l in _find_layers(a):
+                    if all(l is not x for x in layers):
+                        layers.append(l)
+        self._layers = layers
+        self._optimizers = opts
+
+    def __call__(self, *args, **kwargs):
+        if self._layers is None:
+            self._discover(args, kwargs)
+        state = _State(self._layers, self._optimizers)
+
+        raw_tree = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, (args, kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        flat, treedef = jax.tree_util.tree_flatten(raw_tree)
+        dyn_idx = [i for i, v in enumerate(flat) if _is_arrayish(v)]
+        dyn_vals = [flat[i] for i in dyn_idx]
+        static_flat = [None if i in dyn_idx else v for i, v in enumerate(flat)]
+
+        key = (_spec_key(static_flat, treedef, dyn_vals), state.signature())
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = _CompiledEntry(self._fn, state, treedef, static_flat,
+                                   tuple(dyn_idx))
+            self._cache[key] = entry
+
+        lrs = jnp.asarray([opt.get_lr() for opt in state.optimizers],
+                          jnp.float32)
+        rng_key = rnd.default_generator().next_key()
+        return entry.run(state, dyn_vals, lrs, rng_key)
+
+    # ----- parity helpers
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+    def rollback(self):
+        return self._fn
+
+
+class _CompiledEntry:
+    def __init__(self, fn, state_example, treedef, static_flat, dyn_idx):
+        self._fn = fn
+        self._treedef = treedef
+        self._static_flat = static_flat
+        self._dyn_idx = dyn_idx
+        self._pre_slot_ids = [(id(s), k) for s, k in state_example.opt_slots()]
+        self._new_slot_handles = []  # [(store, key)] discovered at trace time
+        self._out_template = None
+
+        entry = self
+
+        def jax_fn(state_vals, dyn_vals, lrs, rng_key):
+            state = entry._live_state
+            orig_vals = state.read()
+            pre_slots = state.opt_slots()
+            state.write(state_vals, slots=pre_slots)
+            counter = itertools.count()
+
+            def key_provider():
+                return jax.random.fold_in(rng_key, next(counter))
+
+            prev_provider = rnd.set_trace_key_provider(key_provider)
+            prev_lrs = [opt._learning_rate for opt in state.optimizers]
+            for i, opt in enumerate(state.optimizers):
+                opt._learning_rate = _TracedLR(lrs[i])
+            try:
+                flat2 = list(entry._static_flat)
+                for pos, v in zip(entry._dyn_idx, dyn_vals):
+                    flat2[pos] = Tensor(v, stop_gradient=True)
+                call_args, call_kwargs = jax.tree_util.tree_unflatten(
+                    entry._treedef, flat2)
+                with dispatch.static_trace_guard():
+                    out = entry._fn(*call_args, **call_kwargs)
+
+                post_slots = state.opt_slots()
+                pre_ids = set(entry._pre_slot_ids)
+                known_vals = [s[k] for s, k in post_slots
+                              if (id(s), k) in pre_ids]
+                new_handles = [(s, k) for s, k in post_slots
+                               if (id(s), k) not in pre_ids]
+                new_vals = [s[k] for s, k in new_handles]
+                entry._new_slot_handles = new_handles
+                n_pb = len(state.params) + len(state.buffers)
+                cur = state.read()
+                new_state = cur[:n_pb] + known_vals + new_vals
+
+                out_raw = jax.tree_util.tree_map(
+                    lambda x: x._value if isinstance(x, Tensor) else x, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                entry._out_template = jax.tree_util.tree_structure(
+                    out_raw, is_leaf=lambda x: x is None)
+            finally:
+                rnd.set_trace_key_provider(prev_provider)
+                for opt, prev in zip(state.optimizers, prev_lrs):
+                    opt._learning_rate = prev
+                # restore concrete state so tracers never leak into live objs
+                state.write(orig_vals, slots=pre_slots)
+            return out_raw, new_state
+
+        self._jitted = jax.jit(jax_fn, donate_argnums=(0,))
+
+    def run(self, state, dyn_vals, lrs, rng_key):
+        self._live_state = state
+        n_known = (len(state.params) + len(state.buffers)
+                   + len(self._pre_slot_ids))
+        out_raw, new_state = self._jitted(state.read(), dyn_vals, lrs, rng_key)
+        pre_slots = [(s, k) for s, k in state.opt_slots()
+                     if (id(s), k) in set(self._pre_slot_ids)]
+        state.write(new_state[:n_known], slots=pre_slots)
+        for (store, k), v in zip(self._new_slot_handles, new_state[n_known:]):
+            store[k] = v
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if _is_arrayish(v) else v, out_raw)
+
+
+class _TracedLR(float):
+    """float subclass carrying the traced LR; arithmetic with arrays uses the
+    traced value (optimizer rules receive it as a jit argument)."""
+
+    def __new__(cls, traced):
+        obj = super().__new__(cls, float("nan"))
+        obj.traced = traced
+        return obj
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling a function or Layer to XLA."""
+    if isinstance(function, Layer):
+        function.forward = StaticFunction(function.forward, input_spec)
+        return function
+    if function is not None:
+        return StaticFunction(function, input_spec)
+
+    def deco(fn):
+        return to_static(fn, input_spec, build_strategy, backend, **kwargs)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+# ------------------------------------------------------------- control flow
+def _as_raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if _is_arrayish(v) else v, t)
+
+
+def _unwrap_tree(t):
+    return jax.tree_util.tree_map(
+        _as_raw, t, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def cond(pred, true_fn, false_fn, *operands):
+    """Functional conditional lowered to XLA Cond (reference:
+    fluid/layers/control_flow.py cond)."""
+    raw_ops = _unwrap_tree(operands)
+    out = jax.lax.cond(
+        _as_raw(pred),
+        lambda ops: _unwrap_tree(true_fn(*_wrap_tree(ops))),
+        lambda ops: _unwrap_tree(false_fn(*_wrap_tree(ops))),
+        raw_ops)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """Functional while lowered to XLA While (reference: while_loop:1167)."""
+    raw = tuple(_unwrap_tree(v) for v in loop_vars)
+    out = jax.lax.while_loop(
+        lambda vs: _as_raw(cond_fn(*_wrap_tree(vs))),
+        lambda vs: tuple(_unwrap_tree(body_fn(*_wrap_tree(vs)))),
+        raw)
+    return _wrap_tree(out)
+
+
+def scan(f, init, xs):
+    """lax.scan with Tensor wrapping; the TPU-idiomatic loop primitive."""
+
+    def body(carry, x):
+        new_c, y = f(_wrap_tree(carry), _wrap_tree(x))
+        return _unwrap_tree(new_c), _unwrap_tree(y)
+
+    carry, ys = jax.lax.scan(body, _unwrap_tree(init), _unwrap_tree(xs))
+    return _wrap_tree(carry), _wrap_tree(ys)
+
+
+# ------------------------------------------------------------- save / load
+def save(layer, path, input_spec=None, **configs):
+    """Export for serving: serialized StableHLO + weights in one artifact
+    (reference: paddle.jit.save → inference program + persistables)."""
+    import pickle
+
+    if isinstance(layer, Layer):
+        layer.eval()
+        fn = layer.forward
+        state = {k: np.asarray(v.numpy())
+                 for k, v in layer.state_dict().items()}
+    else:
+        fn = layer
+        state = {}
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec")
+
+    shapes = [jax.ShapeDtypeStruct(
+        tuple(d if d and d > 0 else 1 for d in spec.shape),
+        to_np(spec.dtype)) for spec in input_spec]
+
+    def pure_fn(*arg_vals):
+        with dispatch.no_grad_ctx(), dispatch.static_trace_guard():
+            args = [Tensor(v) for v in arg_vals]
+            out = fn(*args)
+        return jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    exported = jax.export.export(jax.jit(pure_fn))(*shapes)
+    blob = {
+        "stablehlo": exported.serialize(),
+        "state": state,
+        "input_spec": [(list(s.shape), str(s.dtype)) for s in shapes],
+    }
+    fname = path if path.endswith(".pdmodel") else path + ".pdmodel"
+    with open(fname, "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    return fname
+
+
+class LoadedFunction:
+    """Deserialized serving artifact; __call__ runs the compiled program."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+
+    def __call__(self, *args):
+        raw = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+               for a in args]
+        out = self._exported.call(*raw)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if _is_arrayish(v) else v, out)
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return self._state
+
+
+def load(path, **configs):
+    import pickle
+
+    fname = path if path.endswith(".pdmodel") else path + ".pdmodel"
+    with open(fname, "rb") as f:
+        blob = pickle.load(f)
+    exported = jax.export.deserialize(blob["stablehlo"])
+    return LoadedFunction(exported, blob["state"])

@@ -25,6 +25,8 @@ class LRSchedulerRef:
 
 
 def _get_lr_value(lr):
+    if hasattr(lr, "traced"):  # jit.to_static passes the LR as a traced scalar
+        return lr.traced
     from .lr import LRScheduler
 
     if isinstance(lr, LRScheduler):
@@ -50,6 +52,10 @@ class Optimizer:
         # per-parameter accumulator slots: name -> {id(param): jnp array}
         self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
         self._step_count = 0
+        # step as a device scalar so compiled training steps don't bake it
+        # (jit.to_static captures it as program state)
+        self._global_state: Dict[str, jnp.ndarray] = {
+            "step": jnp.zeros((), jnp.int32)}
 
     # ------------------------------------------------------------ accumulators
     def _add_accumulator(self, name, param, fill=0.0, dtype=None, shape=None):
@@ -108,6 +114,7 @@ class Optimizer:
                 self._update_param(p, g._value if isinstance(g, Tensor) else g,
                                    lr)
         self._step_count += 1
+        self._global_state["step"] = self._global_state["step"] + 1
 
     def _update_param(self, param, grad, lr):
         raise NotImplementedError
@@ -303,7 +310,7 @@ class Adam(Optimizer):
         wd = self._weight_decay if self._weight_decay else None
         p._value, m, v = _adam_rule(p._value, m, v, g, lr, self._beta1,
                                     self._beta2, self._epsilon,
-                                    self._step_count + 1, wd)
+                                    self._global_state["step"] + 1, wd)
         self._set_accumulator("moment1", p, m)
         self._set_accumulator("moment2", p, v)
 
@@ -327,7 +334,7 @@ class AdamW(Optimizer):
             wd = 0.0
         p._value, m, v = _adamw_rule(p._value, m, v, g, lr, self._beta1,
                                      self._beta2, self._epsilon,
-                                     self._step_count + 1, wd)
+                                     self._global_state["step"] + 1, wd)
         self._set_accumulator("moment1", p, m)
         self._set_accumulator("moment2", p, v)
 
@@ -400,7 +407,7 @@ class Adamax(Optimizer):
         u = self._add_accumulator("inf_norm", p, dtype=jnp.float32)
         p._value, m, u = _adamax_rule(p._value, m, u, g, lr, self._beta1,
                                       self._beta2, self._epsilon,
-                                      self._step_count + 1,
+                                      self._global_state["step"] + 1,
                                       self._weight_decay)
         self._set_accumulator("moment", p, m)
         self._set_accumulator("inf_norm", p, u)
@@ -423,6 +430,6 @@ class Lamb(Optimizer):
             wd = 0.0
         p._value, m, v = _lamb_rule(p._value, m, v, g, lr, self._beta1,
                                     self._beta2, self._epsilon,
-                                    self._step_count + 1, wd)
+                                    self._global_state["step"] + 1, wd)
         self._set_accumulator("moment1", p, m)
         self._set_accumulator("moment2", p, v)

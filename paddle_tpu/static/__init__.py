@@ -1,0 +1,44 @@
+"""paddle.static parity surface.
+
+The reference's static graph mode (Program/Executor,
+/root/reference/python/paddle/static) is subsumed by jit.to_static: a traced
+function IS the program, XLA is the executor.  This module keeps the API
+names that still make sense — InputSpec and inference-model save/load — and
+raises clear errors for Program-construction APIs that have no TPU-native
+equivalent.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec, load as _jit_load, save as _jit_save  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit.save(layer, path, input_spec=[...]) — the traced "
+        "StableHLO artifact is the inference model")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _jit_load(path_prefix)
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "No static Program graph: compile functions with "
+            "paddle_tpu.jit.to_static instead")
+
+
+def default_main_program():
+    raise NotImplementedError("no static graph mode; use jit.to_static")
+
+
+def default_startup_program():
+    raise NotImplementedError("no static graph mode; use jit.to_static")
+
+
+def name_scope(name):
+    import contextlib
+
+    return contextlib.nullcontext()
