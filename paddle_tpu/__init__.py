@@ -48,6 +48,14 @@ from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import framework  # noqa: F401
 from .framework.io import load, save  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi.model import Model, summary  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 
 # paddle.linalg namespace is the ops.linalg module re-exported
 from .ops import linalg  # noqa: F401

@@ -1,0 +1,118 @@
+"""paddle.device (reference: python/paddle/device/).
+
+TPU is the accelerator; `cuda` names exist for API compatibility and map to
+the accelerator backend (streams/events are no-ops under the XLA execution
+model, where ordering is program order).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, set_device,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def synchronize(device=None):
+    """Block until all queued device work finishes."""
+    for d in jax.live_arrays() if hasattr(jax, "live_arrays") else []:
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+
+
+class Stream:
+    """API-compat stream object: XLA orders work by program order, so
+    streams are identity contexts (reference: phi stream objects)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+class cuda:
+    """paddle.device.cuda compat namespace."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0

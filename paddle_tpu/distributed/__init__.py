@@ -1,0 +1,45 @@
+"""paddle.distributed — TPU-native distributed training.
+
+The reference builds distribution from NCCL process groups + per-rank OS
+processes (SURVEY.md §2.2).  Here the first-class citizens are the device
+Mesh (jax.sharding) and XLA collectives over ICI; ProcessGroup/collective
+APIs are kept as the compatibility surface and the fleet API drives GSPMD
+sharding instead of manual comm scheduling.
+"""
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, fleet_mesh, get_mesh,
+    init_mesh, ProcessMesh,
+)
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
+    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send,
+    wait, ReduceOp, Group,
+)
+from .parallel import init_parallel_env  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .sharding import shard_tensor, shard_op  # noqa: F401
+
+
+def is_initialized():
+    from .mesh import _GLOBAL_MESH
+
+    return _GLOBAL_MESH is not None
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Multi-process launch helper (reference: distributed/spawn.py).  On TPU
+    a single process drives all local chips via SPMD, so spawn degenerates to
+    a direct call for nprocs<=1 and raises otherwise."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "multi-process spawn is not the TPU execution model; one process "
+        "drives all local chips via the mesh (use paddle_tpu.distributed.launch "
+        "for multi-host)")

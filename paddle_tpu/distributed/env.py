@@ -1,0 +1,60 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+ParallelEnv + fleet role makers reading PADDLE_TRAINER_ID/endpoints).
+
+On TPU, rank/world come from the JAX multi-host runtime (jax.process_index /
+process_count) with PADDLE_* env vars honored for launch-controller parity.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", get_rank()))
+
+    @property
+    def device_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

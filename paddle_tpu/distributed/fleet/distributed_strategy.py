@@ -1,0 +1,63 @@
+"""DistributedStrategy (reference: python/paddle/distributed/fleet/base/
+distributed_strategy.py:109 over distributed_strategy.proto) — one typed
+config tree; the proto becomes a plain dataclass-style object."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (proto: HybridConfig:51)
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
+        }
+        # amp (proto AMPConfig:58)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0, "use_pure_fp16": False,
+            "use_bf16": True, "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute (proto RecomputeConfig:26)
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        # ZeRO sharding (proto ShardingConfig:32)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "sharding_degree": 1, "stage": 1, "offload": False,
+            "segment_broadcast_MB": 32,
+        }
+        # gradient merge (proto:84)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # pipeline (proto PipelineConfig)
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "schedule_mode": "1F1B",
+                                 "micro_batch_size": 1}
+        # misc toggles kept for parity
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.cudnn_exhaustive_search = False
+        self.heter_ccl_mode = False
+        self.a_sync = False
+        self.a_sync_configs: Dict[str, Any] = {}
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.__dict__.items():
+            lines.append(f"  {k}={v},")
+        return "\n".join(lines) + ")"

@@ -1,0 +1,72 @@
+"""init_parallel_env + DataParallel (reference:
+python/paddle/distributed/parallel.py:91, fluid/dygraph/parallel.py).
+
+Under SPMD, DataParallel is free: batch sharded on the dp axis makes XLA
+insert the gradient all-reduce (the reference's Reducer bucketing,
+imperative/reducer.cc, becomes a compiler decision).  The wrapper below keeps
+the reference API: it annotates inputs/parameters and otherwise passes
+through.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import HybridCommunicateGroup, fleet_mesh, get_mesh
+
+
+def init_parallel_env():
+    """Bootstrap the parallel environment.  Multi-host rendezvous (the
+    reference's TCPStore + NCCL-id exchange) is handled by
+    jax.distributed.initialize when PADDLE_TRAINER_ENDPOINTS is set."""
+    import os
+
+    env = ParallelEnv()
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    if eps and len(eps.split(",")) > 1:
+        import jax
+
+        coord = eps.split(",")[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=len(eps.split(",")),
+                process_id=env.rank)
+        except (RuntimeError, ValueError):
+            pass  # already initialized
+    if get_mesh() is None:
+        fleet_mesh(dp_degree=1)
+        HybridCommunicateGroup()
+    return env
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel wrapper: under the mesh, gradients reduce via
+    GSPMD when the step is compiled; the wrapper exists for API parity and
+    eager single-chip correctness (identity)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()

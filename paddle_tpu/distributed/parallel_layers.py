@@ -1,0 +1,175 @@
+"""Tensor-parallel layers.
+
+Reference: Megatron-style mp_layers
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py:30 VocabParallelEmbedding, :97
+ColumnParallelLinear, :170 RowParallelLinear, :249 ParallelCrossEntropy) —
+implemented there with c_identity/c_allreduce/c_embedding collective ops.
+
+TPU-native: each layer holds the FULL logical weight annotated with a mesh
+sharding (column → PartitionSpec(None, "mp"); row → PartitionSpec("mp",
+None); vocab embedding → PartitionSpec("mp", None)).  Under jit, GSPMD
+partitions the matmuls and inserts the same all-reduces the reference codes
+by hand — scheduled with overlap by XLA.  Eagerly on one chip they behave as
+dense layers (degree-1 groups), matching the reference's single-rank path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from .mesh import get_hybrid_communicate_group, get_mesh
+from .sharding import mark_sharding, shard_tensor
+
+
+def _mp_size():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    mesh = get_mesh()
+    return mesh.shape.get("mp", 1) if mesh is not None else 1
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mp_size()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, PartitionSpec(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            mark_sharding(self.bias, PartitionSpec("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output and get_mesh() is not None and \
+                "mp" in get_mesh().shape:
+            nd = out.ndim
+            out = shard_tensor(out, placements=[None] * (nd - 1) + ["mp"])
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mp_size()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, PartitionSpec("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # contraction dim sharded on mp → GSPMD inserts the all-reduce the
+        # reference codes as c_allreduce_sum after the local matmul
+        out = F.linear(x, self.weight, self.bias)
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.world_size = _mp_size()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        mark_sharding(self.weight, PartitionSpec("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel softmax cross entropy (reference mp_layers.py:249 →
+    c_softmax_with_cross_entropy op).  With logits sharded on the vocab axis,
+    GSPMD partitions log_softmax's reduction into the same max/sum
+    all-reduce pattern the hand-written kernel uses."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class RNGStatesTracker:
+    """Per-region RNG isolation (reference: parallel_layers/random.py:32) —
+    distinct named seeds for 'global' vs 'local' (per-mp-rank) dropout."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        import jax as _jax
+
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = _jax.random.PRNGKey(seed)
+
+    def rng_state(self, name="model_parallel_rng"):
+        import contextlib
+
+        from ..ops import random as rnd
+
+        @contextlib.contextmanager
+        def ctx():
+            if name not in self.states_:
+                raise ValueError(f"unknown rng region {name}")
+            gen = rnd.default_generator()
+            saved = gen._key
+            gen._key = self.states_[name]
+            try:
+                yield
+            finally:
+                self.states_[name] = gen._key
+                gen._key = saved
+        return ctx()
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from ..ops import random as rnd
+
+    seed = seed or (1024 + pyrandom.randint(0, 10000))
+    global _RNG_TRACKER
+    _RNG_TRACKER = RNGStatesTracker()
+    rnd.seed(seed)
+    _RNG_TRACKER.add("model_parallel_rng", seed + 1)
+    _RNG_TRACKER.add("global_seed", seed + 2)

@@ -1,0 +1,96 @@
+"""Sharding annotations — the GSPMD front door.
+
+Replaces the reference auto_parallel shard_tensor/dist_attr machinery
+(/root/reference/python/paddle/distributed/auto_parallel/) with jax.sharding:
+a placement is a PartitionSpec over the global mesh; annotations are
+device_put (eager) or with_sharding_constraint (inside a trace).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.dispatch import apply, in_static_trace
+from ..core.tensor import Tensor
+from .mesh import get_mesh
+
+
+def _pspec(placements) -> PartitionSpec:
+    if placements is None:
+        return PartitionSpec()
+    if isinstance(placements, PartitionSpec):
+        return placements
+    return PartitionSpec(*placements)
+
+
+def shard_tensor(x: Tensor, mesh: Optional[Mesh] = None, placements=None,
+                 dist_attr=None) -> Tensor:
+    """Annotate a tensor with a mesh sharding.
+
+    Eager: device_put onto the NamedSharding (actually lays the tensor out
+    across chips).  Traced: with_sharding_constraint (GSPMD propagates).
+    """
+    mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else \
+        (mesh or get_mesh())
+    if mesh is None:
+        return x
+    spec = _pspec(placements)
+    sharding = NamedSharding(mesh, spec)
+    if in_static_trace() or _is_tracer(x._value):
+        out = apply("sharding_constraint",
+                    lambda v: jax.lax.with_sharding_constraint(v, sharding), x)
+        out._sharding_spec = spec
+        return out
+    out = Tensor(jax.device_put(x._value, sharding),
+                 stop_gradient=x.stop_gradient)
+    out._grad_node = x._grad_node
+    out._output_index = x._output_index
+    out._sharding_spec = spec
+    return out
+
+
+def _is_tracer(v):
+    return hasattr(v, "aval") and not hasattr(v, "addressable_shards")
+
+
+def mark_sharding(param: Tensor, placements) -> Tensor:
+    """Attach a sharding spec to a Parameter; jit.to_static uses it to build
+    in_shardings for the compiled step (and eagerly lays out the weight)."""
+    spec = _pspec(placements)
+    param._sharding_spec = spec
+    mesh = get_mesh()
+    if mesh is not None and not _is_tracer(param._value):
+        needed = [a for a in jax.tree_util.tree_leaves(tuple(spec)) if a]
+        if all(a in mesh.shape for a in needed):
+            param._value = jax.device_put(param._value,
+                                          NamedSharding(mesh, spec))
+    return param
+
+
+def get_sharding_spec(t: Tensor):
+    return getattr(t, "_sharding_spec", None)
+
+
+def shard_op(op_fn, mesh=None, in_placements=None, out_placements=None):
+    """Wrap an op so inputs/outputs carry sharding constraints."""
+
+    def wrapped(*args, **kwargs):
+        if in_placements is not None:
+            args = tuple(
+                shard_tensor(a, mesh, p) if isinstance(a, Tensor) and
+                p is not None else a
+                for a, p in zip(args, in_placements))
+        out = op_fn(*args, **kwargs)
+        if out_placements is not None and isinstance(out, Tensor):
+            out = shard_tensor(out, mesh, out_placements)
+        return out
+
+    return wrapped
+
+
+def reshard(x: Tensor, mesh=None, placements=None) -> Tensor:
+    """Change a tensor's layout across the mesh (reference:
+    auto_parallel/reshard.py — here it is one device_put; XLA moves bytes)."""
+    return shard_tensor(x, mesh, placements)

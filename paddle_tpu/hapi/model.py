@@ -1,0 +1,278 @@
+"""hapi Model (reference: python/paddle/hapi/model.py:907 Model, :1557 fit).
+
+The train loop compiles its step through jit.to_static, so Model.fit trains
+with one fused XLA program per batch shape — the reference's dygraph adapter
+runs op-by-op instead (model.py:705 DynamicGraphAdapter).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import CallbackList, LRScheduler, ModelCheckpoint, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step_fn = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._build_train_step()
+
+    def _build_train_step(self):
+        from .. import jit
+
+        network = self.network
+        loss_fn = self._loss
+        optimizer = self._optimizer
+
+        if optimizer is None or loss_fn is None:
+            return
+
+        def train_step(inputs, labels):
+            outputs = network(*inputs)
+            losses = loss_fn(outputs, *labels)
+            losses.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return losses, outputs
+
+        self._train_step_fn = jit.to_static(train_step)
+
+        def eval_step(inputs, labels):
+            outputs = network(*inputs)
+            losses = loss_fn(outputs, *labels)
+            return losses, outputs
+
+        self._eval_step_fn = jit.to_static(eval_step)
+
+    # ------------------------------------------------------------- steps
+    @staticmethod
+    def _split_batch(data):
+        if isinstance(data, (list, tuple)):
+            if len(data) >= 2:
+                return [data[0]], list(data[1:])
+            return [data[0]], []
+        return [data], []
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        inputs = [to_tensor(x) if not isinstance(x, Tensor) else x
+                  for x in inputs]
+        labels = [to_tensor(y) if not isinstance(y, Tensor) else y
+                  for y in labels]
+        loss, outputs = self._train_step_fn(inputs, labels)
+        metrics = [float(np.asarray(loss.numpy()).mean())]
+        for m in self._metrics:
+            m.update(m.compute(outputs, *labels).numpy())
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        inputs = [to_tensor(x) for x in inputs]
+        labels = [to_tensor(y) for y in labels]
+        loss, outputs = self._eval_step_fn(inputs, labels)
+        for m in self._metrics:
+            m.update(m.compute(outputs, *labels).numpy())
+        return float(np.asarray(loss.numpy()).mean())
+
+    def predict_batch(self, inputs):
+        from ..core.dispatch import no_grad_ctx
+
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad_ctx():
+            out = self.network(*[to_tensor(x) for x in inputs])
+        return out
+
+    # ------------------------------------------------------------- loops
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbs = [ProgBarLogger(log_freq, verbose=verbose)]
+        if self._optimizer is not None and \
+                self._optimizer._lr_scheduler is not None:
+            cbs.append(LRScheduler())
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs += list(callbacks or [])
+        cb_list = CallbackList(cbs)
+        cb_list.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cb_list.set_params({"epochs": epochs, "steps": steps,
+                            "verbose": verbose})
+
+        self.stop_training = False
+        cb_list.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cb_list.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            epoch_logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cb_list.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                for m in self._metrics:
+                    names = m.name()
+                    vals = m.accumulate()
+                    if isinstance(names, list):
+                        logs.update(dict(zip(names, vals)))
+                    else:
+                        logs[names] = vals
+                epoch_logs = logs
+                cb_list.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            history["loss"].append(epoch_logs.get("loss"))
+            cb_list.on_epoch_end(epoch, epoch_logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=verbose,
+                                          _callbacks=cb_list)
+            if self.stop_training:
+                break
+        cb_list.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None,
+                 _callbacks=None):
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        cb_list = _callbacks or CallbackList(list(callbacks or []))
+        if _callbacks is None:
+            cb_list.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cb_list.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            inputs, labels = self._split_batch(batch)
+            losses.append(self.eval_batch(inputs, labels))
+        logs = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, list):
+                logs.update(dict(zip(names, vals)))
+            else:
+                logs[names] = vals
+        cb_list.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            out = self.predict_batch(inputs)
+            outputs.append(out.numpy() if isinstance(out, Tensor)
+                           else [o.numpy() for o in out])
+        if stack_outputs and outputs and isinstance(outputs[0], np.ndarray):
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    # ------------------------------------------------------------- persist
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary_fn(self.network, input_size, dtype)
+
+
+def summary_fn(net, input_size=None, dtype=None):
+    """paddle.summary analog: parameter table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [f"{'Param':<{width}}{'Shape':<20}{'Count':>12}"]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+summary = summary_fn

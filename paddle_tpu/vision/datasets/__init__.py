@@ -1,0 +1,204 @@
+"""paddle.vision.datasets (reference: python/paddle/vision/datasets/).
+
+This environment has no network egress, so datasets load from local files
+(`data_file=` / `image_path=` args); `FakeData` provides synthetic samples
+for pipelines and tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+
+class FakeData(Dataset):
+    """Synthetic image classification dataset (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference downloads them; zero-egress here).
+
+    image_path/label_path point at (possibly gzipped) idx files.
+    """
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise ValueError(
+                "no network egress: pass image_path/label_path to local "
+                "MNIST idx files, or use paddle_tpu.vision.datasets.FakeData")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # 1HW
+        label = np.asarray(self.labels[idx], np.int64)
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, label
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-pickle tarball."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise ValueError(
+                "no network egress: pass data_file pointing at "
+                "cifar-10-python.tar.gz, or use FakeData")
+        self.transform = transform
+        self.data, self.labels = self._load(data_file, mode)
+
+    def _load(self, data_file, mode):
+        images, labels = [], []
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" \
+            else ["test_batch"]
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    d = pickle.load(tar.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        return data, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def _load(self, data_file, mode):
+        names = ["train"] if mode == "train" else ["test"]
+        images, labels = [], []
+        with tarfile.open(data_file) as tar:
+            for member in tar.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    d = pickle.load(tar.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d[b"fine_labels"])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        return data, np.asarray(labels, np.int64)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdir image folder (reference: vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL unavailable; use .npy images") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(tuple(extensions))]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)

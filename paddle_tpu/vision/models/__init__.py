@@ -1,0 +1,13 @@
+"""paddle.vision.models (reference: python/paddle/vision/models/__init__.py)."""
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext101_32x4d, wide_resnet50_2, wide_resnet101_2,
+)
+from .simple import (  # noqa: F401
+    AlexNet, LeNet, SqueezeNet, VGG, alexnet, squeezenet1_0, squeezenet1_1,
+    vgg11, vgg13, vgg16, vgg19,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
+    mobilenet_v1, mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small,
+)

@@ -1,0 +1,116 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py): detection
+primitives — nms, box coding, roi_align, deform_conv2d (subset)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, in_static_trace
+from ..core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def box_area(boxes):
+    return apply("box_area",
+                 lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), _t(boxes))
+
+
+def box_iou(boxes1, boxes2):
+    def _iou(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return apply("box_iou", _iou, _t(boxes1), _t(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS — data-dependent output size, so host-side (eager only)."""
+    if in_static_trace():
+        raise RuntimeError("nms has data-dependent shape; run outside jit")
+    b = np.asarray(_t(boxes)._value)
+    s = np.asarray(_t(scores)._value) if scores is not None \
+        else np.ones(len(b), np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        w = np.clip(xx2 - xx1, 0, None)
+        h = np.clip(yy2 - yy1, 0, None)
+        inter = w * h
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear gather (XLA-friendly, static shapes)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _roi(feat, rois):
+        # feat [N,C,H,W]; rois [R,4] in x1,y1,x2,y2 (batch 0 assumed per-image
+        # via boxes_num split upstream — single image path here)
+        C, H, W = feat.shape[1:]
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        bw = (x2 - x1) / ow
+        bh = (y2 - y1) / oh
+        gy = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * bh[:, None]
+        gx = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * bw[:, None]
+
+        # vectorized bilinear gather over rois
+        R = rois.shape[0]
+        yy = gy[:, :, None]  # [R, oh, 1]
+        xx = gx[:, None, :]  # [R, 1, ow]
+        yy = jnp.broadcast_to(yy, (R, oh, ow))
+        xx = jnp.broadcast_to(xx, (R, oh, ow))
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy - y0, 0, 1)
+        wx = jnp.clip(xx - x0, 0, 1)
+        img = feat[0]  # [C,H,W]
+        g = lambda yi, xi: img[:, yi, xi]  # → [C,R,oh,ow] via advanced idx
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1_, x0) * wy * (1 - wx)
+               + g(y0, x1_) * (1 - wy) * wx + g(y1_, x1_) * wy * wx)
+        return jnp.transpose(out, (1, 0, 2, 3))  # [R,C,oh,ow]
+    return apply("roi_align", _roi, _t(x), _t(boxes))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    raise NotImplementedError("yolo_box: planned detection-suite op")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    raise NotImplementedError("deform_conv2d: planned detection-suite op")

@@ -1,0 +1,225 @@
+"""DataLoader / vision / hapi Model / metric tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (BatchSampler, ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, random_split)
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.optimizer import Adam, SGD
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import (LeNet, mobilenet_v2, resnet18,
+                                      squeezenet1_1, vgg11)
+from paddle_tpu.vision import transforms as T
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.asarray([i], np.float32), np.asarray(i % 3, np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        xs = np.arange(12).reshape(6, 2).astype(np.float32)
+        ds = TensorDataset([xs, np.arange(6)])
+        x, y = ds[2]
+        np.testing.assert_array_equal(x, [4, 5])
+
+    def test_concat_subset_split(self):
+        a, b = RangeDataset(5), RangeDataset(7)
+        cat = ConcatDataset([a, b])
+        assert len(cat) == 12
+        assert cat[6][0][0] == 1  # second dataset idx 1
+        sub = Subset(a, [1, 3])
+        assert len(sub) == 2
+        parts = random_split(RangeDataset(10), [7, 3])
+        assert len(parts[0]) == 7 and len(parts[1]) == 3
+
+
+class TestSamplers:
+    def test_sequence_random(self):
+        ds = RangeDataset(10)
+        assert list(SequenceSampler(ds)) == list(range(10))
+        assert sorted(RandomSampler(ds)) == list(range(10))
+
+    def test_batch_sampler(self):
+        ds = RangeDataset(10)
+        bs = BatchSampler(ds, batch_size=3, drop_last=False)
+        batches = list(bs)
+        assert len(batches) == 4 and len(batches[-1]) == 1
+        bs2 = BatchSampler(ds, batch_size=3, drop_last=True)
+        assert len(list(bs2)) == 3
+
+    def test_distributed_batch_sampler(self):
+        ds = RangeDataset(10)
+        s0 = DistributedBatchSampler(ds, 2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, 2, num_replicas=2, rank=1)
+        idx0 = [i for b in s0 for i in b]
+        idx1 = [i for b in s1 for i in b]
+        assert len(set(idx0) & set(idx1)) == 0
+        assert len(idx0) + len(idx1) == 10
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        loader = DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 1]
+
+    def test_shuffle(self):
+        loader = DataLoader(RangeDataset(50), batch_size=50, shuffle=True)
+        (x, _), = list(loader)
+        assert not np.array_equal(x.numpy().flatten(), np.arange(50))
+
+    def test_iterable_dataset(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.asarray([i], np.float32)
+
+        loader = DataLoader(Stream(), batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 3
+
+    def test_multiprocess_workers(self):
+        loader = DataLoader(RangeDataset(16), batch_size=4, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 4
+        all_vals = sorted(int(v) for b in batches for v in b[0].numpy().flatten())
+        assert all_vals == list(range(16))
+
+    def test_dict_collate(self):
+        class DictDS(Dataset):
+            def __getitem__(self, i):
+                return {"x": np.ones(2, np.float32) * i, "y": i}
+
+            def __len__(self):
+                return 4
+
+        loader = DataLoader(DictDS(), batch_size=2)
+        batch = next(iter(loader))
+        assert batch["x"].shape == [2, 2]
+
+
+class TestTransforms:
+    def test_compose_pipeline(self):
+        img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+        tf = T.Compose([T.Resize(8), T.CenterCrop(6), T.ToTensor()])
+        out = tf(img)
+        assert out.shape == (3, 6, 6)
+        assert out.max() <= 1.0
+
+    def test_normalize(self):
+        x = np.ones((3, 4, 4), np.float32)
+        out = T.Normalize(mean=[1, 1, 1], std=[2, 2, 2])(x)
+        np.testing.assert_allclose(out, np.zeros_like(x))
+
+    def test_flips_crops(self):
+        img = np.arange(16).reshape(4, 4, 1).astype(np.float32)
+        np.testing.assert_array_equal(T.hflip(img)[:, :, 0], img[:, ::-1, 0])
+        out = T.RandomCrop(2)(img)
+        assert out.shape == (2, 2, 1)
+
+
+class TestVisionModels:
+    def test_lenet(self):
+        net = LeNet()
+        out = net(paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype("f")))
+        assert out.shape == [2, 10]
+
+    def test_resnet18_forward_backward(self):
+        net = resnet18(num_classes=4)
+        out = net(paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype("f")))
+        assert out.shape == [1, 4]
+        out.sum().backward()
+        assert net.conv1.weight.grad is not None
+
+    def test_vgg_mobilenet_squeezenet(self):
+        x = paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype("f"))
+        assert vgg11(num_classes=5)(x).shape == [1, 5]
+        assert mobilenet_v2(num_classes=5)(x).shape == [1, 5]
+        assert squeezenet1_1(num_classes=5)(x).shape == [1, 5]
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        acc = Accuracy()
+        pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+        label = paddle.to_tensor(np.array([0, 0]))
+        correct = acc.compute(pred, label)
+        acc.update(correct)
+        assert acc.accumulate() == pytest.approx(0.5)
+
+    def test_precision_recall(self):
+        p = Precision()
+        p.update(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 1]))
+        assert p.accumulate() == pytest.approx(0.5)
+        r = Recall()
+        r.update(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 1]))
+        assert r.accumulate() == pytest.approx(0.5)
+
+    def test_auc(self):
+        auc = Auc()
+        auc.update(np.array([0.9, 0.8, 0.3, 0.1]), np.array([1, 1, 0, 0]))
+        assert auc.accumulate() == pytest.approx(1.0)
+
+
+class TestHapiModel:
+    def _model(self):
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 16), nn.ReLU(),
+                            nn.Linear(16, 3))
+        model = paddle.Model(net)
+        model.prepare(Adam(0.01, parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        return model
+
+    def test_fit_reduces_loss(self):
+        ds = FakeData(size=64, image_shape=(1, 8, 8), num_classes=3)
+        model = self._model()
+        hist = model.fit(ds, epochs=3, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_evaluate_predict(self):
+        ds = FakeData(size=32, image_shape=(1, 8, 8), num_classes=3)
+        model = self._model()
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        logs = model.evaluate(ds, batch_size=16, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = model.predict(ds, batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (32, 3)
+
+    def test_save_load(self, tmp_path):
+        ds = FakeData(size=16, image_shape=(1, 8, 8), num_classes=3)
+        model = self._model()
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        model2 = self._model()
+        model2.load(path)
+        np.testing.assert_array_equal(
+            model.network[1].weight.numpy(), model2.network[1].weight.numpy())
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        ds = FakeData(size=32, image_shape=(1, 8, 8), num_classes=3)
+        model = self._model()
+        model.fit(ds, eval_data=ds, epochs=5, batch_size=16, verbose=0,
+                  callbacks=[EarlyStopping(monitor="loss", patience=0)])
+        # just verifies the callback path runs end to end
+
+    def test_summary(self):
+        model = self._model()
+        info = model.summary()
+        assert info["total_params"] > 0
