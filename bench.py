@@ -1,0 +1,85 @@
+"""Benchmark: Llama pretrain tokens/sec/chip on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md) — vs_baseline
+reports achieved MFU (model flops utilization) as the comparable scalar.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, steps, warmup = 8, 2048, 20, 5
+    else:  # smoke path for CPU dev runs
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 2, 64, 5, 2
+
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(1e-4, parameters=model.parameters())
+
+    @jit.to_static
+    def train_step(tokens):
+        loss, _ = model(tokens, labels=tokens)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    tokens = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    for _ in range(warmup):
+        loss = train_step(tokens)
+    np.asarray(loss.numpy())  # hard sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(tokens)
+        loss._value.block_until_ready()  # per-step sync: robust timing on
+        # remote-tunnel backends where a tail sync can miss the chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    # params (embedding counted once) for 6N flops/token
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6.0 * n_params
+    achieved_flops = tokens_per_sec * flops_per_token
+    # v5e bf16 peak ~197 TFLOP/s; CPU smoke has no meaningful peak
+    peak = 197e12 if on_tpu else None
+    mfu = achieved_flops / peak if peak else None
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4) if mfu is not None else None,
+    }))
+    print(f"# model={n_params/1e6:.1f}M params, batch={batch}, seq={seq}, "
+          f"steps={steps}, step_time={dt/steps*1000:.1f}ms, "
+          f"loss={float(np.asarray(loss.numpy())):.4f}, "
+          f"backend={jax.default_backend()}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

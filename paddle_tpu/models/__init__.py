@@ -1,0 +1,3 @@
+"""Model zoo beyond vision: LLM families (BASELINE.md configs 2-4)."""
+from .llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM,  # noqa: F401
+                    LlamaModel)

@@ -1,0 +1,353 @@
+"""Llama model family (Llama-2/3 architecture) — the flagship pretrain config
+(BASELINE.md config 3).
+
+The 2022 reference snapshot predates Llama; its closest analogs are the
+fused transformer ops (/root/reference/paddle/fluid/operators/fused/
+fused_multi_transformer_op.cu) and the Fleet mp_layers the model composes
+with.  TPU-native design:
+  - weights bf16, attention via the Pallas flash kernel (paddle_tpu/kernels)
+  - RMSNorm via the fused Pallas kernel
+  - tensor parallel through GSPMD-annotated Column/RowParallel layers
+  - sequence axis shardable ("sp") for context parallelism
+  - rotary embeddings precomputed once per max_position
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..nn import functional as F
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama3_8b(**overrides):
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            rope_theta=500000.0)
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg
+
+    @staticmethod
+    def tiny(**overrides):
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, dtype="float32")
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+def precompute_rope(head_dim, max_pos, theta):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    t = jnp.arange(max_pos, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [T, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, position_offset=0):
+    """x: [B, T, H, D].  Rotate-half convention."""
+    T = x.shape[1]
+    c = cos[position_offset:position_offset + T][None, :, None, :]
+    s = sin[position_offset:position_offset + T][None, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaRMSNorm(nn.Layer):
+    def __init__(self, hidden_size, eps=1e-5):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self._epsilon = eps
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        from ..core.flags import flag
+
+        def _rms(v, w):
+            if flag("use_pallas_kernels") and jax.default_backend() == "tpu":
+                from ..kernels.rms_norm import rms_norm as pallas_rms
+
+                return pallas_rms(v, w, self._epsilon)
+            var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                           keepdims=True)
+            return (v.astype(jnp.float32) * jax.lax.rsqrt(
+                var + self._epsilon)).astype(v.dtype) * w
+        return apply("rms_norm", _rms, x, self.weight)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        self.q_proj = ColumnParallelLinear(
+            h, self.num_heads * self.head_dim, has_bias=False,
+            gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            h, self.num_kv_heads * self.head_dim, has_bias=False,
+            gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            h, self.num_kv_heads * self.head_dim, has_bias=False,
+            gather_output=False)
+        self.o_proj = RowParallelLinear(
+            self.num_heads * self.head_dim, h, has_bias=False,
+            input_is_parallel=True)
+
+    def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
+                position_offset=0):
+        B, T = hidden.shape[0], hidden.shape[1]
+        q = self.q_proj(hidden).reshape([B, T, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden).reshape([B, T, self.num_kv_heads,
+                                         self.head_dim])
+        v = self.v_proj(hidden).reshape([B, T, self.num_kv_heads,
+                                         self.head_dim])
+
+        def _rope_q(qv):
+            return apply_rope(qv, cos, sin, position_offset)
+        q = apply("rope", _rope_q, q)
+        k = apply("rope", lambda kv: apply_rope(kv, cos, sin, position_offset),
+                  k)
+
+        if cache is not None:
+            from ..ops.manipulation import concat
+
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+
+        causal = cache is None  # full prefill is causal; decode attends to all
+
+        def _attn(qv, kv, vv):
+            from ..core.flags import flag
+            from ..kernels.flash_attention import (_attn_reference,
+                                                   flash_attention_bthd)
+
+            if self.config.use_flash_attention and flag("use_pallas_kernels") \
+                    and jax.default_backend() == "tpu":
+                return flash_attention_bthd(qv, kv, vv, causal=causal)
+            # reference path with GQA repeat
+            rep = qv.shape[2] // kv.shape[2]
+            if rep > 1:
+                kv = jnp.repeat(kv, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            qt = jnp.swapaxes(qv, 1, 2)
+            kt = jnp.swapaxes(kv, 1, 2)
+            vt = jnp.swapaxes(vv, 1, 2)
+            out = _attn_reference(qt, kt, vt, causal,
+                                  1.0 / math.sqrt(self.head_dim))
+            return jnp.swapaxes(out, 1, 2)
+
+        out = apply("attention", _attn, q, k, v)
+        out = out.reshape([B, T, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, m, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(m, h, has_bias=False,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
+                position_offset=0):
+        residual = hidden
+        h = self.input_layernorm(hidden)
+        if cache is not None:
+            h, new_cache = self.self_attn(h, cos, sin, attn_mask, cache,
+                                          position_offset)
+        else:
+            h = self.self_attn(h, cos, sin, attn_mask)
+            new_cache = None
+        hidden = residual + h
+        residual = hidden
+        h = self.mlp(self.post_attention_layernorm(hidden))
+        hidden = residual + h
+        if cache is not None:
+            return hidden, new_cache
+        return hidden
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = precompute_rope(head_dim, config.max_position_embeddings,
+                                   config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+        if config.dtype == "bfloat16":
+            self.bfloat16()
+
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        hidden = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            from ..distributed.sharding import shard_tensor
+
+            hidden = shard_tensor(hidden, placements=[None, "sp", None])
+        cos, sin = self.rope_cos._value, self.rope_sin._value
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                hidden, c = layer(hidden, cos, sin, attn_mask, caches[i],
+                                  position_offset)
+                new_caches.append(c)
+            else:
+                hidden = layer(hidden, cos, sin, attn_mask)
+        hidden = self.norm(hidden)
+        if caches is not None:
+            return hidden, new_caches
+        return hidden
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+            if config.dtype == "bfloat16":
+                self.lm_head.bfloat16()
+
+    def forward(self, input_ids, labels=None, attn_mask=None, caches=None,
+                position_offset=0):
+        if caches is not None:
+            hidden, new_caches = self.model(input_ids, attn_mask, caches,
+                                            position_offset)
+        else:
+            hidden = self.model(input_ids, attn_mask)
+        if self.config.tie_word_embeddings:
+            def _tied(h, w):
+                return h @ w.T.astype(h.dtype)
+            logits = apply("lm_head_tied", _tied, hidden,
+                           self.model.embed_tokens.weight)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is not None:
+            def _loss(lg, lab):
+                lg = lg[:, :-1].astype(jnp.float32)
+                lab = lab[:, 1:]
+                logp = jax.nn.log_softmax(lg, axis=-1)
+                picked = jnp.take_along_axis(
+                    logp, lab[..., None].astype(jnp.int32), axis=-1)[..., 0]
+                return -jnp.mean(picked)
+            loss = apply("causal_lm_loss", _loss, logits, labels)
+            return loss, logits
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    # --------------------------------------------------------- generation
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k: Optional[int] = None):
+        """Greedy/temperature decode with KV cache (eager loop)."""
+        from .. import ops
+        from ..core.dispatch import no_grad_ctx
+        from ..ops import random as rnd
+
+        with no_grad_ctx():
+            B, T = input_ids.shape
+            caches = [(Tensor(jnp.zeros(
+                (B, 0, self.config.num_key_value_heads,
+                 self.config.hidden_size // self.config.num_attention_heads),
+                self.model.embed_tokens.weight._value.dtype)),) * 2
+                for _ in range(self.config.num_hidden_layers)]
+            caches = [tuple(c) for c in caches]
+            logits, caches = self.forward(input_ids, caches=caches,
+                                          position_offset=0)
+            out_tokens = [input_ids]
+            cur = T
+            last = logits[:, -1]
+            for _ in range(max_new_tokens):
+                if temperature == 0.0:
+                    nxt = ops.argmax(last, axis=-1).astype("int32")
+                else:
+                    scaled = last / temperature
+                    if top_k:
+                        vals, _ = ops.topk(scaled, top_k, axis=-1)
+                        kth = vals[:, -1:]
+                        scaled = ops.where(scaled < kth,
+                                           ops.full_like(scaled, -1e30),
+                                           scaled)
+                    key = rnd.next_key()
+                    nxt = Tensor(jax.random.categorical(
+                        key, scaled._value.astype(jnp.float32)).astype(
+                            jnp.int32))
+                nxt = nxt.reshape([B, 1])
+                out_tokens.append(nxt)
+                logits, caches = self.forward(nxt, caches=caches,
+                                              position_offset=cur)
+                last = logits[:, -1]
+                cur += 1
+            return ops.concat(out_tokens, axis=1)
