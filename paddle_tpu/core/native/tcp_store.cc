@@ -1,0 +1,327 @@
+// TCPStore: rendezvous key-value store for multi-host bootstrap.
+//
+// Native analog of the reference's C++ TCPStore
+// (/root/reference/paddle/fluid/distributed/store/tcp_store.h:91,
+// tcp_utils.cc): a TCP server on the master rank serving set/get/add/wait,
+// used before any accelerator interconnect exists.  C ABI for ctypes.
+//
+// Protocol (all ints little-endian u32 unless noted):
+//   request : u8 cmd | u32 keylen | key | (SET: u32 vallen | val)
+//                                        (ADD: i64 delta)
+//                                        (WAIT: u32 timeout_ms)
+//   response: GET -> u32 vallen|val (vallen==0xFFFFFFFF => missing)
+//             SET -> u8 1
+//             ADD -> i64 new_value
+//             WAIT-> u8 (1 found, 0 timeout)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDelete = 5 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, 0);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Store {
+ public:
+  void set(const std::string& k, std::string v) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      data_[k] = std::move(v);
+    }
+    cv_.notify_all();
+  }
+
+  bool get(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = data_.find(k);
+    if (it == data_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  int64_t add(const std::string& k, int64_t delta) {
+    int64_t result;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      int64_t cur = 0;
+      auto it = data_.find(k);
+      if (it != data_.end() && it->second.size() == sizeof(int64_t)) {
+        std::memcpy(&cur, it->second.data(), sizeof(int64_t));
+      }
+      cur += delta;
+      std::string v(sizeof(int64_t), '\0');
+      std::memcpy(&v[0], &cur, sizeof(int64_t));
+      data_[k] = std::move(v);
+      result = cur;
+    }
+    cv_.notify_all();
+    return result;
+  }
+
+  bool wait(const std::string& k, uint32_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                        [&] { return data_.count(k) > 0; });
+  }
+
+  void erase(const std::string& k) {
+    std::lock_guard<std::mutex> g(mu_);
+    data_.erase(k);
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+  Store store;
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR), ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(conns_mu);
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+};
+
+void handle_conn(Server* srv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    if (!read_full(fd, &cmd, 1)) break;
+    uint32_t keylen;
+    if (!read_full(fd, &keylen, 4)) break;
+    std::string key(keylen, '\0');
+    if (keylen && !read_full(fd, &key[0], keylen)) break;
+    if (cmd == kSet) {
+      uint32_t vallen;
+      if (!read_full(fd, &vallen, 4)) break;
+      std::string val(vallen, '\0');
+      if (vallen && !read_full(fd, &val[0], vallen)) break;
+      srv->store.set(key, std::move(val));
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (cmd == kGet) {
+      std::string val;
+      if (srv->store.get(key, &val)) {
+        uint32_t n = static_cast<uint32_t>(val.size());
+        if (!write_full(fd, &n, 4) || !write_full(fd, val.data(), n)) break;
+      } else {
+        uint32_t n = 0xFFFFFFFFu;
+        if (!write_full(fd, &n, 4)) break;
+      }
+    } else if (cmd == kAdd) {
+      int64_t delta;
+      if (!read_full(fd, &delta, 8)) break;
+      int64_t result = srv->store.add(key, delta);
+      if (!write_full(fd, &result, 8)) break;
+    } else if (cmd == kWait) {
+      uint32_t timeout_ms;
+      if (!read_full(fd, &timeout_ms, 4)) break;
+      uint8_t found = srv->store.wait(key, timeout_ms) ? 1 : 0;
+      if (!write_full(fd, &found, 1)) break;
+    } else if (cmd == kDelete) {
+      srv->store.erase(key);
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(int port) {
+  auto* srv = new Server();
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 128) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(srv->conns_mu);
+      srv->conns.emplace_back(handle_conn, srv, fd);
+    }
+  });
+  return srv;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (srv) {
+    srv->shutdown();
+    delete srv;
+  }
+}
+
+void* tcp_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* cl = new Client();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    cl->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    ::inet_pton(AF_INET, host, &addr.sin_addr);
+    if (::connect(cl->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+        0) {
+      int one = 1;
+      ::setsockopt(cl->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return cl;
+    }
+    ::close(cl->fd);
+    cl->fd = -1;
+    if (std::chrono::steady_clock::now() > deadline) {
+      delete cl;
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void tcp_store_client_close(void* handle) {
+  delete static_cast<Client*>(handle);
+}
+
+static bool send_key(Client* cl, uint8_t cmd, const char* key,
+                     uint32_t keylen) {
+  return write_full(cl->fd, &cmd, 1) && write_full(cl->fd, &keylen, 4) &&
+         write_full(cl->fd, key, keylen);
+}
+
+int tcp_store_set(void* handle, const char* key, const uint8_t* val,
+                  uint32_t vallen) {
+  auto* cl = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(cl->mu);
+  if (!send_key(cl, kSet, key, static_cast<uint32_t>(strlen(key)))) return -1;
+  if (!write_full(cl->fd, &vallen, 4) || !write_full(cl->fd, val, vallen))
+    return -1;
+  uint8_t ok;
+  return read_full(cl->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// returns value length, -1 missing, -2 error; copies at most buflen bytes.
+int64_t tcp_store_get(void* handle, const char* key, uint8_t* buf,
+                      uint32_t buflen) {
+  auto* cl = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(cl->mu);
+  if (!send_key(cl, kGet, key, static_cast<uint32_t>(strlen(key)))) return -2;
+  uint32_t n;
+  if (!read_full(cl->fd, &n, 4)) return -2;
+  if (n == 0xFFFFFFFFu) return -1;
+  std::string val(n, '\0');
+  if (n && !read_full(cl->fd, &val[0], n)) return -2;
+  std::memcpy(buf, val.data(), n < buflen ? n : buflen);
+  return static_cast<int64_t>(n);
+}
+
+int64_t tcp_store_add(void* handle, const char* key, int64_t delta) {
+  auto* cl = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(cl->mu);
+  if (!send_key(cl, kAdd, key, static_cast<uint32_t>(strlen(key))))
+    return INT64_MIN;
+  if (!write_full(cl->fd, &delta, 8)) return INT64_MIN;
+  int64_t result;
+  if (!read_full(cl->fd, &result, 8)) return INT64_MIN;
+  return result;
+}
+
+int tcp_store_wait(void* handle, const char* key, uint32_t timeout_ms) {
+  auto* cl = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(cl->mu);
+  if (!send_key(cl, kWait, key, static_cast<uint32_t>(strlen(key))))
+    return -1;
+  if (!write_full(cl->fd, &timeout_ms, 4)) return -1;
+  uint8_t found;
+  if (!read_full(cl->fd, &found, 1)) return -1;
+  return found ? 1 : 0;
+}
+
+int tcp_store_delete(void* handle, const char* key) {
+  auto* cl = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> g(cl->mu);
+  if (!send_key(cl, kDelete, key, static_cast<uint32_t>(strlen(key))))
+    return -1;
+  uint8_t ok;
+  return read_full(cl->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+}  // extern "C"

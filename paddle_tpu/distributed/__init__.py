@@ -23,7 +23,13 @@ from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
 )
-from .sharding import shard_tensor, shard_op  # noqa: F401
+from .sharding import shard_tensor, shard_op, reshard  # noqa: F401
+from .moe import ExpertMLP, MoELayer  # noqa: F401
+from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
+                       SharedLayerDesc, gpipe_spmd)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .store import TCPStore  # noqa: F401
+from ..kernels.ring_attention import ring_attention  # noqa: F401
 
 
 def is_initialized():

@@ -1,0 +1,17 @@
+"""Segmentation helpers for pipeline stages (reference: pp_layers.py
+SegmentLayers — uniform and by-layer strategies)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def segment_uniform(num_items: int, num_parts: int) -> List[Tuple[int, int]]:
+    base = num_items // num_parts
+    extra = num_items % num_parts
+    bounds = []
+    start = 0
+    for i in range(num_parts):
+        size = base + (1 if i < extra else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds

@@ -1,0 +1,172 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:233
+MoELayer + gates (naive/gshard/switch, moe/gate/*.py) + global_scatter/
+global_gather alltoall ops (paddle/fluid/operators/collective/
+global_scatter_op.*).
+
+TPU-native (GShard recipe — XLA hates dynamic token counts, so routing is
+capacity-padded with static shapes): expert weights are stacked with a
+leading expert axis sharded over the mesh axis "ep"; dispatch/combine are
+einsums against a [tokens, E, C] one-hot, and GSPMD lowers the expert-axis
+resharding to the same all-to-all the reference codes by hand.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from .sharding import mark_sharding
+
+
+def _top2_gating(logits, capacity, second_policy="all"):
+    """GShard top-2 gating → (combine [T,E,C], dispatch [T,E,C], aux_loss)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)
+    g1_prob = jnp.max(probs, axis=-1)
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(g1_idx, E))
+    g2_idx = jnp.argmax(probs_wo1, axis=-1)
+    g2_prob = jnp.max(probs_wo1, axis=-1)
+
+    # aux load-balance loss (GShard eq.4): E * mean(me * ce)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(g1_idx, E), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    mask1 = jax.nn.one_hot(g1_idx, E)
+    mask2 = jax.nn.one_hot(g2_idx, E)
+    # positions within each expert (cumsum over tokens)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - 1.0
+    mask1 = mask1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(mask2, axis=0) + jnp.sum(mask1, axis=0,
+                                                keepdims=True)) * mask2 - 1.0
+    mask2 = mask2 * (pos2 < capacity)
+
+    denom = g1_prob + g2_prob + 1e-9
+    w1 = (g1_prob / denom) * jnp.sum(mask1, axis=1)
+    w2 = (g2_prob / denom) * jnp.sum(mask2, axis=1)
+
+    p1 = jnp.einsum("te,te->t", pos1, mask1).astype(jnp.int32)
+    p2 = jnp.einsum("te,te->t", pos2, mask2).astype(jnp.int32)
+    c1 = jax.nn.one_hot(jnp.clip(p1, 0, capacity - 1), capacity)
+    c2 = jax.nn.one_hot(jnp.clip(p2, 0, capacity - 1), capacity)
+    combine = (w1[:, None, None] * mask1[:, :, None] * c1[:, None, :]
+               + w2[:, None, None] * mask2[:, :, None] * c2[:, None, :])
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+def _top1_gating(logits, capacity, jitter_eps=0.0):
+    """Switch-transformer gating."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    g_idx = jnp.argmax(probs, axis=-1)
+    g_prob = jnp.max(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(g_idx, E), axis=0)
+    aux = jnp.sum(me * ce) * E
+    mask = jax.nn.one_hot(g_idx, E)
+    pos = jnp.cumsum(mask, axis=0) * mask - 1.0
+    mask = mask * (pos < capacity)
+    p = jnp.einsum("te,te->t", pos, mask).astype(jnp.int32)
+    c = jax.nn.one_hot(jnp.clip(p, 0, capacity - 1), capacity)
+    combine = g_prob[:, None, None] * mask[:, :, None] * c[:, None, :]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class ExpertMLP(nn.Layer):
+    """Stacked expert FFNs: weights [E, ...] sharded on the ep axis."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.activation = activation
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter([num_experts, 1, d_model],
+                                        is_bias=True)
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            mark_sharding(p, PartitionSpec("ep"))
+
+    def forward(self, x):
+        """x: [E, C, d_model] → [E, C, d_model]."""
+        def _expert(v, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edm->ecm", v, w1) + b1
+            h = jax.nn.gelu(h) if self.activation == "gelu" else \
+                jax.nn.silu(h) if self.activation in ("silu", "swish") else \
+                jax.nn.relu(h)
+            return jnp.einsum("ecm,emd->ecd", h, w2) + b2
+        return apply("expert_mlp", _expert, x, self.w1, self.b1, self.w2,
+                     self.b2)
+
+
+class MoELayer(nn.Layer):
+    """Reference MoELayer analog (moe_layer.py:233)."""
+
+    def __init__(self, d_model, d_hidden=None, num_experts=8, top_k=2,
+                 capacity_factor=1.25, gate: str = "gshard", experts=None,
+                 ep_group=None, recompute_interval=0, activation="gelu",
+                 name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate_type = gate if isinstance(gate, str) else "gshard"
+        self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
+        self.experts = experts if experts is not None else ExpertMLP(
+            num_experts, d_model, d_hidden or 4 * d_model, activation)
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [B, T, d] (or [T, d]).  Returns same shape; aux (load-balance)
+        loss stored on self.aux_loss."""
+        orig_shape = x.shape
+        from ..ops.manipulation import reshape
+
+        flat = reshape(x, [-1, self.d_model])
+        T = flat.shape[0]
+        capacity = max(int(self.capacity_factor * T * self.top_k
+                           / self.num_experts), 1)
+        logits = self.gate(flat)
+
+        gate_fn = _top2_gating if (self.gate_type == "gshard"
+                                   and self.top_k >= 2) else _top1_gating
+
+        def _route(lg):
+            combine, dispatch, aux = gate_fn(lg.astype(jnp.float32), capacity)
+            return combine, dispatch.astype(lg.dtype), aux
+        combine, dispatch, aux = apply("moe_gate", _route, logits)
+        self.aux_loss = aux
+
+        def _dispatch(v, d):
+            return jnp.einsum("tec,td->ecd", d.astype(v.dtype), v)
+        expert_in = apply("moe_dispatch", _dispatch, flat, dispatch)
+        expert_out = self.experts(expert_in)
+
+        def _combine(c, eo):
+            return jnp.einsum("tec,ecd->td", c.astype(eo.dtype), eo)
+        out = apply("moe_combine", _combine, combine, expert_out)
+        return reshape(out, orig_shape)
+
+
+class MoEMLP(MoELayer):
+    pass

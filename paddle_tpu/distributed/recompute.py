@@ -1,0 +1,98 @@
+"""Activation recomputation (reference:
+python/paddle/distributed/fleet/utils/recompute.py:331 — PyLayer replay with
+RNG-state restore).
+
+TPU-native: jax.checkpoint (remat) IS recompute — XLA rematerializes the
+segment inside the compiled program, trading FLOPs for HBM exactly like the
+reference's segment replay but without Python-level bookkeeping.  Layer
+parameters are threaded through the remat boundary as explicit inputs so
+their gradients flow (and so the replay uses the step's own weights).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _collect_params(function) -> List[Tensor]:
+    from ..jit import _find_layers
+
+    params = []
+    seen = set()
+    for layer in _find_layers(function):
+        for _, p in layer.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+    return params
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Run `function` under rematerialization; grads for both activations
+    and the function's Layer parameters flow through the remat boundary."""
+    params = _collect_params(function)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    n_args = len(tensor_args)
+
+    def raw_fn(*raw):
+        arg_vals, param_vals = raw[:n_args], raw[n_args:]
+        saved = [(p._value, p._grad_node, p._output_index) for p in params]
+        it = iter(arg_vals)
+        new_args = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                    for a in args]
+        try:
+            for p, v in zip(params, param_vals):
+                p._value = v
+                p._grad_node = None
+            out = function(*new_args, **kwargs)
+        finally:
+            for p, (v, node, idx) in zip(params, saved):
+                p._value = v
+                p._grad_node = node
+                p._output_index = idx
+        if isinstance(out, Tensor):
+            return out._value
+        return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+
+    remat_fn = jax.checkpoint(raw_fn)
+    return apply("recompute", remat_fn, *(tensor_args + params))
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in segments (reference: recompute_sequential;
+    first arg is a ctx dict with 'segments')."""
+    if not isinstance(ctx, dict):  # called without ctx
+        functions, args = ctx, (functions,) + args
+        ctx = {}
+    segments = ctx.get("segments", 1)
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // max(segments, 1), 1)
+    x = args[0]
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+
+        def run_chunk(inp, _chunk=tuple(chunk)):
+            for l in _chunk:
+                inp = l(inp)
+            return inp
+
+        x = recompute(run_chunk, x)
+        i += per
+    return x
+
+
+class RecomputeWrapper:
+    """Wrap a Layer so its forward runs under remat."""
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def __call__(self, *args, **kwargs):
+        return recompute(self.layer, *args, **kwargs)

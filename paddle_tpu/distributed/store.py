@@ -1,0 +1,132 @@
+"""TCPStore python binding (reference: paddle/fluid/distributed/store/
+tcp_store.h:91 bound via pybind; here the C++ core is loaded with ctypes).
+
+The native library compiles on first use (g++ -O2 -shared); a pure-python
+fallback keeps the API available without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "core", "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtcp_store.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "tcp_store.cc")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_native():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) or (
+                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                 _SRC_PATH, "-o", _SO_PATH],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_client_connect.restype = ctypes.c_void_p
+        lib.tcp_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                                 ctypes.c_int]
+        lib.tcp_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_set.restype = ctypes.c_int
+        lib.tcp_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_uint32]
+        lib.tcp_store_get.restype = ctypes.c_int64
+        lib.tcp_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_uint32]
+        lib.tcp_store_add.restype = ctypes.c_int64
+        lib.tcp_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64]
+        lib.tcp_store_wait.restype = ctypes.c_int
+        lib.tcp_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint32]
+        lib.tcp_store_delete.restype = ctypes.c_int
+        lib.tcp_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+        return lib
+
+
+class TCPStore:
+    """paddle.distributed TCPStore analog.
+
+    is_master=True starts the native server in-process; every rank (master
+    included) connects a client to host:port.
+    """
+
+    def __init__(self, host="127.0.0.1", port=6170, is_master=False,
+                 world_size=1, timeout=30.0):
+        self._lib = _load_native()
+        self._server = None
+        self.host = host
+        self.port = port
+        self.world_size = world_size
+        if is_master:
+            self._server = self._lib.tcp_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+        self._client = self._lib.tcp_store_client_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        rc = self._lib.tcp_store_set(self._client, key.encode(), data,
+                                     len(data))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str, wait: bool = True, timeout: float = 30.0) -> bytes:
+        if wait:
+            self.wait([key], timeout)
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.tcp_store_get(self._client, key.encode(), buf,
+                                    len(buf))
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        out = self._lib.tcp_store_add(self._client, key.encode(), amount)
+        if out == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return out
+
+    def wait(self, keys, timeout: float = 30.0):
+        if isinstance(keys, str):
+            keys = [keys]
+        for key in keys:
+            rc = self._lib.tcp_store_wait(self._client, key.encode(),
+                                          int(timeout * 1000))
+            if rc != 1:
+                raise TimeoutError(f"TCPStore.wait timeout on {key!r}")
+
+    def delete_key(self, key: str):
+        self._lib.tcp_store_delete(self._client, key.encode())
+
+    def barrier(self, name: str = "barrier", timeout: float = 30.0):
+        """All world_size participants arrive before anyone proceeds."""
+        count = self.add(f"{name}/count", 1)
+        if count == self.world_size:
+            self.set(f"{name}/done", b"1")
+        self.wait([f"{name}/done"], timeout)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.tcp_store_client_close(self._client)
+            if getattr(self, "_server", None):
+                self._lib.tcp_store_server_stop(self._server)
+        except Exception:
+            pass

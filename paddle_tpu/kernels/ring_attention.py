@@ -1,0 +1,112 @@
+"""Ring attention: exact attention over sequence shards.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.2 last row);
+this is new capability the TPU build owns.  Design (Ring Attention /
+blockwise): the sequence axis is sharded over the mesh axis `sp`; each step
+of a fori_loop computes a blockwise online-softmax update against the
+currently-held KV shard, then rotates KV one hop around the ring with
+lax.ppermute over ICI — compute and the permute overlap, and the full T x T
+score matrix never exists.
+
+Composes with dp/mp as extra mesh axes via shard_map.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k, v, acc, m, l, q_offset, kv_offset, scale, causal):
+    """One online-softmax update of (acc, m, l) with a KV block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; acc: [B, Tq, H, D] f32;
+    m/l: [B, Tq, H, 1] f32.
+    """
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale  # [B,H,Tq,Tk]
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    s = jnp.moveaxis(s, 1, 2)[..., None, :]  # [B,Tq,H,1,Tk] align with m/l
+    s = s[..., 0, :]  # [B,Tq,H,Tk]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # guard fully-masked blocks (exp(NEG_INF - NEG_INF) = 1 otherwise)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(m - m_new)
+    alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bthk,bkhd->bthd", p, v.astype(jnp.float32))
+    acc_new = acc * alpha + pv
+    return acc_new, m_new, l_new
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Runs on each sp shard inside shard_map.  q/k/v: [B, T_local, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    acc0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, T, H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, T, H, 1), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (idx - i) % n  # whose KV shard we hold at step i
+        q_off = idx * T
+        kv_off = src * T
+        acc, m, l = _block_attn_update(q, k_cur, v_cur, acc, m, l, q_off,
+                                       kv_off, scale, causal)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh = None, axis_name: str = "sp",
+                   causal: bool = False, scale=None, batch_axis: str = None):
+    """[B, T, H, D] exact attention with T sharded over `axis_name`.
+
+    Called on global (possibly sharded) arrays; returns the same layout.
+    """
+    from ..distributed.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        # no sp axis: plain attention
+        from .flash_attention import _attn_reference
+
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        out = _attn_reference(qt, kt, vt, causal,
+                              scale or 1.0 / math.sqrt(q.shape[-1]))
+        return jnp.swapaxes(out, 1, 2)
+
+    spec = P(batch_axis, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False) if hasattr(jax, "shard_map") else \
+        jax.experimental.shard_map.shard_map(
+            functools.partial(_ring_attention_local, axis_name=axis_name,
+                              causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False)
+    return fn(q, k, v)
