@@ -56,6 +56,17 @@ from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import sparse  # noqa: F401
+from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
+from . import incubate  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
+from . import utils  # noqa: F401
+from .models import bert as _bert_models  # noqa: F401
+from . import models  # noqa: F401
 
 # paddle.linalg namespace is the ops.linalg module re-exported
 from .ops import linalg  # noqa: F401

@@ -1,0 +1,128 @@
+"""paddle.distributed.launch: multi-host launch controller.
+
+Reference: python/paddle/distributed/launch/ (Controller builds a Pod of
+Containers, watches exits, restarts per --elastic_level; rendezvous via
+HTTP/etcd Master, controllers/master.py:66).
+
+TPU-native: ONE process per host drives all local chips (SPMD), so the
+controller's job is per-host process supervision + TCPStore rendezvous
+(jax.distributed handles the device-runtime handshake once env is set).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+
+class Container:
+    """One supervised training process (reference: job/container.py)."""
+
+    def __init__(self, cmd: List[str], env: dict, log_path: Optional[str] = None):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+
+    def start(self):
+        log = open(self.log_path, "ab") if self.log_path else None
+        self.proc = subprocess.Popen(
+            self.cmd, env={**os.environ, **self.env},
+            stdout=log or None, stderr=subprocess.STDOUT if log else None)
+        return self.proc
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class Controller:
+    """Per-host supervisor with elastic restart (reference:
+    controllers/collective.py:23 CollectiveController)."""
+
+    def __init__(self, script: str, script_args: List[str], nnodes: int = 1,
+                 rank: int = 0, master: str = "127.0.0.1:6170",
+                 elastic_level: int = 0, max_restarts: int = 3,
+                 log_dir: str = "log"):
+        self.script = script
+        self.script_args = script_args
+        self.nnodes = nnodes
+        self.rank = rank
+        self.master_addr, self.master_port = master.split(":")
+        self.elastic_level = elastic_level
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.store: Optional[TCPStore] = None
+
+    def _rendezvous(self):
+        """All nodes register endpoints; everyone learns the full list."""
+        is_master = self.rank == 0
+        self.store = TCPStore(self.master_addr, int(self.master_port),
+                              is_master=is_master, world_size=self.nnodes,
+                              timeout=300.0)
+        self.store.set(f"node/{self.rank}", f"{self.master_addr}")
+        self.store.barrier("rendezvous", timeout=300.0)
+        endpoints = ",".join(
+            f"{self.master_addr}:{int(self.master_port) + 1}"
+            for _ in range(self.nnodes))
+        return endpoints
+
+    def _build_env(self, endpoints):
+        return {
+            "PADDLE_TRAINER_ID": str(self.rank),
+            "PADDLE_TRAINERS_NUM": str(self.nnodes),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[self.rank]
+            if self.nnodes > 1 else endpoints,
+            "PADDLE_RANK_IN_NODE": "0",
+        }
+
+    def run(self):
+        os.makedirs(self.log_dir, exist_ok=True)
+        endpoints = self._rendezvous() if self.nnodes > 1 else "127.0.0.1:6170"
+        env = self._build_env(endpoints)
+        container = Container(
+            [sys.executable, self.script] + self.script_args, env,
+            os.path.join(self.log_dir, f"worker.{self.rank}.log"))
+        container.start()
+        while True:
+            code = container.poll()
+            if code is None:
+                time.sleep(1)
+                # heartbeat so peers can detect dead nodes
+                if self.store is not None:
+                    self.store.set(f"heartbeat/{self.rank}",
+                                   str(time.time()))
+                continue
+            if code == 0:
+                return 0
+            if self.elastic_level > 0 and \
+                    container.restarts < self.max_restarts:
+                container.restarts += 1
+                time.sleep(3)
+                container.start()
+                continue
+            return code
+
+
+def launch(script=None, args=None, nnodes=1, rank=None, master=None,
+           elastic_level=0, max_restarts=3, log_dir="log", **kwargs):
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    master = master or os.environ.get("PADDLE_MASTER", "127.0.0.1:6170")
+    ctrl = Controller(script, args or [], nnodes, rank, master, elastic_level,
+                      max_restarts, log_dir)
+    return ctrl.run()

@@ -1,0 +1,25 @@
+"""python -m paddle_tpu.distributed.launch --nnodes N --rank R script.py args"""
+import argparse
+import sys
+
+from . import launch
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int, default=None)
+    parser.add_argument("--master", type=str, default=None)
+    parser.add_argument("--elastic_level", type=int, default=0)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    sys.exit(launch(args.script, args.script_args, args.nnodes, args.rank,
+                    args.master, args.elastic_level, args.max_restarts,
+                    args.log_dir))
+
+
+if __name__ == "__main__":
+    main()

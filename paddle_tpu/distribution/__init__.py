@@ -1,0 +1,462 @@
+"""paddle.distribution (reference: python/paddle/distribution/ — ~10
+distributions + kl_divergence + transforms)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from ..ops import random as rnd
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
+           "LogNormal", "Multinomial", "Gumbel", "Geometric", "Poisson",
+           "kl_divergence", "register_kl"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _shape(self, shape):
+        if isinstance(shape, int):
+            return (shape,)
+        return tuple(shape)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=(), seed=0):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.normal(key, shp) * self.scale._value
+                      + self.loc._value)
+
+    def log_prob(self, value):
+        def _lp(v, loc, scale):
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply("normal_log_prob", _lp, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        def _ent(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return apply("normal_entropy", _ent, self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply("sq", jnp.square, self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    def sample(self, shape=()):
+        from ..ops.math import exp
+
+        return exp(self.base.sample(shape))
+
+    def log_prob(self, value):
+        def _lp(v, loc, scale):
+            logv = jnp.log(v)
+            var = scale ** 2
+            return -((logv - loc) ** 2) / (2 * var) - jnp.log(scale * v) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply("lognormal_log_prob", _lp, _t(value), self.base.loc,
+                     self.base.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.low._value.shape, self.high._value.shape)))
+
+    def sample(self, shape=(), seed=0):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        u = jax.random.uniform(key, shp)
+        return Tensor(self.low._value + u * (self.high._value
+                                             - self.low._value))
+
+    def log_prob(self, value):
+        def _lp(v, lo, hi):
+            inside = (v >= lo) & (v <= hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply("uniform_log_prob", _lp, _t(value), self.low, self.high)
+
+    def entropy(self):
+        def _ent(lo, hi):
+            return jnp.log(hi - lo)
+        return apply("uniform_entropy", _ent, self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is not None:
+            self.logits = apply("log", lambda p: jnp.log(
+                jnp.clip(p, 1e-30, None)), _t(probs))
+        else:
+            self.logits = _t(logits)
+        super().__init__(tuple(self.logits._value.shape[:-1]))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(
+            key, self.logits._value.astype(jnp.float32),
+            shape=shp if shp else None).astype(jnp.int64))
+
+    @property
+    def probs(self):
+        return apply("softmax", lambda l: jax.nn.softmax(l, -1), self.logits)
+
+    def log_prob(self, value):
+        def _lp(lg, v):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), -1)[..., 0]
+        return apply("categorical_log_prob", _lp, self.logits, _t(value))
+
+    def entropy(self):
+        def _ent(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+        return apply("categorical_entropy", _ent, self.logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_t = _t(probs)
+        else:
+            self.probs_t = apply("sigmoid", jax.nn.sigmoid, _t(logits))
+        super().__init__(tuple(self.probs_t._value.shape))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_t._value, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _lp(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply("bernoulli_log_prob", _lp, self.probs_t, _t(value))
+
+    def entropy(self):
+        def _ent(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply("bernoulli_entropy", _ent, self.probs_t)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.alpha._value.shape, self.beta._value.shape)))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(key, self.alpha._value,
+                                      self.beta._value, shp))
+
+    def log_prob(self, value):
+        def _lp(v, a, b):
+            from jax.scipy.special import betaln
+
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln(a, b)
+        return apply("beta_log_prob", _lp, _t(value), self.alpha, self.beta)
+
+    @property
+    def mean(self):
+        def _m(a, b):
+            return a / (a + b)
+        return apply("beta_mean", _m, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration._value.shape[:-1]),
+                         tuple(self.concentration._value.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(key, self.concentration._value,
+                                           shp if shp else None))
+
+    def log_prob(self, value):
+        def _lp(v, c):
+            from jax.scipy.special import gammaln
+
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+        return apply("dirichlet_log_prob", _lp, _t(value), self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate._value.shape))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(key, shp) / self.rate._value)
+
+    def log_prob(self, value):
+        def _lp(v, r):
+            return jnp.log(r) - r * v
+        return apply("exponential_log_prob", _lp, _t(value), self.rate)
+
+    @property
+    def mean(self):
+        return apply("recip", jnp.reciprocal, self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.concentration._value.shape, self.rate._value.shape)))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(key, self.concentration._value, shp)
+                      / self.rate._value)
+
+    def log_prob(self, value):
+        def _lp(v, a, r):
+            from jax.scipy.special import gammaln
+
+            return a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - gammaln(a)
+        return apply("gamma_log_prob", _lp, _t(value), self.concentration,
+                     self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.laplace(key, shp) * self.scale._value
+                      + self.loc._value)
+
+    def log_prob(self, value):
+        def _lp(v, loc, b):
+            return -jnp.abs(v - loc) / b - jnp.log(2 * b)
+        return apply("laplace_log_prob", _lp, _t(value), self.loc, self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.gumbel(key, shp) * self.scale._value
+                      + self.loc._value)
+
+    def log_prob(self, value):
+        def _lp(v, loc, b):
+            z = (v - loc) / b
+            return -(z + jnp.exp(-z)) - jnp.log(b)
+        return apply("gumbel_log_prob", _lp, _t(value), self.loc, self.scale)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = _t(probs)
+        super().__init__(tuple(self.probs_t._value.shape))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.geometric(key, self.probs_t._value,
+                                           shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _lp(p, v):
+            return (v - 1) * jnp.log1p(-p) + jnp.log(p)
+        return apply("geometric_log_prob", _lp, self.probs_t, _t(value))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate._value.shape))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        shp = self._shape(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(key, self.rate._value,
+                                         shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _lp(v, r):
+            from jax.scipy.special import gammaln
+
+            return v * jnp.log(r) - r - gammaln(v + 1)
+        return apply("poisson_log_prob", _lp, _t(value), self.rate)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = total_count
+        self.probs_t = _t(probs)
+        super().__init__(tuple(self.probs_t._value.shape[:-1]),
+                         tuple(self.probs_t._value.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = rnd.next_key()
+        n = self.total_count
+        cat = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.probs_t._value, 1e-30, None)),
+            shape=self._shape(shape) + self.batch_shape + (n,))
+        k = self.probs_t._value.shape[-1]
+        return Tensor(jax.nn.one_hot(cat, k).sum(-2))
+
+    def log_prob(self, value):
+        def _lp(v, p):
+            from jax.scipy.special import gammaln
+
+            n = jnp.sum(v, -1)
+            return (gammaln(n + 1) - jnp.sum(gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(jnp.clip(p, 1e-30, None)), -1))
+        return apply("multinomial_log_prob", _lp, _t(value), self.probs_t)
+
+
+# ------------------------------------------------------------- KL registry
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def _kl(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply("kl_normal", _kl, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def _kl(pl, ql):
+        logp = jax.nn.log_softmax(pl, -1)
+        logq = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), -1)
+    return apply("kl_categorical", _kl, p.logits, q.logits)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def _kl(plo, phi, qlo, qhi):
+        return jnp.log((qhi - qlo) / (phi - plo))
+    return apply("kl_uniform", _kl, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def _kl(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return pp * jnp.log(pp / qp) + (1 - pp) * jnp.log(
+            (1 - pp) / (1 - qp))
+    return apply("kl_bernoulli", _kl, p.probs_t, q.probs_t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def _kl(pr, qr):
+        ratio = qr / pr
+        return ratio - jnp.log(ratio) - 1
+    return apply("kl_exponential", _kl, p.rate, q.rate)

@@ -1,0 +1,98 @@
+"""paddle.incubate (reference: python/paddle/incubate/): autotune config,
+segment ops, fused transformer ops, 2:4 sparsity (asp)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+
+_autotune_config = {"kernel": {"enable": False},
+                    "layout": {"enable": False},
+                    "dataloader": {"enable": False}}
+
+
+def autotune_set_config(config=None):
+    """reference: python/paddle/incubate/autotune.py set_config.  Kernel
+    autotune maps to XLA's autotuning (latency-hiding scheduler + gemm
+    algorithm picking), already on by default."""
+    if config:
+        _autotune_config.update(config)
+
+
+set_config = autotune_set_config
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def segment_sum(data, segment_ids, name=None):
+    def _fn(v, ids):
+        n = int(jax.core.get_aval(ids).shape[0]) if False else None
+        num = jnp.max(ids) + 1 if not hasattr(ids, "aval") else None
+        # static segment count required under jit: use data length bound
+        return jax.ops.segment_sum(v, ids, num_segments=None)
+    # eager only when num_segments dynamic
+    import numpy as np
+
+    ids = np.asarray(_t(segment_ids).numpy())
+    num = int(ids.max()) + 1 if ids.size else 0
+    return apply("segment_sum",
+                 lambda v, i: jax.ops.segment_sum(v, i, num_segments=num),
+                 _t(data), _t(segment_ids))
+
+
+def _segment_reduce(name, combiner, init):
+    def op(data, segment_ids, name_arg=None):
+        import numpy as np
+
+        ids = np.asarray(_t(segment_ids).numpy())
+        num = int(ids.max()) + 1 if ids.size else 0
+
+        def _fn(v, i):
+            one_hot = jax.nn.one_hot(i, num, dtype=v.dtype)
+            if name == "mean":
+                s = jax.ops.segment_sum(v, i, num_segments=num)
+                cnt = jax.ops.segment_sum(jnp.ones_like(v), i,
+                                          num_segments=num)
+                return s / jnp.maximum(cnt, 1)
+            if name == "max":
+                return jax.ops.segment_max(v, i, num_segments=num)
+            return jax.ops.segment_min(v, i, num_segments=num)
+        return apply(f"segment_{name}", _fn, _t(data), _t(segment_ids))
+    return op
+
+
+segment_mean = _segment_reduce("mean", None, 0)
+segment_max = _segment_reduce("max", None, -jnp.inf)
+segment_min = _segment_reduce("min", None, jnp.inf)
+
+
+def identity_loss(x, reduction="none"):
+    from ..ops import math as m
+
+    if reduction == "mean":
+        return m.mean(x)
+    if reduction == "sum":
+        return m.sum(x)
+    return _t(x)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None):
+    def _fn(v, src, dst):
+        import numpy as np
+
+        gathered = jnp.take(v, src, axis=0)
+        n = out_size or v.shape[0]
+        return jax.ops.segment_sum(gathered, dst, num_segments=n)
+    return apply("graph_send_recv", _fn, _t(x), _t(src_index), _t(dst_index))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    def _fn(v, m):
+        return jax.nn.softmax(v + m, axis=-1)
+    return apply("softmax_mask_fuse", _fn, _t(x), _t(mask))

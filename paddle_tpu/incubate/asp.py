@@ -1,0 +1,63 @@
+"""2:4 structured sparsity (reference: python/paddle/fluid/contrib/sparsity —
+ASP masks + OptimizerWithSparsityGuarantee).
+
+TPU note: the MXU has no 2:4 sparse mode (that is A100 tensor-core
+hardware); masks are still useful for model compression, so the masking
+machinery is implemented and the speedup claim is explicitly not made.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_masks = {}
+
+
+def compute_mask_2_4(arr: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest |values| in every group of 4 along the last axis."""
+    flat = arr.reshape(-1, arr.shape[-1])
+    out = np.zeros_like(flat, dtype=bool)
+    for r in range(flat.shape[0]):
+        row = flat[r]
+        n4 = (len(row) // 4) * 4
+        groups = np.abs(row[:n4]).reshape(-1, 4)
+        idx = np.argsort(-groups, axis=1)[:, :2]
+        for g, (i, j) in enumerate(idx):
+            out[r, g * 4 + i] = True
+            out[r, g * 4 + j] = True
+        out[r, n4:] = True
+    return out.reshape(arr.shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    for name, p in model.named_parameters():
+        if p.ndim < 2:
+            continue
+        mask = compute_mask_2_4(p.numpy())
+        _masks[id(p)] = jnp.asarray(mask)
+        p._value = p._value * _masks[id(p)].astype(p._value.dtype)
+    return model
+
+
+def decorate(optimizer):
+    """Re-apply masks after each step (OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p, _, _ in optimizer._collect_params_grads():
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask.astype(p._value.dtype)
+
+    optimizer.step = step
+    return optimizer
+
+
+def check_sparsity(arr: np.ndarray, n=2, m=4) -> bool:
+    flat = np.asarray(arr).reshape(-1)
+    n4 = (len(flat) // m) * m
+    groups = flat[:n4].reshape(-1, m)
+    return bool(np.all((groups != 0).sum(axis=1) <= n))

@@ -1,0 +1,78 @@
+"""paddle.incubate.nn: fused transformer layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py backed by
+fused_attention_op.cu / fused_feedforward_op.cu).
+
+On TPU the fusion comes from the Pallas flash-attention kernel + XLA
+elementwise fusion, so these are thin compositions with the reference API.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from . import functional  # noqa: F401
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, normalize_before=False, kdim=None,
+                 vdim=None, need_weights=False, qkv_weight_attr=None, **kwargs):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.norm = nn.LayerNorm(embed_dim)
+        self.attn = nn.MultiHeadAttention(embed_dim, num_heads,
+                                          attn_dropout_rate, kdim, vdim)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        residual = query
+        if self.normalize_before:
+            query = self.norm(query)
+        out = self.attn(query, key, value, attn_mask)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", act_dropout_rate=None,
+                 normalize_before=False, **kwargs):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.norm = nn.LayerNorm(d_model)
+        self.fc1 = nn.Linear(d_model, dim_feedforward)
+        self.fc2 = nn.Linear(dim_feedforward, d_model)
+        self.dropout = nn.Dropout(act_dropout_rate or dropout_rate)
+        self.dropout2 = nn.Dropout(dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        out = self.fc2(self.dropout(self.activation(self.fc1(src))))
+        out = residual + self.dropout2(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kwargs):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate, attn_dropout_rate or dropout_rate,
+            normalize_before)
+        self.ffn = FusedFeedForward(d_model, dim_feedforward, dropout_rate,
+                                    activation, act_dropout_rate,
+                                    normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedLinear(nn.Linear):
+    pass

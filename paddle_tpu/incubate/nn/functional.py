@@ -1,0 +1,29 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/)."""
+from __future__ import annotations
+
+from ...nn.functional.attention import scaled_dot_product_attention
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "use nn.MultiHeadAttention / F.scaled_dot_product_attention — the "
+        "Pallas flash kernel is the fused path on TPU")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "XLA fuses the FFN chain automatically; use incubate.nn."
+        "FusedFeedForward for the layer API")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ...nn.functional.common import linear
+
+    if transpose_weight:
+        from ...ops.manipulation import t as _t
+
+        weight = _t(weight)
+    return linear(x, weight, bias)
+
+
+flash_attention = scaled_dot_product_attention

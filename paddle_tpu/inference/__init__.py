@@ -1,0 +1,159 @@
+"""paddle.inference: the serving runtime (reference:
+paddle/fluid/inference/api/analysis_predictor.cc + paddle_inference_api.h).
+
+TPU-native: the "optimized inference program" IS the jit.save StableHLO
+artifact; AnalysisPredictor's 40-pass pipeline collapses into XLA compilation
+(with a persistent compile cache).  Zero-copy handles wrap device arrays.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "create_predictor", "Predictor", "PredictorPool",
+           "get_version"]
+
+
+def get_version():
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+class Config:
+    """AnalysisConfig analog."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model_dir = None
+        self._compile_cache_dir = None
+        self._memory_pool_mb = 0
+
+    def set_model(self, prog_file, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+
+    def set_model_dir(self, d):
+        self._model_dir = d
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_compile_cache(self, cache_dir):
+        """Persistent XLA compile cache (the TRT engine-cache analog)."""
+        self._compile_cache_dir = cache_dir
+
+    # accepted-and-ignored GPU-era toggles for parity
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_mb
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, **kwargs):
+        pass  # XLA is the engine
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _IOHandle:
+    """ZeroCopyTensor analog."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._array = jnp.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def share_external_data(self, data):
+        self._array = data._value if hasattr(data, "_value") else data
+
+    @property
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self.config = config
+        if config._compile_cache_dir:
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  config._compile_cache_dir)
+            except Exception:
+                pass
+        path = config.prog_file or config._model_dir
+        self._loaded = jit_load(path)
+        n_in = len(self._loaded._exported.in_avals) if hasattr(
+            self._loaded._exported, "in_avals") else 1
+        self._inputs = {f"input_{i}": _IOHandle(f"input_{i}")
+                        for i in range(n_in)}
+        self._outputs: Dict[str, _IOHandle] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs) or ["output_0"]
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs.setdefault(name, _IOHandle(name))
+
+    def run(self, inputs: Optional[list] = None):
+        """ZeroCopyRun: execute the compiled program."""
+        if inputs is not None:
+            arrs = [x._value if hasattr(x, "_value") else jnp.asarray(x)
+                    for x in inputs]
+        else:
+            arrs = [h._array for h in self._inputs.values()]
+        out = self._loaded._exported.call(*arrs)
+        leaves = jax.tree_util.tree_leaves(out)
+        for i, leaf in enumerate(leaves):
+            self.get_output_handle(f"output_{i}")._array = leaf
+        if inputs is not None:
+            from ..core.tensor import Tensor
+
+            return [Tensor(l) for l in leaves]
+        return True
+
+    def clone(self):
+        return Predictor(self.config)
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx) -> Predictor:
+        return self._predictors[idx]

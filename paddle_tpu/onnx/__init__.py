@@ -1,0 +1,12 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py → paddle2onnx).
+
+ONNX export from StableHLO needs an external converter not present in this
+environment; jit.save's StableHLO artifact is the portable format.
+"""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export unavailable (no paddle2onnx equivalent in-image); use "
+        "paddle_tpu.jit.save — the serialized StableHLO artifact is portable "
+        "across PJRT runtimes")

@@ -1,0 +1,275 @@
+"""paddle.profiler (reference: python/paddle/profiler/profiler.py:270 +
+platform/profiler/ host tracer + CUPTI).
+
+TPU-native: host ranges recorded with perf_counter_ns (the HostTraceLevel
+analog); device activity comes from jax.profiler (XLA/Xprof) traces.  Export
+keeps the chrome://tracing JSON format the reference emits
+(chrometracing_logger.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEventRecorder:
+    """Lock-free-ish per-thread buffers (reference: host_event_recorder.h)."""
+
+    def __init__(self):
+        self._local = threading.local()
+        self._all_buffers = []
+        self._lock = threading.Lock()
+
+    def _buffer(self):
+        buf = getattr(self._local, "buf", None)
+        if buf is None:
+            buf = []
+            self._local.buf = buf
+            with self._lock:
+                self._all_buffers.append((threading.get_ident(), buf))
+        return buf
+
+    def record(self, name, start_ns, end_ns, category="host"):
+        self._buffer().append((name, start_ns, end_ns, category))
+
+    def drain(self):
+        with self._lock:
+            out = []
+            for tid, buf in self._all_buffers:
+                out.extend((tid,) + e for e in buf)
+                buf.clear()
+        return out
+
+
+_recorder = _HostEventRecorder()
+_active_profiler: Optional["Profiler"] = None
+
+
+class RecordEvent:
+    """Annotated host range (reference: event_tracing.h RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+
+    def end(self):
+        if self._start is not None:
+            _recorder.record(self.name, self._start, time.perf_counter_ns())
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+        return False
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int],
+                                                                     ProfilerState]:
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        prof._export_chrome(fname)
+        return fname
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        self.targets = targets or [ProfilerTarget.CPU]
+        if scheduler is None:
+            self.scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, tuple):
+            lo, hi = scheduler
+            self.scheduler = lambda step: (
+                ProfilerState.RECORD if lo <= step < hi
+                else ProfilerState.CLOSED)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self.events: List[tuple] = []
+        self._step_times: List[float] = []
+        self._last_step_t = None
+        self._jax_trace_dir = None
+
+    # -- lifecycle
+    def start(self):
+        self.state = self.scheduler(self.step_num)
+        self._maybe_start_device_trace()
+        self._last_step_t = time.perf_counter()
+        global _active_profiler
+        _active_profiler = self
+
+    def stop(self):
+        self.events.extend(_recorder.drain())
+        self._maybe_stop_device_trace()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        global _active_profiler
+        _active_profiler = None
+        self.state = ProfilerState.CLOSED
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self.events.extend(_recorder.drain())
+        self.step_num += 1
+        new_state = self.scheduler(self.step_num)
+        if new_state != self.state:
+            if new_state == ProfilerState.CLOSED:
+                self._maybe_stop_device_trace()
+            elif self.state == ProfilerState.CLOSED:
+                self._maybe_start_device_trace()
+            self.state = new_state
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    # -- device (XLA) trace via jax.profiler
+    def _maybe_start_device_trace(self):
+        if ProfilerTarget.TPU in self.targets and \
+                self.state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN):
+            import tempfile
+
+            import jax
+
+            self._jax_trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_trace_")
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+
+    def _maybe_stop_device_trace(self):
+        if self._jax_trace_dir is not None:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_trace_dir = None
+
+    # -- reporting
+    def _export_chrome(self, path):
+        trace_events = []
+        for tid, name, start_ns, end_ns, cat in self.events:
+            trace_events.append({
+                "name": name, "cat": cat, "ph": "X",
+                "ts": start_ns / 1000.0, "dur": (end_ns - start_ns) / 1000.0,
+                "pid": os.getpid(), "tid": tid,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace_events}, f)
+        return path
+
+    def export(self, path, format="json"):
+        return self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg = {}
+        for tid, name, start_ns, end_ns, cat in self.events:
+            d = agg.setdefault(name, [0, 0.0])
+            d[0] += 1
+            d[1] += (end_ns - start_ns) / 1e6
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv:
+                                           -kv[1][1]):
+            lines.append(f"{name:<40}{calls:>8}{total:>12.3f}"
+                         f"{total / calls:>12.3f}")
+        if self._step_times:
+            import numpy as np
+
+            lines.append(f"steps: {len(self._step_times)}, avg "
+                         f"{np.mean(self._step_times) * 1000:.2f}ms")
+        report = "\n".join(lines)
+        print(report)
+        return report
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class benchmark:
+    """paddle.profiler.benchmark timer (ips) analog."""
+
+    def __init__(self):
+        self._times = []
+        self._t = None
+
+    def begin(self):
+        self._t = time.perf_counter()
+
+    def end(self, num_samples=1):
+        if self._t is not None:
+            self._times.append((time.perf_counter() - self._t, num_samples))
+
+    def ips(self):
+        total_t = sum(t for t, _ in self._times)
+        total_n = sum(n for _, n in self._times)
+        return total_n / total_t if total_t else 0.0

@@ -1,0 +1,127 @@
+"""paddle.sparse (reference: python/paddle/sparse — COO/CSR tensors, sparse
+ops; phi sparse kernels).
+
+Backed by jax.experimental.sparse BCOO (XLA-lowered scatter/gather); CSR is
+kept as a format view.  Dense fallbacks where BCOO lacks an op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+try:
+    from jax.experimental import sparse as jsparse
+
+    _HAS_BCOO = True
+except ImportError:  # pragma: no cover
+    _HAS_BCOO = False
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "matmul", "masked_matmul", "relu",
+           "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo, shape):
+        self._bcoo = bcoo
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def nnz(self):
+        return self._bcoo.nse
+
+    @property
+    def dtype(self):
+        from ..core.dtype import convert_dtype
+
+        return convert_dtype(self._bcoo.data.dtype)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    idx = indices.numpy() if isinstance(indices, Tensor) else np.asarray(indices)
+    vals = values._value if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..core.dtype import to_np
+
+        vals = vals.astype(to_np(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    crows_np = crows.numpy() if isinstance(crows, Tensor) else np.asarray(crows)
+    cols_np = cols.numpy() if isinstance(cols, Tensor) else np.asarray(cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return sparse_coo_tensor(indices, values, shape, dtype)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def add(x: SparseCooTensor, y):
+    if isinstance(y, SparseCooTensor):
+        out = jsparse.bcoo_add_any_sparse(x._bcoo, y._bcoo) if hasattr(
+            jsparse, "bcoo_add_any_sparse") else \
+            jsparse.BCOO.fromdense(x._bcoo.todense() + y._bcoo.todense())
+        return SparseCooTensor(out, x._shape)
+    return Tensor(x._bcoo.todense() + y._value)
+
+
+def matmul(x, y):
+    if isinstance(x, SparseCooTensor):
+        dense_y = y._value if isinstance(y, Tensor) else y
+        return Tensor(x._bcoo @ dense_y)
+    raise TypeError("sparse.matmul expects a sparse lhs")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseCooTensor):
+    out = x._value @ y._value
+    dense_mask = (mask._bcoo.todense() != 0).astype(out.dtype)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out * dense_mask),
+                           tuple(out.shape))
+
+
+def relu(x: SparseCooTensor):
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+                     shape=x._shape), x._shape)
+
+
+class nn:
+    """paddle.sparse.nn subset (sparse conv is a planned kernel)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Conv3D:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                "sparse submanifold conv: planned Pallas kernel (reference "
+                "phi/kernels/sparse/conv_kernel)")

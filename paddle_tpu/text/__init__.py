@@ -1,0 +1,270 @@
+"""paddle.text (reference: python/paddle/text/ — NLP datasets) + a host-side
+tokenizer (the reference's in-graph faster_tokenizer_op,
+paddle/fluid/operators/string/faster_tokenizer_op.cc:525, becomes host
+preprocessing feeding infeed on TPU)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "WMT14", "WMT16", "Conll05st", "Movielens",
+           "BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+class _LocalFileDataset(Dataset):
+    name = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        if data_file is None:
+            raise ValueError(
+                f"no network egress: pass data_file with a local copy of "
+                f"{self.name}")
+        self.data_file = data_file
+        self.mode = mode
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(_LocalFileDataset):
+    name = "uci_housing (housing.data)"
+
+    def _load(self):
+        raw = np.loadtxt(self.data_file)
+        x = raw[:, :-1].astype(np.float32)
+        y = raw[:, -1:].astype(np.float32)
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        n = int(len(x) * 0.8)
+        if self.mode == "train":
+            self.samples = list(zip(x[:n], y[:n]))
+        else:
+            self.samples = list(zip(x[n:], y[n:]))
+
+
+class Imdb(_LocalFileDataset):
+    name = "imdb (aclImdb tarball)"
+
+    def _load(self):
+        import re
+        import tarfile
+
+        pattern = re.compile(
+            rf"aclImdb/{self.mode}/(pos|neg)/.*\.txt$")
+        self.samples = []
+        with tarfile.open(self.data_file) as tar:
+            for member in tar.getmembers():
+                m = pattern.match(member.name)
+                if m:
+                    text = tar.extractfile(member).read().decode(
+                        "utf-8", "ignore")
+                    label = 1 if m.group(1) == "pos" else 0
+                    self.samples.append((text, np.asarray(label, np.int64)))
+
+
+class WMT14(_LocalFileDataset):
+    name = "wmt14"
+
+    def _load(self):
+        raise NotImplementedError("provide a local WMT14 archive")
+
+
+class WMT16(WMT14):
+    name = "wmt16"
+
+
+class Conll05st(_LocalFileDataset):
+    name = "conll05st"
+
+    def _load(self):
+        raise NotImplementedError("provide a local Conll05 archive")
+
+
+class Movielens(_LocalFileDataset):
+    name = "movielens"
+
+    def _load(self):
+        raise NotImplementedError("provide a local Movielens archive")
+
+
+# ---------------------------------------------------------------- tokenizer
+class BasicTokenizer:
+    """Whitespace + punctuation splitting with lowercasing/accent folding."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        import unicodedata
+
+        if self.do_lower_case:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(c for c in text
+                           if unicodedata.category(c) != "Mn")
+        out = []
+        word = []
+        for ch in text:
+            if ch.isspace():
+                if word:
+                    out.append("".join(word))
+                    word = []
+            elif not ch.isalnum():
+                if word:
+                    out.append("".join(word))
+                    word = []
+                out.append(ch)
+            else:
+                word.append(ch)
+        if word:
+            out.append("".join(word))
+        return out
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first wordpiece (reference:
+    faster_tokenizer_op.cc WordPieceTokenizer)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token="[UNK]",
+                 max_input_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[str]:
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        tokens = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            tokens.append(cur)
+            start = end
+        return tokens
+
+
+class BertTokenizer:
+    def __init__(self, vocab_file=None, vocab: Optional[Dict[str, int]] = None,
+                 do_lower_case=True, unk_token="[UNK]", cls_token="[CLS]",
+                 sep_token="[SEP]", pad_token="[PAD]"):
+        if vocab is None:
+            if vocab_file is None:
+                raise ValueError("pass vocab_file or vocab dict")
+            vocab = {}
+            with open(vocab_file, encoding="utf-8") as f:
+                for i, line in enumerate(f):
+                    vocab[line.rstrip("\n")] = i
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(vocab, unk_token)
+        self.unk_token = unk_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.pad_token = pad_token
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for word in self.basic.tokenize(text):
+            out.extend(self.wordpiece.tokenize(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens):
+        unk = self.vocab.get(self.unk_token, 0)
+        return [self.vocab.get(t, unk) for t in tokens]
+
+    def __call__(self, text, text_pair=None, max_length=None,
+                 padding=False, truncation=False):
+        tokens = [self.cls_token] + self.tokenize(text) + [self.sep_token]
+        type_ids = [0] * len(tokens)
+        if text_pair:
+            pair = self.tokenize(text_pair) + [self.sep_token]
+            tokens += pair
+            type_ids += [1] * len(pair)
+        ids = self.convert_tokens_to_ids(tokens)
+        if truncation and max_length:
+            ids = ids[:max_length]
+            type_ids = type_ids[:max_length]
+        attn = [1] * len(ids)
+        if padding and max_length and len(ids) < max_length:
+            pad_id = self.vocab.get(self.pad_token, 0)
+            pad_n = max_length - len(ids)
+            ids += [pad_id] * pad_n
+            type_ids += [0] * pad_n
+            attn += [0] * pad_n
+        return {"input_ids": ids, "token_type_ids": type_ids,
+                "attention_mask": attn}
+
+
+# ---------------------------------------------------------------- viterbi
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF viterbi decode via lax.scan (reference: viterbi_decode op)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor, to_tensor
+
+    pot = potentials if isinstance(potentials, Tensor) \
+        else to_tensor(potentials)
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else to_tensor(transition_params)
+
+    def _viterbi(p, tr):
+        # p: [B, T, N]; tr: [N, N]
+        def step(carry, emit):
+            score = carry  # [B, N]
+            cand = score[:, :, None] + tr[None]  # [B, N_from, N_to]
+            best = jnp.max(cand, axis=1) + emit
+            back = jnp.argmax(cand, axis=1)
+            return best, back
+
+        init = p[:, 0]
+        score, backs = jax.lax.scan(step, init,
+                                    jnp.moveaxis(p[:, 1:], 1, 0))
+        last = jnp.argmax(score, axis=-1)
+
+        def backtrack(carry, back):
+            idx = carry
+            prev = jnp.take_along_axis(back, idx[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path = jax.lax.scan(backtrack, last, backs, reverse=True)
+        path = jnp.concatenate([jnp.moveaxis(path, 0, 1), last[:, None]], 1)
+        return jnp.max(score, -1), path.astype(jnp.int64)
+
+    return apply("viterbi_decode", _viterbi, pot, trans,
+                 _differentiable=False)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,48 @@
+"""paddle.utils (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or str(e)) from e
+
+
+def run_check():
+    """paddle.utils.run_check analog: verify the accelerator works."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    x = paddle.ones([2, 2])
+    y = (x @ x).numpy()
+    assert y[0, 0] == 2.0
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! backend="
+          f"{jax.default_backend()}, {n} device(s)")
+    return True
+
+
+def unique_name_generator(prefix="tmp"):
+    import itertools
+
+    counter = itertools.count()
+
+    def gen():
+        return f"{prefix}_{next(counter)}"
+
+    return gen
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason=""):
+        self.update_to = update_to
+
+    def __call__(self, fn):
+        return fn

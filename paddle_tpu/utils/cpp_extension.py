@@ -1,0 +1,70 @@
+"""Custom C++ op loading (reference: python/paddle/utils/cpp_extension —
+JIT builds of user .cc ops against paddle/extension.h; custom_operator.cc
+loads them at runtime).
+
+TPU-native custom-op story: (1) host-side C++ via this module (ctypes ABI —
+the TCPStore pattern), (2) device-side custom kernels are Pallas functions
+registered with register_pallas_op.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Dict
+
+_PALLAS_OPS: Dict[str, Callable] = {}
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False):
+    """Compile C++ sources into a shared lib and load with ctypes."""
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    needs_build = not os.path.exists(so_path) or any(
+        os.path.getmtime(s) > os.path.getmtime(so_path) for s in srcs)
+    if needs_build:
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + (extra_cxx_cflags or [])
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + srcs + ["-o", so_path])
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+
+
+class CUDAExtension(CppExtension):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "no CUDA on TPU: device kernels are Pallas (register_pallas_op)")
+
+
+def register_pallas_op(name: str, fn: Callable):
+    """Register a Pallas kernel as a named custom op, callable through
+    paddle_tpu.utils.cpp_extension.get_op(name) — the custom-kernel registry
+    analog (reference: phi/core/custom_kernel.cc)."""
+    _PALLAS_OPS[name] = fn
+    return fn
+
+
+def get_op(name: str) -> Callable:
+    return _PALLAS_OPS[name]
+
+
+class BuildExtension:
+    @staticmethod
+    def with_options(**kwargs):
+        return BuildExtension
+
+
+def setup(**kwargs):
+    raise NotImplementedError("use cpp_extension.load for JIT builds")

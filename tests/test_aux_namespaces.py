@@ -1,0 +1,246 @@
+"""distribution / fft / sparse / profiler / inference / incubate / text."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+
+        d = Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.mean().numpy())) < 0.2
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        assert abs(float(lp.numpy()) - (-0.9189385)) < 1e-4
+        assert abs(float(d.entropy().numpy()) - 1.4189385) < 1e-4
+
+    def test_categorical_uniform_bernoulli(self):
+        from paddle_tpu.distribution import Bernoulli, Categorical, Uniform
+
+        c = Categorical(logits=paddle.to_tensor([0.0, 0.0, 0.0]))
+        s = c.sample([100])
+        assert s.shape == [100]
+        u = Uniform(0.0, 2.0)
+        assert abs(float(u.entropy().numpy()) - np.log(2)) < 1e-5
+        b = Bernoulli(probs=paddle.to_tensor(0.3))
+        lp = b.log_prob(paddle.to_tensor(1.0))
+        assert abs(float(lp.numpy()) - np.log(0.3)) < 1e-5
+
+    def test_gamma_beta_sampling(self):
+        from paddle_tpu.distribution import Beta, Gamma
+
+        g = Gamma(2.0, 1.0)
+        s = g.sample([500])
+        assert abs(float(s.mean().numpy()) - 2.0) < 0.5
+        bt = Beta(2.0, 2.0)
+        assert abs(float(bt.mean.numpy()) - 0.5) < 1e-6
+
+    def test_kl_divergence(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 1.0)
+        kl = kl_divergence(p, q)
+        assert abs(float(kl.numpy()) - 0.5) < 1e-5
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        from paddle_tpu import fft
+
+        x = paddle.to_tensor(r(16))
+        X = fft.fft(x)
+        back = fft.ifft(X)
+        np.testing.assert_allclose(np.real(back.numpy()), x.numpy(),
+                                   atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        from paddle_tpu import fft
+
+        x = r(32)
+        np.testing.assert_allclose(
+            fft.rfft(paddle.to_tensor(x)).numpy(), np.fft.rfft(x).astype(
+                np.complex64), atol=1e-4)
+
+    def test_fft2_shift(self):
+        from paddle_tpu import fft
+
+        x = paddle.to_tensor(r(8, 8))
+        X = fft.fft2(x)
+        assert X.shape == [8, 8]
+        assert fft.fftshift(X).shape == [8, 8]
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        from paddle_tpu.sparse import sparse_coo_tensor
+
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        sp = sparse_coo_tensor(indices, values, [3, 3])
+        dense = sp.to_dense().numpy()
+        assert dense[0, 1] == 1.0 and dense[2, 0] == 3.0
+        assert sp.nnz() == 3
+
+    def test_spmm(self):
+        from paddle_tpu.sparse import matmul, sparse_coo_tensor
+
+        sp = sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], [2, 2])
+        dense = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        out = matmul(sp, dense)
+        np.testing.assert_allclose(out.numpy(), [[2, 0], [0, 3]])
+
+    def test_csr(self):
+        from paddle_tpu.sparse import sparse_csr_tensor
+
+        sp = sparse_csr_tensor([0, 1, 2], [0, 1], [5.0, 6.0], [2, 2])
+        np.testing.assert_allclose(sp.to_dense().numpy(), [[5, 0], [0, 6]])
+
+
+class TestProfiler:
+    def test_record_and_summary(self, tmp_path):
+        import time
+
+        from paddle_tpu.profiler import Profiler, RecordEvent
+
+        prof = Profiler()
+        prof.start()
+        with RecordEvent("my_range"):
+            time.sleep(0.01)
+        prof.step()
+        prof.stop()
+        report = prof.summary()
+        assert "my_range" in report
+        path = prof.export(str(tmp_path / "trace.json"))
+        import json
+
+        with open(path) as f:
+            data = json.load(f)
+        assert any(e["name"] == "my_range" for e in data["traceEvents"])
+
+    def test_scheduler(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+
+
+class TestInference:
+    def test_predictor_roundtrip(self, tmp_path):
+        from paddle_tpu import jit
+        from paddle_tpu.inference import Config, create_predictor
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        path = str(tmp_path / "served")
+        jit.save(net, path, input_spec=[jit.InputSpec([2, 4], "float32")])
+
+        config = Config(path)
+        predictor = create_predictor(config)
+        x = r(2, 4)
+        h = predictor.get_input_handle(predictor.get_input_names()[0])
+        h.copy_from_cpu(x)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        expect = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestIncubate:
+    def test_segment_ops(self):
+        from paddle_tpu.incubate import segment_max, segment_mean, segment_sum
+
+        data = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        np.testing.assert_allclose(segment_sum(data, ids).numpy(), [3, 7])
+        np.testing.assert_allclose(segment_mean(data, ids).numpy(), [1.5, 3.5])
+        np.testing.assert_allclose(segment_max(data, ids).numpy(), [2, 4])
+
+    def test_fused_layers(self):
+        from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                            FusedMultiHeadAttention,
+                                            FusedTransformerEncoderLayer)
+
+        x = paddle.to_tensor(r(2, 5, 16))
+        assert FusedMultiHeadAttention(16, 4)(x).shape == [2, 5, 16]
+        assert FusedFeedForward(16, 32)(x).shape == [2, 5, 16]
+        assert FusedTransformerEncoderLayer(16, 4, 32)(x).shape == [2, 5, 16]
+
+    def test_asp_masks(self):
+        from paddle_tpu.incubate import asp
+
+        net = nn.Linear(8, 8)
+        asp.prune_model(net)
+        assert asp.check_sparsity(net.weight.numpy())
+
+
+class TestText:
+    def test_bert_tokenizer(self):
+        from paddle_tpu.text import BertTokenizer
+
+        vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "hello": 4,
+                 "world": 5, "##ly": 6, "friend": 7}
+        tok = BertTokenizer(vocab=vocab)
+        enc = tok("hello friendly world", max_length=10, padding=True,
+                  truncation=True)
+        assert enc["input_ids"][0] == 2  # CLS
+        assert len(enc["input_ids"]) == 10
+        assert 6 in enc["input_ids"]  # ##ly wordpiece
+
+    def test_viterbi(self):
+        from paddle_tpu.text import viterbi_decode
+
+        pot = np.array([[[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]], np.float32)
+        trans = np.zeros((2, 2), np.float32)
+        scores, path = viterbi_decode(pot, trans)
+        np.testing.assert_array_equal(path.numpy()[0], [0, 1, 0])
+
+
+class TestBert:
+    def test_bert_pretraining_step(self):
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+        from paddle_tpu.optimizer import AdamW
+        from paddle_tpu import jit
+
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        opt = AdamW(1e-3, parameters=model.parameters())
+
+        @jit.to_static
+        def step(ids, mlm_labels, nsp_labels):
+            loss, _, _ = model(ids, masked_lm_labels=mlm_labels,
+                               next_sentence_labels=nsp_labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 256, (2, 16)).astype("int32"))
+        mlm = paddle.to_tensor(
+            np.where(rng.rand(2, 16) < 0.15,
+                     rng.randint(0, 256, (2, 16)), -100).astype("int32"))
+        nsp = paddle.to_tensor(rng.randint(0, 2, (2,)).astype("int32"))
+        losses = [float(step(ids, mlm, nsp).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_bert_classifier(self):
+        from paddle_tpu.models.bert import (BertConfig,
+                                            BertForSequenceClassification)
+
+        model = BertForSequenceClassification(BertConfig.tiny(), num_classes=3)
+        ids = paddle.to_tensor(
+            np.random.randint(0, 256, (2, 8)).astype("int32"))
+        mask = paddle.to_tensor(np.ones((2, 8), np.float32))
+        logits = model(ids, attention_mask=mask)
+        assert logits.shape == [2, 3]
