@@ -65,6 +65,8 @@ from . import incubate  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
+from . import quantization  # noqa: F401
+from .nn import utils as _nn_utils  # noqa: F401
 from .models import bert as _bert_models  # noqa: F401
 from . import models  # noqa: F401
 

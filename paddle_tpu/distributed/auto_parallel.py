@@ -1,0 +1,168 @@
+"""Semi-automatic parallelization (reference:
+python/paddle/distributed/auto_parallel/: Engine engine.py:54, ProcessMesh,
+completion.py shard propagation, partitioner.py, reshard.py, planner).
+
+The reference's pipeline — annotate a few tensors, propagate dist_attrs,
+partition the program, insert reshards — is exactly GSPMD's job: here
+shard_tensor/mark_sharding are the annotations, XLA's sharding propagation
+is `completion`, SPMD partitioner is `partitioner`, and device_put is
+`reshard`.  Engine wraps that flow with the reference's fit/evaluate API.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .mesh import ProcessMesh, get_mesh, set_mesh
+from .sharding import shard_tensor as _shard_tensor
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, placements=None):
+    """auto_parallel.shard_tensor: spec names map to mesh axes."""
+    spec = placements if placements is not None else shard_spec
+    return _shard_tensor(x, mesh=process_mesh, placements=spec)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    from .sharding import shard_op as _shard_op
+
+    return _shard_op(op_fn, process_mesh, in_shard_specs, out_shard_specs)
+
+
+class Strategy:
+    """auto_parallel Strategy (subset)."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = _Toggle()
+        self.recompute = _Toggle()
+        self.sharding = _Toggle()
+        self.gradient_merge = _Toggle()
+
+
+class _Toggle:
+    def __init__(self):
+        self.enable = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class Engine:
+    """reference engine.py:54: prepare/fit/evaluate/predict with automatic
+    distribution over the current mesh."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy or Strategy()
+        self._step_fn = None
+
+    def _build(self):
+        from .. import jit
+
+        model, loss_fn, optimizer = self.model, self.loss, self.optimizer
+
+        def train_step(x, y):
+            out = model(x)
+            l = loss_fn(out, y)
+            l.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return l
+
+        self._step_fn = jit.to_static(train_step)
+
+        def eval_step(x, y):
+            out = model(x)
+            return loss_fn(out, y)
+
+        self._eval_fn = jit.to_static(eval_step)
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        self._build()
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            valid_data=None, collate_fn=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        if self._step_fn is None:
+            self._build()
+        loader = DataLoader(train_data, batch_size=batch_size, shuffle=True) \
+            if isinstance(train_data, Dataset) else train_data
+        history = []
+        mesh = get_mesh()
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                x, y = batch[0], batch[1]
+                if mesh is not None and "dp" in mesh.shape:
+                    x = _shard_tensor(x, placements=["dp"])
+                    y = _shard_tensor(y, placements=["dp"])
+                losses.append(float(np.asarray(
+                    self._step_fn(x, y).numpy())))
+            history.append(float(np.mean(losses)) if losses else None)
+            if verbose:
+                print(f"epoch {epoch}: loss={history[-1]}")
+        return {"loss": history}
+
+    def evaluate(self, eval_data, batch_size=1, steps=None, collate_fn=None,
+                 verbose=1):
+        from ..io import DataLoader, Dataset
+
+        if self._step_fn is None:
+            self._build()
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            losses.append(float(np.asarray(
+                self._eval_fn(batch[0], batch[1]).numpy())))
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=1, steps=None, collate_fn=None):
+        from ..core.dispatch import no_grad_ctx
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(test_data, batch_size=batch_size) \
+            if isinstance(test_data, Dataset) else test_data
+        outs = []
+        with no_grad_ctx():
+            for i, batch in enumerate(loader):
+                if steps is not None and i >= steps:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self.model(x).numpy())
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        fsave(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None:
+            fsave(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import os
+
+        from ..framework.io import load as fload
+
+        self.model.set_state_dict(fload(path + ".pdparams"))
+        if load_optimizer and os.path.exists(path + ".pdopt") and \
+                self.optimizer is not None:
+            self.optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def cost(self, mode="train"):
+        """Planner cost stub: XLA's own cost model drives scheduling; expose
+        compiled HLO stats instead in a later round."""
+        return None

@@ -1,3 +1,7 @@
-"""Model zoo beyond vision: LLM families (BASELINE.md configs 2-4)."""
+"""Model zoo beyond vision: LLM/MoE/diffusion families (BASELINE configs 2-5)."""
 from .llama import (LlamaConfig, LlamaDecoderLayer, LlamaForCausalLM,  # noqa: F401
                     LlamaModel)
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, BertModel)
+from .gpt_moe import MoEConfig, MoEForCausalLM  # noqa: F401
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401

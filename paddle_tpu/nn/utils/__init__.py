@@ -1,0 +1,115 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/): weight_norm,
+spectral_norm, parameters_to_vector, vector_to_parameters."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Parameter, Tensor
+from ..layer.layers import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize weight = g * v / ||v|| (reference: utils/weight_norm.py).
+
+    Installs a forward_pre_hook recomputing the weight each call so both g
+    and v train.
+    """
+    w = getattr(layer, name)
+    w_val = w._value
+    g0 = _norm_except(w_val, dim)
+    v = Parameter(w_val)
+    g = Parameter(g0.reshape(g0.shape))
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    # demote original weight to a plain (recomputed) attribute
+    layer._parameters.pop(name, None)
+
+    def compute(layer_, inputs):
+        def _wn(v_, g_):
+            return g_ * v_ / jnp.maximum(_norm_except(v_, dim), 1e-12)
+        new_w = apply("weight_norm", _wn, v, g)
+        object.__setattr__(layer_, name, new_w)
+        return None
+
+    handle = layer.register_forward_pre_hook(compute)
+    layer._weight_norm_handle = handle
+    compute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    w = apply("weight_norm_final",
+              lambda v_, g_: g_ * v_ / jnp.maximum(
+                  _norm_except(v_, 0), 1e-12), v, g)
+    layer.add_parameter(name, Parameter(w._value))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int
+                  = 1, eps: float = 1e-12, dim: int = 0):
+    """Power-iteration spectral normalization as a forward_pre_hook."""
+    w = getattr(layer, name)
+    h = w.shape[dim]
+    rest = int(np.prod(w.shape)) // h
+    from ...ops import random as rnd
+
+    u = jax.random.normal(rnd.next_key(), (h,), jnp.float32)
+    state = {"u": u / jnp.linalg.norm(u)}
+    v_param = Parameter(w._value)
+    layer.add_parameter(name + "_orig", v_param)
+    layer._parameters.pop(name, None)
+
+    def compute(layer_, inputs):
+        def _sn(w_):
+            w_mat = jnp.moveaxis(w_, dim, 0).reshape(h, rest)
+            u_ = state["u"]
+            for _ in range(n_power_iterations):
+                v_ = w_mat.T @ u_
+                v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+                u_ = w_mat @ v_
+                u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+            sigma = u_ @ w_mat @ v_
+            if not isinstance(u_, jax.core.Tracer):
+                state["u"] = jax.lax.stop_gradient(u_)
+            return w_ / sigma
+        new_w = apply("spectral_norm", _sn, v_param)
+        object.__setattr__(layer_, name, new_w)
+        return None
+
+    layer.register_forward_pre_hook(compute)
+    compute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat, reshape
+
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        chunk = vec[offset:offset + n]
+        p._value = chunk._value.reshape(tuple(p.shape))
+        offset += n
+    return parameters

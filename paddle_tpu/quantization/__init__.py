@@ -1,0 +1,182 @@
+"""Quantization: QAT + PTQ (reference: python/paddle/fluid/contrib/slim —
+quantization_pass.py fake_quant insertion, ImperativeQuantAware dygraph QAT,
+PTQ calibration; ops paddle/fluid/operators/fake_quantize_op.cc).
+
+TPU-native: fake-quant is a straight-through-estimator op XLA fuses into the
+surrounding program; int8 serving uses XLA's native int8 dot when converted.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["fake_quantize_dequantize", "FakeQuantAbsMax",
+           "FakeQuantMovingAverageAbsMax", "QuantedLinear", "QuantedConv2D",
+           "ImperativeQuantAware", "PTQ", "AbsmaxObserver"]
+
+
+def fake_quantize_dequantize(x, scale, bit_length=8):
+    """Simulated quantization with straight-through gradients."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def _fq(v, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+        dq = q * s / qmax
+        # straight-through: forward quantized, backward identity
+        return v + jax.lax.stop_gradient(dq - v)
+    return apply("fake_quant_dequant", _fq, x,
+                 scale if isinstance(scale, Tensor) else Tensor(
+                     jnp.asarray(scale, jnp.float32)))
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Per-call abs-max scale (weights)."""
+
+    def __init__(self, bit_length=8):
+        super().__init__()
+        self.bit_length = bit_length
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+
+        def _fq(v):
+            s = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8)
+            q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+            dq = q * s / qmax
+            return v + jax.lax.stop_gradient(dq - v)
+        return apply("fake_quant_abs_max", _fq, x)
+
+
+class FakeQuantMovingAverageAbsMax(nn.Layer):
+    """EMA abs-max scale (activations) — reference:
+    fake_quantize_moving_average_abs_max op."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            from ..core.dispatch import no_grad_ctx
+
+            with no_grad_ctx():
+                cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+                self.scale._value = (self.moving_rate * self.scale._value
+                                     + (1 - self.moving_rate) * cur)
+        return fake_quantize_dequantize(x, self.scale, self.bit_length)
+
+
+class QuantedLinear(nn.Layer):
+    def __init__(self, layer: nn.Linear, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits)
+
+    def forward(self, x):
+        from ..nn.functional.common import linear
+
+        xq = self.act_quant(x)
+        wq = self.weight_quant(self.inner.weight)
+        return linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer: nn.Conv2D, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits)
+
+    def forward(self, x):
+        from ..nn.functional.conv import conv2d
+
+        xq = self.act_quant(x)
+        wq = self.weight_quant(self.inner.weight)
+        return conv2d(xq, wq, self.inner.bias, self.inner._stride,
+                      self.inner._padding, self.inner._dilation,
+                      self.inner._groups, self.inner._data_format)
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT (reference: slim ImperativeQuantAware): replaces
+    Linear/Conv2D sublayers with fake-quant wrappers in place."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        self.types = set(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def quantize(self, model: nn.Layer):
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                if type(sub).__name__ == "Linear" and "Linear" in self.types:
+                    layer._sub_layers[name] = QuantedLinear(
+                        sub, self.weight_bits, self.activation_bits)
+                elif type(sub).__name__ == "Conv2D" and "Conv2D" in self.types:
+                    layer._sub_layers[name] = QuantedConv2D(
+                        sub, self.weight_bits, self.activation_bits)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        jit.save(model, path, input_spec=input_spec)
+
+
+class AbsmaxObserver:
+    def __init__(self):
+        self.max_val = 0.0
+
+    def observe(self, x: Tensor):
+        self.max_val = max(self.max_val,
+                           float(jnp.max(jnp.abs(x._value))))
+
+    def scale(self):
+        return self.max_val
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches through observers,
+    then freeze scales into fake-quant layers."""
+
+    def __init__(self, activation_bits=8, weight_bits=8):
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self._observers: Dict[int, AbsmaxObserver] = {}
+
+    def quantize(self, model: nn.Layer):
+        qat = ImperativeQuantAware(weight_bits=self.weight_bits,
+                                   activation_bits=self.activation_bits)
+        model = qat.quantize(model)
+        model.eval()
+        # hooks: observe activation ranges on calibration data
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                obs = AbsmaxObserver()
+                self._observers[id(layer)] = obs
+
+                def hook(l, inputs, _obs=obs):
+                    _obs.observe(inputs[0])
+                layer.register_forward_pre_hook(hook)
+        return model
+
+    def convert(self, model: nn.Layer):
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                obs = self._observers.get(id(layer))
+                if obs and obs.max_val > 0:
+                    layer.act_quant.scale._value = jnp.asarray(
+                        obs.scale(), jnp.float32)
+        return model

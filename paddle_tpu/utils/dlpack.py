@@ -1,0 +1,21 @@
+"""paddle.utils.dlpack (reference: paddle/fluid/framework/dlpack_tensor.cc):
+zero-copy tensor exchange with other frameworks via the DLPack protocol."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x: Tensor):
+    return jax.dlpack.to_dlpack(x._value) if hasattr(jax.dlpack, "to_dlpack") \
+        else x._value.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    if hasattr(capsule, "__dlpack__"):
+        arr = jnp.from_dlpack(capsule)
+    else:
+        arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

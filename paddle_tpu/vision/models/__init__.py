@@ -11,3 +11,8 @@ from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, MobileNetV3Large, MobileNetV3Small,
     mobilenet_v1, mobilenet_v2, mobilenet_v3_large, mobilenet_v3_small,
 )
+from .extra import (  # noqa: F401
+    DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, densenet121, densenet161,
+    densenet169, densenet201, googlenet, inception_v3, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+)

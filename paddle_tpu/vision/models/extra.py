@@ -1,0 +1,270 @@
+"""DenseNet / GoogLeNet / InceptionV3 / ShuffleNetV2 (reference:
+python/paddle/vision/models/{densenet,googlenet,inception,shufflenetv2}.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "GoogLeNet", "googlenet", "InceptionV3",
+           "inception_v3", "ShuffleNetV2", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x0_5"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(drop_rate) if drop_rate else None
+
+    def forward(self, x):
+        h = self.conv1(self.relu(self.norm1(x)))
+        h = self.conv2(self.relu(self.norm2(h)))
+        if self.drop is not None:
+            h = self.drop(h)
+        return concat([x, h], axis=1)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+        block_config = cfgs[layers]
+        num_init = 2 * growth_rate
+        if layers == 161:
+            growth_rate = 48
+            num_init = 96
+        self.features = nn.Sequential(
+            nn.Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(num_init), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        ch = num_init
+        blocks = []
+        for i, n in enumerate(block_config):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth_rate, bn_size, dropout))
+                ch += growth_rate
+            if i < len(block_config) - 1:
+                blocks.append(nn.Sequential(
+                    nn.BatchNorm2D(ch), nn.ReLU(),
+                    nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                    nn.AvgPool2D(2, 2)))
+                ch //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.num_classes = num_classes
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.features(x))
+        x = self.relu(self.norm_final(x))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c2, c3, c4):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c2[0], 1), nn.ReLU(),
+                                nn.Conv2D(c2[0], c2[1], 3, padding=1),
+                                nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c3[0], 1), nn.ReLU(),
+                                nn.Conv2D(c3[0], c3[1], 5, padding=2),
+                                nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(in_c, c4, 1), nn.ReLU())
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, (96, 128), (16, 32), 32),
+            _Inception(256, 128, (128, 192), (32, 96), 64),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc4 = nn.Sequential(
+            _Inception(480, 192, (96, 208), (16, 48), 64),
+            _Inception(512, 160, (112, 224), (24, 64), 64),
+            _Inception(512, 128, (128, 256), (24, 64), 64),
+            _Inception(512, 112, (144, 288), (32, 64), 64),
+            _Inception(528, 256, (160, 320), (32, 128), 128),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, (160, 320), (32, 128), 128),
+            _Inception(832, 384, (192, 384), (48, 128), 128))
+        self.num_classes = num_classes
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+class InceptionV3(nn.Layer):
+    """Compact InceptionV3-style stem + mixed blocks."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+
+        def cbr(i, o, k, s=1, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, stride=s, padding=p,
+                                           bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        self.stem = nn.Sequential(
+            cbr(3, 32, 3, 2), cbr(32, 32, 3), cbr(32, 64, 3, 1, 1),
+            nn.MaxPool2D(3, 2), cbr(64, 80, 1), cbr(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.mixed = nn.Sequential(
+            _Inception(192, 64, (48, 64), (64, 96), 32),
+            _Inception(256, 64, (48, 64), (64, 96), 64),
+            nn.MaxPool2D(3, 2),
+            _Inception(288, 192, (128, 192), (128, 192), 192),
+            _Inception(768, 192, (128, 192), (128, 192), 192))
+        self.num_classes = num_classes
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.mixed(self.stem(x))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+
+        def dw(i, s):
+            return nn.Sequential(
+                nn.Conv2D(i, i, 3, stride=s, padding=1, groups=i,
+                          bias_attr=False), nn.BatchNorm2D(i))
+
+        def pw(i, o):
+            return nn.Sequential(nn.Conv2D(i, o, 1, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+        if stride > 1:
+            self.branch1 = nn.Sequential(dw(in_c, stride), pw(in_c, branch_c))
+            self.branch2 = nn.Sequential(pw(in_c, branch_c),
+                                         dw(branch_c, stride),
+                                         pw(branch_c, branch_c))
+        else:
+            self.branch2 = nn.Sequential(pw(in_c // 2, branch_c),
+                                         dw(branch_c, 1),
+                                         pw(branch_c, branch_c))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride > 1:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        channels = {0.5: [24, 48, 96, 192, 1024],
+                    1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(channels[0]), nn.ReLU(), nn.MaxPool2D(3, 2,
+                                                                 padding=1))
+        stages = []
+        in_c = channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = channels[i + 1]
+            stages.append(_ShuffleUnit(in_c, out_c, 2))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(out_c, out_c, 1))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]), nn.ReLU())
+        self.num_classes = num_classes
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.stem(x)))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)

@@ -1,0 +1,161 @@
+"""MoE LM, UNet, extra vision models, quantization, nn.utils, auto_parallel."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+from paddle_tpu.optimizer import AdamW
+
+
+def r(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestMoELM:
+    def test_trains(self):
+        from paddle_tpu.models import MoEConfig, MoEForCausalLM
+
+        m = MoEForCausalLM(MoEConfig.tiny())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 256, (2, 16)).astype("int32"))
+        opt = AdamW(1e-3, parameters=m.parameters())
+
+        @jit.to_static
+        def step(x):
+            loss, _ = m(x, labels=x)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ls = [float(step(ids).numpy()) for _ in range(6)]
+        assert ls[-1] < ls[0]
+
+
+class TestUNet:
+    def test_forward_backward(self):
+        from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+        unet = UNet2DConditionModel(UNetConfig.tiny())
+        sample = paddle.to_tensor(r(1, 4, 16, 16))
+        t = paddle.to_tensor(np.array([10], "int32"))
+        ctx = paddle.to_tensor(r(1, 8, 32))
+        out = unet(sample, t, ctx)
+        assert out.shape == [1, 4, 16, 16]
+        out.mean().backward()
+        assert unet.conv_in.weight.grad is not None
+
+    def test_serving_export(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+        unet = UNet2DConditionModel(UNetConfig.tiny())
+        unet.eval()
+        path = str(tmp_path / "unet")
+        jit.save(unet, path, input_spec=[
+            jit.InputSpec([1, 4, 8, 8], "float32"),
+            jit.InputSpec([1], "int32"),
+            jit.InputSpec([1, 4, 32], "float32")])
+        predictor = create_predictor(Config(path))
+        outs = predictor.run([paddle.to_tensor(r(1, 4, 8, 8)),
+                              paddle.to_tensor(np.array([5], "int32")),
+                              paddle.to_tensor(r(1, 4, 32))])
+        assert list(outs[0].shape) == [1, 4, 8, 8]
+
+
+class TestExtraVision:
+    def test_shufflenet(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_5
+
+        m = shufflenet_v2_x0_5(num_classes=5)
+        assert m(paddle.to_tensor(r(1, 3, 32, 32))).shape == [1, 5]
+
+
+class TestQuantization:
+    def test_qat_fake_quant(self):
+        from paddle_tpu.quantization import ImperativeQuantAware
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        qnet = ImperativeQuantAware().quantize(net)
+        x = paddle.to_tensor(r(4, 4))
+        out = qnet(x)
+        out.sum().backward()
+        # straight-through: grads reach the inner weights
+        assert qnet[0].inner.weight.grad is not None
+
+    def test_fake_quant_quantizes(self):
+        from paddle_tpu.quantization import fake_quantize_dequantize
+
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        out = fake_quantize_dequantize(x, 1.0, bit_length=3)
+        levels = np.unique(np.round(out.numpy() * 3).astype(int))
+        assert len(levels) <= 7  # 3-bit grid
+
+    def test_ptq_calibration(self):
+        from paddle_tpu.quantization import PTQ
+
+        net = nn.Sequential(nn.Linear(4, 4))
+        ptq = PTQ()
+        qnet = ptq.quantize(net)
+        for _ in range(3):
+            qnet(paddle.to_tensor(r(2, 4) * 5))
+        ptq.convert(qnet)
+        scale = float(qnet[0].act_quant.scale.numpy())
+        assert scale > 1.0  # calibrated to the observed range
+
+
+class TestNNUtils:
+    def test_weight_norm_preserves_output(self):
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(r(2, 4))
+        before = lin(x).numpy()
+        weight_norm(lin)
+        after = lin(x).numpy()
+        np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+        remove_weight_norm(lin)
+        np.testing.assert_allclose(lin(x).numpy(), before, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_params_to_vector_roundtrip(self):
+        from paddle_tpu.nn.utils import (parameters_to_vector,
+                                         vector_to_parameters)
+
+        lin = nn.Linear(3, 2)
+        vec = parameters_to_vector(lin.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        w0 = lin.weight.numpy().copy()
+        vector_to_parameters(vec * 2.0, lin.parameters())
+        np.testing.assert_allclose(lin.weight.numpy(), w0 * 2, rtol=1e-6)
+
+    def test_spectral_norm_hook(self):
+        from paddle_tpu.nn.utils import spectral_norm
+
+        lin = spectral_norm(nn.Linear(4, 4))
+        out = lin(paddle.to_tensor(r(2, 4)))
+        assert out.shape == [2, 4]
+
+
+class TestAutoParallelEngine:
+    def test_engine_fit(self):
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.io import TensorDataset
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        engine = Engine(model=net, loss=nn.CrossEntropyLoss(),
+                        optimizer=AdamW(1e-2, parameters=net.parameters()))
+        xs = r(32, 4)
+        ys = np.random.randint(0, 2, (32,)).astype(np.int64)
+        ds = TensorDataset([xs, ys])
+        hist = engine.fit(ds, epochs=2, batch_size=8, verbose=0)
+        assert hist["loss"][-1] <= hist["loss"][0]
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+        x = paddle.to_tensor(r(3, 3))
+        cap = x._value  # arrays support __dlpack__ directly
+        y = from_dlpack(cap)
+        np.testing.assert_array_equal(x.numpy(), y.numpy())
