@@ -75,20 +75,26 @@ from .ops import linalg  # noqa: F401
 
 
 def disable_static(place=None):
-    """Eager mode is the default and only interactive mode."""
+    """Back to eager mode (the default)."""
+    from .static import graph as _g
+
+    _g.disable_static()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
-        "to compile functions/Layers to XLA")
+    """Switch to static-graph mode: ops on static.data Variables record into
+    the default Program; Executor.run compiles + executes (see static/graph.py)."""
+    from .static import graph as _g
+
+    _g.enable_static()
 
 
 def in_dynamic_mode():
     from .core.dispatch import in_static_trace
+    from .static import graph as _g
 
-    return not in_static_trace()
+    return not in_static_trace() and not _g.in_static_mode()
 
 
 def is_grad_enabled_():  # kept for parity with some callers

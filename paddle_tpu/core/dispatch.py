@@ -33,6 +33,20 @@ class _State(threading.local):
 
 _state = _State()
 
+# Static-graph recorder hook: paddle_tpu.static.graph installs a callback
+# while static mode is enabled; apply() routes ops that touch symbolic
+# Variables to it (the reference's dygraph/static mode switch,
+# /root/reference/python/paddle/fluid/framework.py in_dygraph_mode).
+NOT_RECORDED = object()  # recorder return value meaning "run eagerly"
+_graph_recorder = None
+
+
+def set_graph_recorder(recorder):
+    global _graph_recorder
+    prev = _graph_recorder
+    _graph_recorder = recorder
+    return prev
+
 
 def is_grad_enabled() -> bool:
     # NB: the tape keeps recording inside to_static traces — jax.vjp over
@@ -119,6 +133,11 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
     static keyword attrs; wrap outputs in Tensors and record the grad node.
     """
     from .tensor import Tensor
+
+    if _graph_recorder is not None:
+        rec = _graph_recorder(name, fn, args, attrs)
+        if rec is not NOT_RECORDED:
+            return rec
 
     flat, treedef = jax.tree_util.tree_flatten(
         args, is_leaf=_is_tensor

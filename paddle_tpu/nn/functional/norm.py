@@ -54,6 +54,23 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 def with_no_grad_update(x, running_mean, running_var, channel_axis, momentum):
     from ...core.dispatch import no_grad_ctx
 
+    from ...static import graph as G
+
+    if isinstance(x, G.Variable):
+        # static mode: running-stat update becomes a writeback op
+        def _upd(v, rm, rv):
+            axes = tuple(i for i in range(v.ndim)
+                         if i != channel_axis % v.ndim)
+            mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+            var = jnp.var(v.astype(jnp.float32), axis=axes)
+            return (momentum * rm + (1.0 - momentum) * mean.astype(rm.dtype),
+                    momentum * rv + (1.0 - momentum) * var.astype(rv.dtype))
+
+        G.record_writeback_op("bn_stats", _upd,
+                              [x, running_mean, running_var],
+                              [running_mean, running_var])
+        return
+
     with no_grad_ctx():
         v = x._value
         axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)

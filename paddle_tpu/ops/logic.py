@@ -72,8 +72,9 @@ def is_tensor(x):
 
 def in_dynamic_mode():
     from ..core.dispatch import in_static_trace
+    from ..static import graph as _g
 
-    return not in_static_trace()
+    return not in_static_trace() and not _g.in_static_mode()
 
 
 def any(x, axis=None, keepdim=False, name=None):

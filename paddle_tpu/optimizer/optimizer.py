@@ -127,9 +127,149 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import graph as _G
+
+        if isinstance(loss, _G.Variable):
+            return self._static_minimize(loss, parameters, no_grad_set)
         loss.backward()
         self.step()
         return None, None
+
+    # ------------------------------------------------------- static graph
+    def _static_minimize(self, loss, parameters=None, no_grad_set=None):
+        """Record backward + update ops into the static Program (the
+        reference's Optimizer.minimize in static mode appends grad ops via
+        append_backward then _append_optimize_op per param)."""
+        from ..static import graph as _G
+
+        params = parameters or self._parameter_list
+        if params:  # flatten parameter-group dicts
+            flat_params = []
+            for p in params:
+                if isinstance(p, dict):
+                    flat_params.extend(p["params"])
+                else:
+                    flat_params.append(p)
+            params = flat_params
+        params_grads = _G.append_backward(loss, params, no_grad_set)
+
+        if self._grad_clip is not None:
+            gvars = [g for _, g in params_grads]
+
+            def clip_fn(*gvals):
+                pg = [(p, Tensor(v))
+                      for (p, _), v in zip(params_grads, gvals)]
+                return tuple(t._value for _, t in self._grad_clip(pg))
+
+            from ..core.dispatch import apply
+
+            clipped = apply("grad_clip", clip_fn, *gvars,
+                            _differentiable=False)
+            params_grads = [(p, g) for (p, _), g in
+                            zip(params_grads, clipped)]
+
+        for p, g_var in params_grads:
+            self._record_update_op(p, g_var)
+        self._record_step_op(loss.block)
+        return [], params_grads
+
+    def _probe_accumulators(self, p):
+        """Discover this rule's accumulator slots (names + init arrays) by
+        running the update once on a zero-grad probe with decay disabled."""
+        # fresh zero buffer: update rules donate their param argument, so the
+        # probe must not share p's buffer
+        probe = Parameter(jnp.zeros_like(p._value),
+                          name=getattr(p, "name", None))
+        saved_wd = self._weight_decay
+        self._weight_decay = 0.0
+        try:
+            self._update_param(probe, jnp.zeros_like(p._value), 0.0)
+        finally:
+            self._weight_decay = saved_wd
+        names, inits = [], []
+        for acc_name in sorted(self._accumulators):
+            store = self._accumulators[acc_name]
+            if id(probe) in store:
+                names.append(acc_name)
+                inits.append(store.pop(id(probe)))
+        return names, inits
+
+    def _record_update_op(self, p, g_var):
+        from ..static import graph as _G
+
+        blk = g_var.block
+        acc_names, acc_inits = self._probe_accumulators(p)
+        for acc_name, init in zip(acc_names, acc_inits):
+            store = self._accumulators.setdefault(acc_name, {})
+            if id(p) not in store:
+                store[id(p)] = init
+        slots = [Tensor(self._accumulators[n][id(p)]) for n in acc_names]
+        n_acc = len(acc_names)
+        opt = self
+
+        def opt_fn(p_val, g_val, *rest):
+            acc_vals, lr_val, step_val = rest[:n_acc], rest[n_acc], rest[n_acc + 1]
+            tmp = Parameter(p_val, name=getattr(p, "name", None))
+            saved_step = opt._global_state["step"]
+            opt._global_state["step"] = step_val - 1  # rules use step+1
+            for acc_name, v in zip(acc_names, acc_vals):
+                opt._accumulators[acc_name][id(tmp)] = v
+            try:
+                opt._update_param(tmp, g_val, lr_val)
+                new_accs = tuple(opt._accumulators[acc_name].pop(id(tmp))
+                                 for acc_name in acc_names)
+            finally:
+                opt._global_state["step"] = saved_step
+                for acc_name in acc_names:
+                    opt._accumulators[acc_name].pop(id(tmp), None)
+            return (tmp._value,) + new_accs
+
+        def p_setter(v, _p=p):
+            _p._value = v
+
+        def make_acc_setter(store, pid, slot):
+            def set_(v):
+                slot._value = v
+                store[pid] = v
+            return set_
+
+        inputs = ([("const", p), ("var", g_var)]
+                  + [("const", s) for s in slots]
+                  + [("dyn", lambda: jnp.float32(opt.get_lr())),
+                     ("dyn", lambda: opt._global_state["step"] + 1)])
+        out_avals = [jax.ShapeDtypeStruct(tuple(p._value.shape),
+                                          p._value.dtype)]
+        out_avals += [jax.ShapeDtypeStruct(tuple(s._value.shape),
+                                           s._value.dtype) for s in slots]
+        outputs = [blk.create_var(a, name=blk.program._unique_name(
+            f"{type(self).__name__.lower()}_out")) for a in out_avals]
+        writeback = [(0, p_setter)]
+        for i, (acc_name, slot) in enumerate(zip(acc_names, slots)):
+            writeback.append(
+                (1 + i, make_acc_setter(self._accumulators[acc_name],
+                                        id(p), slot)))
+        blk.append_op(_G.OpDesc(
+            f"{type(self).__name__.lower()}_update", opt_fn, {}, inputs,
+            None, outputs, single=False, writeback=writeback))
+
+    def _record_step_op(self, blk):
+        from ..static import graph as _G
+
+        opt = self
+
+        def step_fn(step_next):
+            return step_next
+
+        def step_setter(v):
+            opt._global_state["step"] = v
+            opt._step_count += 1
+
+        out = blk.create_var(jax.ShapeDtypeStruct((), jnp.int32),
+                             name=blk.program._unique_name("global_step"))
+        blk.append_op(_G.OpDesc(
+            "increment_step", step_fn, {},
+            [("dyn", lambda: opt._global_state["step"] + 1)],
+            None, [out], single=True, writeback=[(0, step_setter)]))
 
     # ---------------------------------------------------------------- state
     def state_dict(self):

@@ -1,44 +1,76 @@
-"""paddle.static parity surface.
+"""paddle.static parity surface: true static-graph mode on XLA.
 
-The reference's static graph mode (Program/Executor,
-/root/reference/python/paddle/static) is subsumed by jit.to_static: a traced
-function IS the program, XLA is the executor.  This module keeps the API
-names that still make sense — InputSpec and inference-model save/load — and
-raises clear errors for Program-construction APIs that have no TPU-native
-equivalent.
+Reference: /root/reference/python/paddle/static (Program/Executor
+re-exports, append_backward in fluid/backward.py, save/load_inference_model
+in fluid/io.py, CompiledProgram).  Design notes in ./graph.py — a Program
+records the same functional ops dygraph runs; Executor compiles the whole
+program (forward+backward+optimizer) into one XLA executable.
 """
 from __future__ import annotations
 
-from ..jit import InputSpec, load as _jit_load, save as _jit_save  # noqa: F401
+from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+from .graph import (  # noqa: F401
+    CompiledProgramWrapper as CompiledProgram,
+    Executor,
+    Program,
+    Scope,
+    Variable,
+    append_backward,
+    create_parameter,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    global_scope,
+    gradients,
+    in_static_mode,
+    load_inference_model,
+    program_guard,
+    save_inference_model,
+    scope_guard,
+)
 
-
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
-    raise NotImplementedError(
-        "Use paddle_tpu.jit.save(layer, path, input_spec=[...]) — the traced "
-        "StableHLO artifact is the inference model")
-
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    return _jit_load(path_prefix)
-
-
-class Program:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "No static Program graph: compile functions with "
-            "paddle_tpu.jit.to_static instead")
-
-
-def default_main_program():
-    raise NotImplementedError("no static graph mode; use jit.to_static")
-
-
-def default_startup_program():
-    raise NotImplementedError("no static graph mode; use jit.to_static")
+py_func = None  # not supported: host callbacks break XLA compilation
 
 
 def name_scope(name):
     import contextlib
 
     return contextlib.nullcontext()
+
+
+def device_guard(device=None):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+from ..nn.layer.layers import ParamAttr  # noqa: F401,E402
+
+
+def save(program, model_path, protocol=4, **configs):
+    """static.save: persist all persistable parameters of a program."""
+    import pickle
+
+    import numpy as np
+
+    state = {}
+    for i, (t, _) in enumerate(program._startup_actions):
+        state[getattr(t, "name", None) or f"param_{i}"] = np.asarray(t._value)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    import jax.numpy as jnp
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for i, (t, _) in enumerate(program._startup_actions):
+        name = getattr(t, "name", None) or f"param_{i}"
+        if name in state:
+            t._value = jnp.asarray(state[name])

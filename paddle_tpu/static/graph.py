@@ -1,0 +1,1089 @@
+"""True static-graph mode: Program / Block / Variable / Executor.
+
+TPU-native re-design of the reference static graph stack
+(/root/reference/python/paddle/fluid/framework.py Program:4777 Block:3199
+Operator:2533 Variable:1212, executor.py:1103 Executor.run, backward.py
+append_backward, layers/control_flow.py cond/while_loop) on top of XLA:
+
+* A ``Program`` records ops symbolically.  Ops are the SAME functional jnp
+  computations the eager mode dispatches (core/dispatch.py): while static
+  mode is enabled, ``dispatch.apply`` routes any op that touches a symbolic
+  ``Variable`` to :func:`record_op`, which infers output shapes with
+  ``jax.eval_shape`` (the InferShape analog) and appends an ``OpDesc`` to the
+  current ``Block``.  Ops over concrete tensors (initializers, constants)
+  still execute eagerly — build-time constant folding.
+* ``Executor.run`` interprets the recorded program inside ONE ``jax.jit``:
+  the whole program — forward, backward, optimizer updates — compiles to a
+  single XLA executable per feed signature (the InterpreterCore +
+  build-strategy-fusion equivalent; XLA does the fusion).
+* ``append_backward`` records a single ``backward`` op whose interpretation
+  is ``jax.grad`` over the re-interpreted forward prefix; XLA CSE merges the
+  recomputation with the primal forward, recovering the reference's
+  grad-op-transpilation semantics without per-op grad kernels.
+* Control flow becomes sub-``Block``s on the op (the reference's
+  conditional_block_op / while_op design) lowered to ``lax.cond`` /
+  ``lax.while_loop``.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from collections import ChainMap
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype, to_np
+from ..core.tensor import Parameter, Tensor
+
+_NOT_RECORDED = dispatch.NOT_RECORDED  # recorder declined: run eagerly
+
+
+# =====================================================================
+# Variables
+# =====================================================================
+class Variable(Tensor):
+    """Symbolic tensor in a Program.  ``_value`` is a ShapeDtypeStruct, so
+    ``.shape``/``.dtype``/``.ndim`` work transparently in layer code."""
+
+    def __init__(self, aval: jax.ShapeDtypeStruct, name: str, block: "Block",
+                 persistable: bool = False, stop_gradient: bool = True,
+                 declared_shape=None):
+        super().__init__(aval, stop_gradient=stop_gradient, name=name)
+        self.block = block
+        self.persistable = persistable
+        self.declared_shape = declared_shape  # may contain None/-1 dims
+        self.is_data = False
+
+    @property
+    def desc(self):
+        return self
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic; run it through "
+            "Executor.run(fetch_list=[var]) to get a value")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name})")
+
+    __str__ = __repr__
+
+
+# =====================================================================
+# Program representation
+# =====================================================================
+class OpDesc:
+    __slots__ = ("type", "fn", "attrs", "inputs", "treedef", "outputs",
+                 "single", "writeback", "extra")
+
+    def __init__(self, type, fn, attrs, inputs, treedef, outputs, single,
+                 writeback=None, extra=None):
+        self.type = type
+        self.fn = fn
+        self.attrs = attrs
+        # inputs: list of (kind, ref); kind in {'var','const','raw','dyn'}
+        #   var  -> Variable,  const -> eager Tensor (live object, e.g. Param)
+        #   raw  -> python value, dyn -> zero-arg provider called every run
+        self.inputs = inputs
+        self.treedef = treedef
+        self.outputs = outputs
+        self.single = single
+        self.writeback = writeback or []  # [(out_index, setter)]
+        self.extra = extra or {}
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.ops: List[OpDesc] = []
+        self.vars: Dict[str, Variable] = {}
+
+    def create_var(self, aval, name=None, persistable=False,
+                   stop_gradient=True, declared_shape=None) -> Variable:
+        name = name or self.program._unique_name("tmp")
+        v = Variable(aval, name, self, persistable=persistable,
+                     stop_gradient=stop_gradient, declared_shape=declared_shape)
+        self.vars[name] = v
+        return v
+
+    def var(self, name) -> Variable:
+        if name in self.vars:
+            return self.vars[name]
+        if self.parent_idx >= 0:
+            return self.program.blocks[self.parent_idx].var(name)
+        raise KeyError(f"no variable named {name!r}")
+
+    def append_op(self, op: OpDesc):
+        self.ops.append(op)
+        self.program._version += 1
+
+
+class Program:
+    """Recorded op graph (the ProgramDesc analog,
+    /root/reference/paddle/fluid/framework/framework.proto:236)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.random_seed = 0
+        self._for_test = False
+        self._version = 0
+        self._name_counter = itertools.count()
+        self._exec_cache: Dict[Any, Any] = {}
+        # persistable initialization actions: [(tensor, init_fn)]
+        self._startup_actions: List[Tuple[Tensor, Callable]] = []
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        st = _state()
+        if st.block_stack and st.block_stack[-1].program is self:
+            return st.block_stack[-1]
+        return self.blocks[0]
+
+    def _create_block(self, parent: Block) -> Block:
+        b = Block(self, len(self.blocks), parent.idx)
+        self.blocks.append(b)
+        return b
+
+    def _unique_name(self, prefix: str) -> str:
+        return f"{prefix}_{next(self._name_counter)}"
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def clone(self, for_test: bool = False) -> "Program":
+        # ops reference live objects; a clone shares them.  for_test=True
+        # marks the clone so the Executor prunes backward/optimizer/state
+        # writeback ops (the reference's clone(for_test=True) prunes the
+        # backward program and flips is_test attrs)
+        p = Program()
+        p.blocks = self.blocks
+        p.random_seed = self.random_seed
+        p._version = self._version
+        p._startup_actions = self._startup_actions
+        p._for_test = for_test
+        return p
+
+    def __repr__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"block {b.idx} (parent {b.parent_idx}):")
+            for op in b.ops:
+                ins = [r.name if k == "var" else k for k, r in op.inputs]
+                outs = [o.name for o in op.outputs]
+                lines.append(f"  {op.type}({ins}) -> {outs}")
+        return "\n".join(lines)
+
+
+# =====================================================================
+# Mode + builder state
+# =====================================================================
+class _BuilderState(threading.local):
+    def __init__(self):
+        self.static_mode = False
+        self.main_program: Optional[Program] = None
+        self.startup_program: Optional[Program] = None
+        self.block_stack: List[Block] = []
+        self.paused = 0
+
+
+_builder = _BuilderState()
+
+
+def _state() -> _BuilderState:
+    return _builder
+
+
+def enable_static():
+    st = _state()
+    if not st.static_mode:
+        st.static_mode = True
+        if st.main_program is None:
+            st.main_program = Program()
+            st.startup_program = Program()
+        dispatch.set_graph_recorder(_recorder)
+
+
+def disable_static():
+    st = _state()
+    st.static_mode = False
+    dispatch.set_graph_recorder(None)
+
+
+def in_static_mode() -> bool:
+    return _state().static_mode
+
+
+def default_main_program() -> Program:
+    st = _state()
+    if st.main_program is None:
+        st.main_program = Program()
+        st.startup_program = Program()
+    return st.main_program
+
+
+def default_startup_program() -> Program:
+    default_main_program()
+    return _state().startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    st = _state()
+    prev = (st.main_program, st.startup_program)
+    st.main_program = main_program
+    if startup_program is not None:
+        st.startup_program = startup_program
+    try:
+        yield
+    finally:
+        st.main_program, st.startup_program = prev
+
+
+@contextlib.contextmanager
+def pause_recording():
+    st = _state()
+    st.paused += 1
+    try:
+        yield
+    finally:
+        st.paused -= 1
+
+
+def _current_block() -> Block:
+    st = _state()
+    if st.block_stack:
+        return st.block_stack[-1]
+    return default_main_program().global_block()
+
+
+@contextlib.contextmanager
+def _sub_block():
+    st = _state()
+    parent = _current_block()
+    blk = parent.program._create_block(parent)
+    st.block_stack.append(blk)
+    try:
+        yield blk
+    finally:
+        st.block_stack.pop()
+
+
+# =====================================================================
+# Recording
+# =====================================================================
+def data(name, shape, dtype="float32", lod_level=0) -> Variable:
+    """paddle.static.data analog: a feed placeholder."""
+    blk = default_main_program().global_block()
+    declared = list(shape)
+    concrete = tuple(1 if (d is None or d < 0) else int(d) for d in declared)
+    aval = jax.ShapeDtypeStruct(concrete, to_np(dtype))
+    v = blk.create_var(aval, name=name, declared_shape=declared)
+    v.is_data = True
+    return v
+
+
+def _recorder(name, fn, args, attrs):
+    """Installed into dispatch.apply while static mode is on."""
+    st = _state()
+    if st.paused:
+        return _NOT_RECORDED
+    flat, treedef = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor))
+    if not any(isinstance(leaf, Variable) for leaf in flat):
+        return _NOT_RECORDED  # constant folding: run eagerly
+    return record_op(name, fn, flat, treedef, attrs)
+
+
+def record_op(name, fn, flat, treedef, attrs):
+    blk = _current_block()
+    inputs = []
+    specs = []
+    spec_pos = []
+    for i, leaf in enumerate(flat):
+        if isinstance(leaf, Variable):
+            inputs.append(("var", leaf))
+            specs.append(leaf._value)
+            spec_pos.append(i)
+        elif isinstance(leaf, Tensor):
+            inputs.append(("const", leaf))
+            specs.append(jax.ShapeDtypeStruct(leaf._value.shape,
+                                              leaf._value.dtype))
+            spec_pos.append(i)
+        else:
+            inputs.append(("raw", leaf))
+
+    def shape_fn(*vals):
+        out = _call_op_fn(fn, flat, treedef, spec_pos, vals, attrs)
+        return out
+
+    from ..ops import random as rnd
+
+    prev = rnd.set_trace_key_provider(lambda: jax.random.PRNGKey(0))
+    try:
+        out_aval = jax.eval_shape(shape_fn, *specs)
+    finally:
+        rnd.set_trace_key_provider(prev)
+
+    single = not isinstance(out_aval, (tuple, list))
+    out_list = [out_aval] if single else list(out_aval)
+    outputs = [blk.create_var(
+        jax.ShapeDtypeStruct(tuple(o.shape), o.dtype),
+        name=blk.program._unique_name(name)) for o in out_list]
+    blk.append_op(OpDesc(name, fn, attrs, inputs, treedef, outputs, single))
+    return outputs[0] if single else tuple(outputs)
+
+
+def _call_op_fn(fn, flat, treedef, spec_pos, vals, attrs):
+    new_flat = list(flat)
+    for pos, v in zip(spec_pos, vals):
+        new_flat[pos] = v
+    # non-tensor leaves stay; tensor leaves replaced by raw values (op fns
+    # receive raw arrays, as in dispatch.apply's raw_fn)
+    for i, leaf in enumerate(new_flat):
+        if isinstance(leaf, Tensor):
+            new_flat[i] = leaf._value
+    if treedef is None:  # flat convention (optimizer update ops)
+        return fn(*new_flat, **attrs)
+    args = jax.tree_util.tree_unflatten(treedef, new_flat)
+    return fn(*args, **attrs)
+
+
+def record_writeback_op(name, fn, leaves, targets):
+    """Record an op (flat call convention) whose outputs are written back
+    into live eager tensors after every Executor.run — the mechanism for
+    persistable state mutated inside the program (BN running stats,
+    optimizer slots; the reference models these as ops writing Scope vars).
+
+    leaves: list of Variable | Tensor | zero-arg provider | raw python value.
+    targets: list of eager Tensors to receive the outputs, aligned 1:1.
+    """
+    blk = _current_block()
+    entries = []
+    for leaf in leaves:
+        if isinstance(leaf, Variable):
+            entries.append(("var", leaf))
+        elif isinstance(leaf, Tensor):
+            entries.append(("const", leaf))
+        elif callable(leaf):
+            entries.append(("dyn", leaf))
+        else:
+            entries.append(("raw", leaf))
+    outputs = [blk.create_var(
+        jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype),
+        name=blk.program._unique_name(name)) for t in targets]
+
+    def make_setter(t):
+        def set_(v):
+            t._value = v
+        return set_
+
+    writeback = [(i, make_setter(t)) for i, t in enumerate(targets)]
+    blk.append_op(OpDesc(name, fn, {}, entries, None, outputs,
+                         single=len(targets) == 1, writeback=writeback))
+    return outputs
+
+
+# =====================================================================
+# append_backward / gradients
+# =====================================================================
+def _collect_referenced_params(block: Block, upto: int):
+    seen, out = set(), []
+    for op in block.ops[:upto]:
+        for kind, ref in op.inputs:
+            if (kind == "const" and isinstance(ref, Tensor)
+                    and getattr(ref, "persistable", False)
+                    and getattr(ref, "trainable", True)
+                    and not ref.stop_gradient
+                    and id(ref) not in seen):
+                seen.add(id(ref))
+                out.append(ref)
+    return out
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Record grad computation for ``loss`` wrt parameters; returns
+    [(param, grad_var)] like the reference
+    (/root/reference/python/paddle/fluid/backward.py append_backward)."""
+    blk = loss.block
+    prefix_len = len(blk.ops)
+    if parameter_list:
+        params = [p for p in parameter_list
+                  if no_grad_set is None or getattr(p, "name", None) not in no_grad_set]
+    else:
+        params = _collect_referenced_params(blk, prefix_len)
+        if no_grad_set:
+            params = [p for p in params
+                      if getattr(p, "name", None) not in no_grad_set]
+    return _record_backward(loss, params, prefix_len)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients: grads of sum of targets wrt arbitrary vars,
+    with optional cotangents (reference: fluid/backward.py gradients)."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    blk = targets[0].block
+    return [g for _, g in _record_backward(
+        targets, inputs, len(blk.ops),
+        target_gradients=target_gradients, no_grad_set=no_grad_set)]
+
+
+def _entry(x):
+    return ("var", x) if isinstance(x, Variable) else ("const", x)
+
+
+def _record_backward(targets: Sequence, wrt: Sequence, prefix_len: int,
+                     target_gradients=None, no_grad_set=None):
+    if isinstance(targets, Variable):
+        targets = [targets]
+    blk = targets[0].block
+    entries = []
+    grad_vars = []
+    for w in wrt:
+        if isinstance(w, Variable):
+            entries.append(("var", w))
+            aval = jax.ShapeDtypeStruct(tuple(w.shape), w._value.dtype)
+            gname = f"{w.name}@GRAD"
+        else:
+            entries.append(("const", w))
+            aval = jax.ShapeDtypeStruct(tuple(w._value.shape), w._value.dtype)
+            gname = f"{getattr(w, 'name', None) or f'param_{id(w)}'}@GRAD"
+        grad_vars.append(blk.create_var(aval, name=blk.program._unique_name(gname)))
+
+    tg_entries = None
+    if target_gradients is not None:
+        tg_entries = [None if tg is None else _entry(tg)
+                      for tg in target_gradients]
+    no_grad_names = set(no_grad_set) if no_grad_set else set()
+
+    op = OpDesc("backward", None, {},
+                [_entry(t) for t in targets] + entries, None,
+                grad_vars, single=False,
+                extra={"prefix_len": prefix_len, "n_targets": len(targets),
+                       "target_gradients": tg_entries,
+                       "no_grad_names": no_grad_names})
+    blk.append_op(op)
+    return list(zip(wrt, grad_vars))
+
+
+# =====================================================================
+# Control flow (sub-block ops; reference: conditional_block_op / while_op)
+# =====================================================================
+def _wrap_branch_outputs(outs):
+    if outs is None:
+        return [], True
+    single = not isinstance(outs, (tuple, list))
+    return ([outs] if single else list(outs)), single
+
+
+def static_cond(pred, true_fn, false_fn, operands=()):
+    blk = _current_block()
+    with _sub_block() as tb:
+        t_out, t_single = _wrap_branch_outputs(true_fn(*operands))
+    with _sub_block() as fb:
+        f_out, f_single = _wrap_branch_outputs(false_fn(*operands))
+    assert len(t_out) == len(f_out), "cond branches must match in structure"
+
+    outputs = []
+    for o in t_out:
+        aval = (jax.ShapeDtypeStruct(tuple(o.shape),
+                                     o._value.dtype if isinstance(o, Tensor)
+                                     else jnp.result_type(o))
+                if isinstance(o, Tensor)
+                else jax.ShapeDtypeStruct(np.shape(o), jnp.result_type(o)))
+        outputs.append(blk.create_var(aval, name=blk.program._unique_name("cond")))
+
+    op = OpDesc("cond", None, {},
+                [("var", pred) if isinstance(pred, Variable) else ("const", pred)],
+                None, outputs, single=t_single,
+                extra={"true_block": tb, "false_block": fb,
+                       "true_out": t_out, "false_out": f_out})
+    blk.append_op(op)
+    return outputs[0] if t_single else tuple(outputs)
+
+
+def static_while_loop(cond_fn, body_fn, loop_vars):
+    blk = _current_block()
+    loop_vars = list(loop_vars)
+    shadows = []
+    for i, v in enumerate(loop_vars):
+        if isinstance(v, Variable):
+            aval = v._value
+        elif isinstance(v, Tensor):
+            aval = jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
+        else:
+            arr = jnp.asarray(v)
+            aval = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        shadows.append(blk.create_var(aval, name=blk.program._unique_name(f"loopvar{i}")))
+
+    with _sub_block() as cb:
+        pred_out = cond_fn(*shadows)
+    with _sub_block() as bb:
+        body_out = body_fn(*shadows)
+        body_out, _ = _wrap_branch_outputs(body_out)
+    assert len(body_out) == len(loop_vars), \
+        "while_loop body must return one value per loop var"
+
+    outputs = [blk.create_var(s._value, name=blk.program._unique_name("whileout"))
+               for s in shadows]
+    entries = [("var", v) if isinstance(v, Variable)
+               else ("const", v) if isinstance(v, Tensor)
+               else ("raw", v) for v in loop_vars]
+    op = OpDesc("while", None, {}, entries, None, outputs, single=False,
+                extra={"cond_block": cb, "body_block": bb,
+                       "pred_out": pred_out, "body_out": body_out,
+                       "shadows": shadows})
+    blk.append_op(op)
+    return tuple(outputs)
+
+
+# =====================================================================
+# Interpretation (inside jax.jit)
+# =====================================================================
+class _Interp:
+    def __init__(self, capmap, dyn_env, key_provider):
+        self.capmap = capmap          # id(const Tensor) -> value
+        self.dyn_env = dyn_env        # id(provider) -> value
+        self.key_provider = key_provider
+        self.wb_vals: Dict[int, Any] = {}   # id(setter) -> value
+        self.depth = 0                # >0 while inside a control-flow branch
+
+    def leaf_value(self, kind, ref, env):
+        if kind == "var":
+            return env[ref.name]
+        if kind == "const":
+            return self.capmap.get(id(ref), ref._value)
+        if kind == "dyn":
+            return self.dyn_env[id(ref)]
+        return ref
+
+    def run_block(self, block: Block, env) -> None:
+        # only called for control-flow sub-blocks: values created here are
+        # branch-local tracers, so writebacks must not be captured
+        self.depth += 1
+        try:
+            for op in block.ops:
+                self.run_op(op, env)
+        finally:
+            self.depth -= 1
+
+    def run_op(self, op: OpDesc, env) -> None:
+        if op.type == "backward":
+            self._run_backward(op, env)
+            return
+        if op.type == "cond":
+            self._run_cond(op, env)
+            return
+        if op.type == "while":
+            self._run_while(op, env)
+            return
+        vals, pos = [], []
+        flat = []
+        for i, (kind, ref) in enumerate(op.inputs):
+            flat.append(ref)
+            if kind != "raw":
+                vals.append(self.leaf_value(kind, ref, env))
+                pos.append(i)
+        from ..ops import random as rnd
+
+        prev = rnd.set_trace_key_provider(self.key_provider)
+        try:
+            out = _call_op_fn(op.fn, flat, op.treedef, pos, vals, op.attrs)
+        finally:
+            rnd.set_trace_key_provider(prev)
+        out_list = [out] if op.single else list(out)
+        for var, v in zip(op.outputs, out_list):
+            env[var.name] = v
+        if self.depth == 0:
+            for out_idx, setter in op.writeback:
+                self.wb_vals[id(setter)] = out_list[out_idx]
+
+    def _run_backward(self, op: OpDesc, env) -> None:
+        n_t = op.extra.get("n_targets", 1)
+        target_entries, wrt = op.inputs[:n_t], op.inputs[n_t:]
+        tg_entries = op.extra.get("target_gradients")
+        no_grad_names = op.extra.get("no_grad_names") or set()
+        first_target = target_entries[0][1]
+        prefix = first_target.block.ops[:op.extra["prefix_len"]]
+        cur = [self.leaf_value(k, r, env) for k, r in wrt]
+
+        def f(*wrt_vals):
+            env2 = dict(env)
+            sub = _Interp(dict(self.capmap), self.dyn_env, self.key_provider)
+            for (kind, ref), v in zip(wrt, wrt_vals):
+                if kind == "var":
+                    env2[ref.name] = v
+                else:
+                    sub.capmap[id(ref)] = v
+            for p_op in prefix:
+                sub.run_op(p_op, env2)
+                if no_grad_names:
+                    for o in p_op.outputs:
+                        if o.name in no_grad_names:
+                            env2[o.name] = jax.lax.stop_gradient(
+                                env2[o.name])
+            # scalar objective: sum of targets, each contracted with its
+            # cotangent when given (reference fills ones otherwise)
+            total = jnp.float32(0.0)
+            for i, (kind, ref) in enumerate(target_entries):
+                tv = sub.leaf_value(kind, ref, env2).astype(jnp.float32)
+                if tg_entries is not None and tg_entries[i] is not None:
+                    cot = self.leaf_value(*tg_entries[i], env)
+                    total = total + jnp.sum(tv * cot.astype(jnp.float32))
+                else:
+                    total = total + jnp.sum(tv)
+            return total
+
+        grads = jax.grad(f, argnums=tuple(range(len(wrt))))(*cur)
+        for gvar, g, (kind, ref) in zip(op.outputs, grads, wrt):
+            tgt_dtype = (ref._value.dtype if isinstance(ref, Tensor)
+                         else g.dtype)
+            env[gvar.name] = g.astype(tgt_dtype)
+
+    def _branch_value(self, o, env2):
+        if isinstance(o, Variable):
+            return env2[o.name]
+        if isinstance(o, Tensor):
+            return self.capmap.get(id(o), o._value)
+        return jnp.asarray(o)
+
+    def _run_cond(self, op: OpDesc, env) -> None:
+        pred = self.leaf_value(*op.inputs[0], env)
+
+        def make_branch(blk, outs):
+            def br(_):
+                env2 = ChainMap({}, env)
+                self.run_block(blk, env2)
+                return tuple(self._branch_value(o, env2) for o in outs)
+            return br
+
+        res = jax.lax.cond(
+            jnp.asarray(pred).astype(bool).reshape(()),
+            make_branch(op.extra["true_block"], op.extra["true_out"]),
+            make_branch(op.extra["false_block"], op.extra["false_out"]),
+            0)
+        for var, v in zip(op.outputs, res):
+            env[var.name] = v
+
+    def _run_while(self, op: OpDesc, env) -> None:
+        shadows = op.extra["shadows"]
+        init = tuple(self.leaf_value(k, r, env) for k, r in op.inputs)
+
+        def bind(carry):
+            env2 = ChainMap({}, env)
+            for s, v in zip(shadows, carry):
+                env2[s.name] = v
+            return env2
+
+        def cond_f(carry):
+            env2 = bind(carry)
+            self.run_block(op.extra["cond_block"], env2)
+            p = self._branch_value(op.extra["pred_out"], env2)
+            return jnp.asarray(p).astype(bool).reshape(())
+
+        def body_f(carry):
+            env2 = bind(carry)
+            self.run_block(op.extra["body_block"], env2)
+            return tuple(
+                jnp.asarray(self._branch_value(o, env2)).astype(
+                    jnp.asarray(c).dtype).reshape(jnp.asarray(c).shape)
+                for o, c in zip(op.extra["body_out"], carry))
+
+        res = jax.lax.while_loop(cond_f, body_f, init)
+        for var, v in zip(op.outputs, res):
+            env[var.name] = v
+
+
+# =====================================================================
+# Executor
+# =====================================================================
+def _sub_block_ops(op: OpDesc):
+    for key in ("true_block", "false_block", "cond_block", "body_block"):
+        blk = op.extra.get(key)
+        if blk is not None:
+            for sub in blk.ops:
+                yield sub
+                yield from _sub_block_ops(sub)
+
+
+def _prune_ops(block: Block, fetch_refs, include_writebacks: bool):
+    """Keep only ops the fetches (and, for training, state writebacks)
+    depend on — the reference's program pruning (fluid/backward.py
+    _prune_and_optimize / inference memory_optimize)."""
+    needed = {r.name for r in fetch_refs if isinstance(r, Variable)}
+    needed |= {r for r in fetch_refs if isinstance(r, str)}
+    keep = [False] * len(block.ops)
+    force_prefix = 0  # backward ops re-run their prefix at grad eval
+
+    def op_var_inputs(op):
+        for kind, ref in op.inputs:
+            if kind == "var":
+                yield ref.name
+        for sub in _sub_block_ops(op):
+            for kind, ref in sub.inputs:
+                if kind == "var":
+                    yield ref.name
+
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        k = (any(o.name in needed for o in op.outputs)
+             or (include_writebacks and op.writeback)
+             or i < force_prefix)
+        if k:
+            keep[i] = True
+            needed.update(op_var_inputs(op))
+            if op.type == "backward":
+                force_prefix = max(force_prefix, op.extra["prefix_len"])
+    # second pass for prefixes forced by a backward op seen late
+    changed = True
+    while changed:
+        changed = False
+        for i in range(len(block.ops) - 1, -1, -1):
+            op = block.ops[i]
+            if not keep[i] and (i < force_prefix
+                                or any(o.name in needed for o in op.outputs)):
+                keep[i] = True
+                needed.update(op_var_inputs(op))
+                if op.type == "backward":
+                    force_prefix = max(force_prefix, op.extra["prefix_len"])
+                changed = True
+    return [op for op, k in zip(block.ops, keep) if k]
+
+
+def _collect_const_and_dyn(op_list):
+    consts, dyns, setters = [], [], []
+    cseen, dseen = set(), set()
+
+    def visit(op, collect_wb):
+        for kind, ref in op.inputs:
+            if kind == "const" and id(ref) not in cseen:
+                cseen.add(id(ref))
+                consts.append(ref)
+            elif kind == "dyn" and id(ref) not in dseen:
+                dseen.add(id(ref))
+                dyns.append(ref)
+        if collect_wb:
+            for _, setter in op.writeback:
+                setters.append(setter)
+
+    for op in op_list:
+        visit(op, collect_wb=True)
+        for sub in _sub_block_ops(op):
+            # sub-block writebacks are branch-local tracers — they cannot
+            # escape the lax.cond/while trace, so state written inside
+            # control flow is not persisted (documented limitation)
+            visit(sub, collect_wb=False)
+        if op.type == "backward":
+            # grad eval re-runs the prefix: its consts are inputs too —
+            # they are already visited because prefix ops are kept
+            pass
+    return consts, dyns, setters
+
+
+class _CompiledProgram:
+    def __init__(self, program: Program, feed_names, fetch_refs,
+                 include_writebacks: bool = True):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_refs = list(fetch_refs)
+        self.op_list = _prune_ops(program.global_block(), self.fetch_refs,
+                                  include_writebacks)
+        self.consts, self.dyns, self.setters = _collect_const_and_dyn(
+            self.op_list)
+
+        produced = {o.name for op in self.op_list for o in op.outputs}
+        required = set()
+        for op in self.op_list:
+            for kind, ref in op.inputs:
+                if kind == "var" and ref.name not in produced:
+                    required.add(ref.name)
+        for ref in self.fetch_refs:
+            if isinstance(ref, Variable) and ref.name not in produced \
+                    and ref.is_data:
+                required.add(ref.name)
+        missing = required - set(self.feed_names)
+        if missing:
+            raise ValueError(
+                f"feed is missing required input(s) {sorted(missing)}; "
+                f"the program consumes feeds {sorted(required)}")
+        blk = program.global_block()
+        self.feed_decls = {n: blk.vars[n].declared_shape or blk.vars[n].shape
+                           for n in self.feed_names if n in blk.vars}
+
+        comp = self
+
+        def jfn(feed_vals, const_vals, dyn_vals, rng_key):
+            counter = itertools.count()
+
+            def key_provider():
+                return jax.random.fold_in(rng_key, next(counter))
+
+            capmap = {id(t): v for t, v in zip(comp.consts, const_vals)}
+            dyn_env = {id(p): v for p, v in zip(comp.dyns, dyn_vals)}
+            interp = _Interp(capmap, dyn_env, key_provider)
+            env: Dict[str, Any] = dict(zip(comp.feed_names, feed_vals))
+            for op in comp.op_list:
+                interp.run_op(op, env)
+            fetches = []
+            for ref in comp.fetch_refs:
+                if isinstance(ref, Variable):
+                    fetches.append(env[ref.name])
+                elif isinstance(ref, Tensor):
+                    fetches.append(capmap.get(id(ref), ref._value))
+                else:  # name
+                    fetches.append(env[ref])
+            # keep positional alignment with comp.setters (None = no value)
+            wb = [interp.wb_vals.get(id(s)) for s in comp.setters]
+            return tuple(fetches), tuple(wb)
+
+        self._jitted = jax.jit(jfn)
+
+    def run(self, feed_vals, rng_key):
+        for name, v in zip(self.feed_names, feed_vals):
+            decl = self.feed_decls.get(name)
+            if decl is None:
+                continue
+            ok = len(v.shape) == len(decl) and all(
+                d is None or d < 0 or d == s
+                for d, s in zip(decl, v.shape))
+            if not ok:
+                raise ValueError(
+                    f"feed '{name}' has shape {tuple(v.shape)} but the "
+                    f"program declares {list(decl)}")
+        const_vals = [t._value for t in self.consts]
+        dyn_vals = [jnp.asarray(p()) for p in self.dyns]
+        fetches, wb = self._jitted(feed_vals, const_vals, dyn_vals, rng_key)
+        for setter, v in zip(self.setters, wb):
+            if v is not None:
+                setter(v)
+        return fetches
+
+
+class Executor:
+    """paddle.static.Executor analog: compiles + runs Programs on XLA."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, scope=None, return_numpy=True, **kwargs):
+        from ..ops import random as rnd
+
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, CompiledProgramWrapper):
+            program = program._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+
+        # startup program: (re)run parameter initializers
+        if not program.global_block().ops and program._startup_actions:
+            with pause_recording():
+                for tensor, init_fn in program._startup_actions:
+                    tensor._value = init_fn()
+            return []
+
+        feed_items = sorted(feed.items())
+        feed_names = [k for k, _ in feed_items]
+        with pause_recording():
+            feed_vals = [v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                         for _, v in feed_items]
+
+        fetch_key = tuple(
+            r.name if isinstance(r, Variable) else
+            f"@const{id(r)}" if isinstance(r, Tensor) else str(r)
+            for r in fetch_list)
+        key = (program._version, tuple(feed_names),
+               tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
+               fetch_key)
+        comp = program._exec_cache.get(key)
+        if comp is None:
+            comp = _CompiledProgram(program, feed_names, fetch_list,
+                                    include_writebacks=not program._for_test)
+            program._exec_cache[key] = comp
+
+        rng_key = rnd.default_generator().next_key()
+        prev_rec = dispatch.set_graph_recorder(None)
+        try:
+            fetches = comp.run(feed_vals, rng_key)
+        finally:
+            dispatch.set_graph_recorder(prev_rec)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def close(self):
+        pass
+
+
+class CompiledProgramWrapper:
+    """paddle.static.CompiledProgram parity shim."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+# =====================================================================
+# Scope
+# =====================================================================
+class _VarView:
+    def __init__(self, tensor: Tensor):
+        self._t = tensor
+
+    def get_tensor(self):
+        return self._t.numpy()
+
+    def set(self, value, place=None):
+        self._t._value = jnp.asarray(value, dtype=self._t._value.dtype)
+
+
+class Scope:
+    """Name → persistable tensor view (reference: framework/scope.h:78)."""
+
+    def __init__(self):
+        self._extra: Dict[str, Tensor] = {}
+
+    def find_var(self, name):
+        for prog in filter(None, [_state().main_program]):
+            for t, _ in prog._startup_actions:
+                if getattr(t, "name", None) == name:
+                    return _VarView(t)
+        t = self._extra.get(name)
+        return _VarView(t) if t is not None else None
+
+    def var(self, name):
+        if name not in self._extra:
+            self._extra[name] = Tensor(jnp.zeros(()))
+        return _VarView(self._extra[name])
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+# =====================================================================
+# Parameters in static mode
+# =====================================================================
+def create_parameter(shape, dtype, name=None, initializer=None,
+                     is_bias=False, attr=None, trainable=True) -> Parameter:
+    """Create an eager Parameter + record its initializer into the startup
+    program (so Executor.run(startup_program) re-initializes, as the
+    reference's startup program does)."""
+    from ..nn import initializer as I
+
+    if initializer is None:
+        initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+    prog = default_startup_program()
+    name = name or default_main_program()._unique_name("param")
+    shape = tuple(int(s) for s in shape)
+    npdt = to_np(dtype)
+
+    def init_fn():
+        with pause_recording(), dispatch.no_grad_ctx():
+            p = Parameter(jnp.zeros(shape, npdt), name=name)
+            initializer(p)
+            return p._value
+
+    p = Parameter(init_fn(), name=name, trainable=trainable)
+    prog._startup_actions.append((p, init_fn))
+    default_main_program()._startup_actions.append((p, init_fn))
+    return p
+
+
+# =====================================================================
+# save / load inference model
+# =====================================================================
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export feed→fetch as serialized StableHLO + weights (reference:
+    static.save_inference_model → program + persistables)."""
+    import pickle
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    comp = _CompiledProgram(program, [v.name for v in feed_vars], fetch_vars,
+                            include_writebacks=False)
+    const_vals = [t._value for t in comp.consts]
+    dyn_vals = [jnp.asarray(p()) for p in comp.dyns]
+
+    def pure(*feed_vals):
+        fetches, _ = comp._jitted.__wrapped__(
+            list(feed_vals), const_vals, dyn_vals, jax.random.PRNGKey(0))
+        return fetches
+
+    # dims declared None/-1 export as symbolic (batch-size-agnostic serving)
+    scope = jax.export.SymbolicScope()
+    specs = []
+    for i, v in enumerate(feed_vars):
+        decl = v.declared_shape if v.declared_shape is not None else v.shape
+        if any(d is None or d < 0 for d in decl):
+            dim_str = ",".join(
+                f"d{i}_{j}" if (d is None or d < 0) else str(d)
+                for j, d in enumerate(decl))
+            shape = jax.export.symbolic_shape(dim_str, scope=scope)
+        else:
+            shape = tuple(int(d) for d in decl)
+        specs.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+    exported = jax.export.export(jax.jit(pure))(*specs)
+    blob = {
+        "stablehlo": exported.serialize(),
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [getattr(v, "name", str(v)) for v in fetch_vars],
+    }
+    fname = path_prefix + ".pdmodel"
+    with open(fname, "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    return fname
+
+
+class LoadedInferenceProgram:
+    def __init__(self, exported, feed_names, fetch_names):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def run(self, feed: Dict[str, Any]):
+        vals = [jnp.asarray(feed[n]) for n in self.feed_names]
+        return [np.asarray(o) for o in self._exported.call(*vals)]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    import pickle
+
+    fname = (path_prefix if path_prefix.endswith(".pdmodel")
+             else path_prefix + ".pdmodel")
+    with open(fname, "rb") as f:
+        blob = pickle.load(f)
+    exported = jax.export.deserialize(blob["stablehlo"])
+    prog = LoadedInferenceProgram(exported, blob["feed_names"],
+                                  blob["fetch_names"])
+    return [prog, prog.feed_names, prog.fetch_names]

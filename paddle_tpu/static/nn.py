@@ -1,0 +1,169 @@
+"""paddle.static.nn: graph-building layer helpers.
+
+Reference: /root/reference/python/paddle/static/nn/__init__.py re-exporting
+fluid.layers (fc, conv2d, batch_norm, embedding — fluid/layers/nn.py) and
+control flow (fluid/layers/control_flow.py cond:?, while_loop:1167, case,
+switch_case).  Here each helper creates eager Parameters (recorded into the
+startup program) and calls the SAME functional ops as dygraph — the op
+recording in static/graph.py turns them into program ops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_np
+from ..nn import functional as F
+from ..nn import initializer as I
+from . import graph as G
+
+
+def _param(shape, dtype, attr, is_bias=False, default=None):
+    """Create a parameter from a weight_attr that may be a ParamAttr, an
+    initializer callable, or None."""
+    from ..nn.layer.layers import ParamAttr
+
+    name, trainable, init = None, True, None
+    if isinstance(attr, ParamAttr):
+        name, init, trainable = attr.name, attr.initializer, attr.trainable
+    elif attr is not None:
+        init = attr
+    if init is None:
+        init = default or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    return G.create_parameter(shape, dtype, name=name, initializer=init,
+                              is_bias=is_bias, trainable=trainable)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """fluid.layers.fc analog (reference: fluid/layers/nn.py fc)."""
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    w = _param([in_dim, size], x._value.dtype, weight_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([size], x._value.dtype, bias_attr, is_bias=True)
+    from .. import ops
+
+    if len(x.shape) > num_flatten_dims + 1:
+        # flatten uses runtime shapes — keeps the program batch-size-agnostic
+        x = ops.flatten(x, start_axis=num_flatten_dims, stop_axis=-1)
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    w = _param(list(size), to_np(dtype), param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    w = _param([num_filters, in_ch // groups, *filter_size],
+               input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False,
+               use_global_stats=False, name=None):
+    from ..core.tensor import Tensor
+
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    w = _param([ch], input._value.dtype, param_attr,
+               default=I.Constant(1.0))
+    b = _param([ch], input._value.dtype, bias_attr, is_bias=True)
+    rm = Tensor(jnp.zeros([ch], input._value.dtype))
+    rv = Tensor(jnp.ones([ch], input._value.dtype))
+    rm.persistable = rv.persistable = True
+    rm.stop_gradient = rv.stop_gradient = True
+    out = F.batch_norm(input, rm, rv, w, b, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout,
+                       use_global_stats=use_global_stats)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    w = _param(shape, input._value.dtype, param_attr,
+               default=I.Constant(1.0)) if scale else None
+    b = _param(shape, input._value.dtype, bias_attr,
+               is_bias=True) if shift else None
+    out = F.layer_norm(input, shape, w, b, epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    mode = ("upscale_in_train"
+            if dropout_implementation == "upscale_in_train"
+            else "downscale_in_infer")
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+# ------------------------------------------------------------ control flow
+cond = G.static_cond
+while_loop = G.static_while_loop
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Chained conditionals (reference: fluid/layers/control_flow.py case):
+    first pair whose pred is true wins; lowered to nested XLA conds."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+
+    def build(k):
+        if k == len(pairs):
+            return default()
+        pred, fn = pairs[k]
+        return G.static_cond(pred, fn, lambda: build(k + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Index dispatch (reference: control_flow.py switch_case)."""
+    from .. import ops
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and callable(branch_fns[0]):
+        items = list(enumerate(branch_fns))
+    else:
+        items = sorted(branch_fns)
+    if default is None:
+        default = items[-1][1]
+
+    def build(k):
+        if k == len(items):
+            return default()
+        idx, fn = items[k]
+        return G.static_cond(ops.equal(branch_index, idx), fn,
+                             lambda: build(k + 1))
+
+    return build(0)

@@ -1,0 +1,322 @@
+"""Static-graph mode tests.
+
+Models the reference's static-graph test style (fluid tests build a Program
+with program_guard, run Executor, compare against numpy; e.g.
+/root/reference/python/paddle/fluid/tests/unittests/test_executor_*.py and
+book/ regression tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestStaticBasics:
+    def test_record_and_run(self, static_mode):
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = paddle.ops.add(paddle.ops.matmul(x, paddle.ops.transpose(x, [1, 0])),
+                               paddle.to_tensor(1.0))
+        exe = static.Executor()
+        xv = np.random.rand(3, 4).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, xv @ xv.T + 1.0, rtol=1e-5)
+
+    def test_constant_folding_stays_eager(self, static_mode):
+        # ops over concrete tensors don't record
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.ops.add(a, a)
+        assert not isinstance(b, static.Variable)
+        np.testing.assert_allclose(b.numpy(), [2.0, 4.0])
+
+    def test_batch_size_agnostic(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            y = paddle.ops.sum(x * 2.0)
+        exe = static.Executor()
+        for n in (1, 5):
+            xv = np.ones((n, 2), np.float32)
+            (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+            assert out == pytest.approx(4.0 * n)
+
+    def test_fc_layer_and_startup(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            h = static.nn.fc(x, 5, activation="relu")
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.rand(2, 3).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[h])
+        assert out.shape == (2, 5)
+        assert (out >= 0).all()
+
+    def test_append_backward(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            w = static.create_parameter([3, 1], "float32")
+            y = paddle.ops.matmul(x, w)
+            loss = paddle.ops.mean(y)
+            pgs = static.append_backward(loss)
+        assert len(pgs) == 1
+        p, gvar = pgs[0]
+        exe = static.Executor()
+        xv = np.random.rand(4, 3).astype(np.float32)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gvar])
+        np.testing.assert_allclose(g, xv.mean(0, keepdims=True).T / 1.0,
+                                   rtol=1e-5)
+
+    def test_gradients_multi_target_and_no_grad(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            t1 = paddle.ops.sum(x * x)      # d/dx = 2x
+            t2 = paddle.ops.sum(3.0 * x)    # d/dx = 3
+            (g,) = static.gradients([t1, t2], x)
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0], np.float32)
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[g])
+        np.testing.assert_allclose(gv, 2 * xv + 3.0, rtol=1e-5)
+
+    def test_gradients_with_cotangent(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            y = x * x
+            (g,) = static.gradients(
+                y, x, target_gradients=paddle.to_tensor([1.0, 10.0]))
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0], np.float32)
+        (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[g])
+        np.testing.assert_allclose(gv, 2 * xv * np.array([1.0, 10.0]),
+                                   rtol=1e-5)
+
+    def test_clone_for_test_prunes_training_ops(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            t = static.data("t", [None, 1], "float32")
+            w = static.create_parameter([3, 1], "float32")
+            pred = paddle.ops.matmul(x, w)
+            loss = paddle.ops.mean(paddle.ops.square(pred - t))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        test_prog = main.clone(for_test=True)
+        exe = static.Executor()
+        w_before = np.asarray(w._value).copy()
+        # no label feed needed, and params must not move
+        (p,) = exe.run(test_prog,
+                       feed={"x": np.ones((2, 3), np.float32)},
+                       fetch_list=[pred])
+        assert p.shape == (2, 1)
+        np.testing.assert_array_equal(w_before, np.asarray(w._value))
+
+    def test_minimize_with_param_groups(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            w = static.create_parameter([3, 1], "float32")
+            loss = paddle.ops.mean(paddle.ops.matmul(x, w))
+            opt = paddle.optimizer.Adam(
+                learning_rate=0.1,
+                parameters=[{"params": [w], "weight_decay": 0.0}])
+            opt.minimize(loss)
+        exe = static.Executor()
+        (lv,) = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                        fetch_list=[loss])
+        assert np.isfinite(lv)
+
+    def test_fc_with_param_attr(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            h = static.nn.fc(x, 2, weight_attr=static.ParamAttr(
+                name="myw",
+                initializer=paddle.nn.initializer.Constant(0.5)),
+                bias_attr=False)
+        exe = static.Executor()
+        (o,) = exe.run(main, feed={"x": np.ones((1, 3), np.float32)},
+                       fetch_list=[h])
+        np.testing.assert_allclose(o, [[1.5, 1.5]], rtol=1e-6)
+
+    def test_in_dynamic_mode_consistent(self, static_mode):
+        assert not paddle.in_dynamic_mode()
+        assert not paddle.ops.logic.in_dynamic_mode()
+        paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+        assert paddle.ops.logic.in_dynamic_mode()
+        paddle.enable_static()
+
+    def test_gradients_wrt_input(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            loss = paddle.ops.sum(x * x)
+            (gx,) = static.gradients(loss, x)
+        exe = static.Executor()
+        xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+        np.testing.assert_allclose(g, 2 * xv, rtol=1e-5)
+
+
+class TestStaticTraining:
+    def _train(self, opt_factory, n_steps=30):
+        main, startup = static.Program(), static.Program()
+        rng = np.random.RandomState(0)
+        true_w = rng.rand(3, 1).astype(np.float32)
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            t = static.data("t", [None, 1], "float32")
+            w = static.create_parameter([3, 1], "float32", name="w")
+            pred = paddle.ops.matmul(x, w)
+            loss = paddle.ops.mean(paddle.ops.square(pred - t))
+            opt = opt_factory()
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(n_steps):
+            xv = rng.rand(16, 3).astype(np.float32)
+            tv = xv @ true_w
+            (lv,) = exe.run(main, feed={"x": xv, "t": tv}, fetch_list=[loss])
+            losses.append(float(lv))
+        return losses
+
+    def test_sgd_minimize_converges(self, static_mode):
+        losses = self._train(lambda: paddle.optimizer.SGD(learning_rate=0.5))
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_adam_minimize_converges(self, static_mode):
+        losses = self._train(
+            lambda: paddle.optimizer.Adam(learning_rate=0.1))
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_momentum_with_clip(self, static_mode):
+        losses = self._train(lambda: paddle.optimizer.Momentum(
+            learning_rate=0.2,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0)))
+        assert losses[-1] < losses[0]
+
+    def test_static_matches_dygraph(self, static_mode):
+        # same init, same data -> same first-step loss and updated weight
+        xv = np.random.RandomState(1).rand(8, 3).astype(np.float32)
+        tv = np.random.RandomState(2).rand(8, 1).astype(np.float32)
+        w0 = np.random.RandomState(3).rand(3, 1).astype(np.float32)
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            t = static.data("t", [None, 1], "float32")
+            w = static.create_parameter(
+                [3, 1], "float32",
+                initializer=paddle.nn.initializer.Assign(w0))
+            loss = paddle.ops.mean(
+                paddle.ops.square(paddle.ops.matmul(x, w) - t))
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        (l_static,) = exe.run(main, feed={"x": xv, "t": tv},
+                              fetch_list=[loss])
+        w_static = np.asarray(w._value)
+
+        paddle.disable_static()
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Parameter
+
+        wd = Parameter(jnp.asarray(w0))
+        xd, td = paddle.to_tensor(xv), paddle.to_tensor(tv)
+        loss_d = paddle.ops.mean(
+            paddle.ops.square(paddle.ops.matmul(xd, wd) - td))
+        opt_d = paddle.optimizer.SGD(learning_rate=0.1, parameters=[wd])
+        loss_d.backward()
+        opt_d.step()
+        paddle.enable_static()
+
+        np.testing.assert_allclose(float(l_static), float(loss_d.numpy()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(w_static, np.asarray(wd._value), rtol=1e-5)
+
+
+class TestStaticControlFlow:
+    def test_cond(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            pred = paddle.ops.sum(x) > 0
+            out = static.nn.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+        exe = static.Executor()
+        (o1,) = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+                        fetch_list=[out])
+        np.testing.assert_allclose(o1, [2.0, 4.0])
+        (o2,) = exe.run(main, feed={"x": np.array([-1.0, -2.0], np.float32)},
+                        fetch_list=[out])
+        np.testing.assert_allclose(o2, [-2.0, -3.0])
+
+    def test_while_loop(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            i0 = paddle.to_tensor([0.0])
+            (i_out, x_out) = static.nn.while_loop(
+                lambda i, v: paddle.ops.sum(i) < 5.0,
+                lambda i, v: (i + 1.0, v * 2.0),
+                [i0, x])
+        exe = static.Executor()
+        (xo,) = exe.run(main, feed={"x": np.array([1.0], np.float32)},
+                        fetch_list=[x_out])
+        np.testing.assert_allclose(xo, [32.0])
+
+    def test_switch_case(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            idx = static.data("i", [], "int32")
+            out = static.nn.switch_case(idx, {
+                0: lambda: paddle.to_tensor(10.0),
+                1: lambda: paddle.to_tensor(20.0),
+            }, default=lambda: paddle.to_tensor(-1.0))
+        exe = static.Executor()
+        for iv, expect in [(0, 10.0), (1, 20.0), (7, -1.0)]:
+            (o,) = exe.run(main, feed={"i": np.int32(iv)}, fetch_list=[out])
+            assert float(o) == expect
+
+
+class TestStaticInferenceModel:
+    def test_save_load_inference_model(self, static_mode, tmp_path):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3], "float32")
+            y = static.nn.fc(x, 2)
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.rand(4, 3).astype(np.float32)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+        path = str(tmp_path / "model")
+        static.save_inference_model(path, [x], [y], exe, program=main)
+        loaded, feed_names, fetch_names = static.load_inference_model(path, exe)
+        out = loaded.run({"x": xv})[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_dropout_and_bn_training(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4, 2, 2], "float32")
+            h = static.nn.batch_norm(x, is_test=False)
+            h = static.nn.dropout(h, 0.5)
+            out = paddle.ops.mean(h)
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.rand(8, 4, 2, 2).astype(np.float32)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        assert np.isfinite(o)
