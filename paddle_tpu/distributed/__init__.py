@@ -30,6 +30,7 @@ from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from ..kernels.ring_attention import ring_attention  # noqa: F401
+from ..kernels.ulysses_attention import ulysses_attention  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import launch  # noqa: F401
 

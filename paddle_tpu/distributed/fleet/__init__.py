@@ -19,6 +19,7 @@ from ..env import get_rank, get_world_size
 from ..mesh import (CommunicateTopology, HybridCommunicateGroup, fleet_mesh,
                     get_hybrid_communicate_group, get_mesh)
 from .distributed_strategy import DistributedStrategy
+from .meta_optimizers import DGCMomentum, LocalSGDOptimizer  # noqa: F401
 
 _FLEET = None
 
@@ -117,6 +118,26 @@ def distributed_optimizer(optimizer, strategy=None):
     dygraph_optimizer/hybrid_parallel_optimizer.py:170).  Accumulator slots
     inherit each parameter's sharding; with sharding_degree>1 the slots
     shard even when params don't (ZeRO-1)."""
+    strategy = strategy or fleet.strategy
+    if strategy is not None and getattr(strategy, "dgc", False):
+        from ...optimizer.optimizer import Momentum
+        from .meta_optimizers import DGCMomentum
+
+        if isinstance(optimizer, Momentum) \
+                and not isinstance(optimizer, DGCMomentum):
+            optimizer = DGCMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                weight_decay=optimizer._weight_decay or None,
+                **(strategy.dgc_configs or {}))
+        elif not isinstance(optimizer, DGCMomentum):
+            import warnings
+
+            warnings.warn("strategy.dgc only applies to Momentum optimizers "
+                          f"(got {type(optimizer).__name__}); ignored — "
+                          "matching the reference DGCOptimizer restriction")
     optimizer._is_distributed = True
     orig_add = optimizer._add_accumulator
 
@@ -142,6 +163,11 @@ def distributed_optimizer(optimizer, strategy=None):
         return arr
 
     optimizer._add_accumulator = _add_accumulator
+    if strategy is not None and getattr(strategy, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      **(strategy.localsgd_configs or {}))
     return optimizer
 
 

@@ -41,7 +41,9 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": 0.999}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True

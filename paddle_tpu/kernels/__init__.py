@@ -7,3 +7,4 @@ Pallas interpreter) and as the recompute backward.
 """
 from .flash_attention import flash_attention_bhtd, flash_attention_bthd  # noqa: F401
 from .rms_norm import rms_norm  # noqa: F401
+from .ulysses_attention import ulysses_attention  # noqa: F401

@@ -25,9 +25,14 @@ NEG_INF = -1e30
 def _block_attn_update(q, k, v, acc, m, l, q_offset, kv_offset, scale, causal):
     """One online-softmax update of (acc, m, l) with a KV block.
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; acc: [B, Tq, H, D] f32;
-    m/l: [B, Tq, H, 1] f32.
+    q: [B, Tq, H, D]; k/v: [B, Tk, Hkv, D]; acc: [B, Tq, H, D] f32;
+    m/l: [B, Tq, H, 1] f32.  GQA (Hkv < H) is expanded here, after the ring
+    hop, so only the small KV shard rides ICI.
     """
+    rep = q.shape[2] // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale  # [B,H,Tq,Tk]
     if causal:
@@ -88,15 +93,11 @@ def ring_attention(q, k, v, mesh: Mesh = None, axis_name: str = "sp",
 
     mesh = mesh or get_mesh()
     if mesh is None or axis_name not in mesh.shape:
-        # no sp axis: plain attention
-        from .flash_attention import _attn_reference
+        # no sp axis: plain attention (GQA-aware; flash kernel on TPU)
+        from .ulysses_attention import _plain_attention
 
-        qt = jnp.swapaxes(q, 1, 2)
-        kt = jnp.swapaxes(k, 1, 2)
-        vt = jnp.swapaxes(v, 1, 2)
-        out = _attn_reference(qt, kt, vt, causal,
-                              scale or 1.0 / math.sqrt(q.shape[-1]))
-        return jnp.swapaxes(out, 1, 2)
+        return _plain_attention(q, k, v, causal,
+                                scale or 1.0 / math.sqrt(q.shape[-1]))
 
     spec = P(batch_axis, axis_name, None, None)
     fn = jax.shard_map(

@@ -1,0 +1,114 @@
+"""Ulysses (DeepSpeed-Ulysses style) sequence parallelism via all-to-all.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.2 last row);
+this is new capability the TPU build owns, complementing ring attention
+(`ring_attention.py`).  Where ring attention rotates KV shards around the
+`sp` ring with ppermute, Ulysses re-shards with two all-to-alls: inputs
+arrive sharded over the sequence axis [B, T/sp, H, D], an all-to-all inside
+`shard_map` turns them into head-sharded full-sequence blocks [B, T, H/sp,
+D], plain (flash) attention runs locally per head group, and a second
+all-to-all restores sequence sharding.  Both all-to-alls ride ICI; the score
+matrix only ever exists blockwise inside the local attention.
+
+Trade-off vs ring: Ulysses moves 2x activations once (latency ~2 hops,
+bandwidth-optimal for moderate sp), ring moves KV sp-1 times but overlaps
+with compute; Ulysses needs heads % sp == 0, ring has no head constraint.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
+def _plain_attention(q, k, v, causal, scale):
+    """q/k/v: [B, T, H, D] (full sequence, local heads).  GQA-aware.
+
+    On TPU this is the blockwise Pallas flash kernel (no T x T score matrix
+    ever materializes); elsewhere the dense reference path.
+    """
+    if jax.default_backend() == "tpu":
+        from .flash_attention import flash_attention_bthd
+
+        return flash_attention_bthd(q, k, v, causal=causal, scale=scale)
+    rep = q.shape[2] // k.shape[2]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0) >= \
+            jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Runs on each sp shard inside shard_map.  q/k/v: [B, T_local, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # seq-sharded -> head-sharded: split heads (axis 2) across sp, gather the
+    # full sequence (axis 1).  tiled=True keeps the block layout contiguous.
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    out = _plain_attention(qh, kh, vh, causal, scale)
+    # head-sharded -> seq-sharded: inverse all-to-all
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh = None, axis_name: str = "sp",
+                      causal: bool = False, scale=None,
+                      batch_axis: str = None, head_axis: str = None):
+    """[B, T, H, D] exact attention with T sharded over `axis_name`.
+
+    Called on global (possibly sharded) arrays; returns the same layout.
+    `head_axis` optionally names a mesh axis the head dim is already sharded
+    over (tensor parallelism); the all-to-all then runs within each TP group.
+    Requires local head count divisible by the sp degree.
+    """
+    from ..distributed.mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.shape \
+            or mesh.shape[axis_name] == 1:
+        if scale is None:
+            scale = 1.0 / math.sqrt(q.shape[-1])
+        return _plain_attention(q, k, v, causal, scale)
+
+    sp = mesh.shape[axis_name]
+    n_kv_local = k.shape[2] // (mesh.shape.get(head_axis, 1)
+                                if head_axis else 1)
+    if n_kv_local % sp != 0:
+        # head constraint not met (e.g. GQA with few KV heads): ring handles
+        # this case without reshuffling heads
+        from .ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh=mesh, axis_name=axis_name,
+                              causal=causal, scale=scale,
+                              batch_axis=batch_axis)
+
+    spec = P(batch_axis, axis_name, head_axis, None)
+    fn = _shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh, (spec, spec, spec), spec)
+    return fn(q, k, v)

@@ -573,3 +573,46 @@ class Lamb(Optimizer):
                                     self._global_state["step"] + 1, wd)
         self._set_accumulator("moment1", p, m)
         self._set_accumulator("moment2", p, v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _lars_rule(p, vel, g, lr, mu, lars_coeff, lars_wd, eps):
+    """Layer-wise adaptive rate scaling (reference:
+    paddle/fluid/operators/optimizers/lars_momentum_op.cc)."""
+    pf = p.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    p_norm = jnp.linalg.norm(pf)
+    g_norm = jnp.linalg.norm(gf)
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + eps),
+        lr)
+    vel = mu * vel + local_lr * (gf + lars_wd * pf)
+    return (pf - vel).astype(p.dtype), vel
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: python/paddle/fluid/optimizer.py
+    LarsMomentumOptimizer; fleet meta_optimizers/lars_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=0.0, multi_precision=False, name=None,
+                 exclude_from_weight_decay=None, **kwargs):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _update_param(self, p, g, lr):
+        vel = self._add_accumulator("velocity", p, dtype=jnp.float32)
+        wd = self._lars_weight_decay
+        pname = getattr(p, "name", "") or ""
+        if any(tag in pname for tag in self._exclude):
+            wd = 0.0
+        p._value, vel = _lars_rule(p._value, vel, g, lr, self._momentum,
+                                   self._lars_coeff, wd, self._epsilon)
+        self._set_accumulator("velocity", p, vel)

@@ -313,3 +313,213 @@ class TestCollectiveAPI:
                                        np.full(8, jnp.sum(x)), rtol=1e-6)
         finally:
             meshmod._GLOBAL_MESH = None
+
+
+class TestUlyssesAttention:
+    def test_matches_reference(self):
+        from paddle_tpu.kernels.flash_attention import _attn_reference
+        from paddle_tpu.kernels.ulysses_attention import ulysses_attention
+
+        mesh = meshmod.init_mesh({"sp": 8})
+        try:
+            B, T, H, D = 2, 64, 8, 16
+            q = jnp.asarray(r(B, T, H, D))
+            k = jnp.asarray(r(B, T, H, D))
+            v = jnp.asarray(r(B, T, H, D))
+            sh = NamedSharding(mesh, P(None, "sp"))
+            qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+            for causal in (False, True):
+                out = ulysses_attention(qs, ks, vs, mesh=mesh, causal=causal)
+                qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+                ref = jnp.swapaxes(
+                    _attn_reference(qt, kt, vt, causal, 1 / np.sqrt(D)), 1, 2)
+                np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                           atol=2e-5)
+        finally:
+            meshmod._GLOBAL_MESH = None
+
+    def test_gqa_falls_back_to_ring(self):
+        # 2 KV heads cannot be split over sp=8 -> ring path, still exact
+        from paddle_tpu.kernels.flash_attention import _attn_reference
+        from paddle_tpu.kernels.ulysses_attention import ulysses_attention
+
+        mesh = meshmod.init_mesh({"sp": 8})
+        try:
+            B, T, H, D = 1, 32, 8, 8
+            q = jnp.asarray(r(B, T, H, D))
+            k = jnp.asarray(r(B, T, 2, D))
+            v = jnp.asarray(r(B, T, 2, D))
+            sh = NamedSharding(mesh, P(None, "sp"))
+            qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+            out = ulysses_attention(qs, ks, vs, mesh=mesh, causal=True)
+            kr = jnp.repeat(k, 4, axis=2)
+            vr = jnp.repeat(v, 4, axis=2)
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, kr, vr))
+            ref = jnp.swapaxes(
+                _attn_reference(qt, kt, vt, True, 1 / np.sqrt(D)), 1, 2)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5)
+        finally:
+            meshmod._GLOBAL_MESH = None
+
+    def test_composes_with_tensor_parallel_heads(self):
+        from paddle_tpu.kernels.flash_attention import _attn_reference
+        from paddle_tpu.kernels.ulysses_attention import ulysses_attention
+
+        mesh = meshmod.init_mesh({"sp": 4, "mp": 2})
+        try:
+            B, T, H, D = 2, 32, 8, 8
+            q = jnp.asarray(r(B, T, H, D))
+            k = jnp.asarray(r(B, T, H, D))
+            v = jnp.asarray(r(B, T, H, D))
+            sh = NamedSharding(mesh, P(None, "sp", "mp"))
+            qs, ks, vs = (jax.device_put(t, sh) for t in (q, k, v))
+            out = ulysses_attention(qs, ks, vs, mesh=mesh, causal=True,
+                                    head_axis="mp")
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            ref = jnp.swapaxes(
+                _attn_reference(qt, kt, vt, True, 1 / np.sqrt(D)), 1, 2)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5)
+        finally:
+            meshmod._GLOBAL_MESH = None
+
+    def test_gradients_flow(self):
+        from paddle_tpu.kernels.ulysses_attention import ulysses_attention
+
+        mesh = meshmod.init_mesh({"sp": 8})
+        try:
+            B, T, H, D = 1, 16, 8, 8
+            q = jnp.asarray(r(B, T, H, D))
+            k = jnp.asarray(r(B, T, H, D))
+            v = jnp.asarray(r(B, T, H, D))
+
+            def loss(q, k, v):
+                return jnp.sum(
+                    ulysses_attention(q, k, v, mesh=mesh, causal=True))
+
+            g = jax.grad(loss)(q, k, v)
+            assert np.isfinite(np.asarray(g)).all()
+            assert float(jnp.abs(g).sum()) > 0
+        finally:
+            meshmod._GLOBAL_MESH = None
+
+
+class TestMetaOptimizers:
+    def test_localsgd_wrapper_steps_and_syncs(self):
+        from paddle_tpu.distributed.fleet import LocalSGDOptimizer
+        from paddle_tpu.optimizer import SGD
+
+        w = paddle.to_tensor(r(4, 3))
+        w.stop_gradient = False
+        inner = SGD(learning_rate=0.1, parameters=[w])
+        opt = LocalSGDOptimizer(inner, k_steps=4, begin_step=2)
+        syncs = []
+        opt._average_parameters = lambda: syncs.append(opt._step_count)
+        for _ in range(10):
+            loss = (w ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # step 1 (pre-warmup) syncs, then every 4 from begin_step=2
+        assert syncs == [1, 2, 6, 10]
+
+    def test_localsgd_via_strategy(self):
+        from paddle_tpu.distributed.fleet import LocalSGDOptimizer
+        from paddle_tpu.optimizer import SGD
+
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 3, "begin_step": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            w = paddle.to_tensor(r(2, 2))
+            w.stop_gradient = False
+            opt = fleet.distributed_optimizer(
+                SGD(learning_rate=0.1, parameters=[w]))
+            assert isinstance(opt, LocalSGDOptimizer)
+            assert opt.k_steps == 3
+            loss = (w ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
+
+    def test_dgc_momentum_sparsifies_and_converges(self):
+        from paddle_tpu.distributed.fleet import DGCMomentum
+
+        target = r(8, 8)
+        w = paddle.to_tensor(np.zeros((8, 8), np.float32))
+        w.stop_gradient = False
+        opt = DGCMomentum(learning_rate=0.01, momentum=0.9, parameters=[w],
+                          sparsity=0.9)
+        for _ in range(800):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # error feedback must preserve convergence despite 90% drop rate
+        np.testing.assert_allclose(w.numpy(), target, atol=0.05)
+
+    def test_dgc_error_feedback_accumulates(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import _dgc_sparsify
+
+        g = jnp.asarray(np.array([[1.0, 0.1], [0.2, 3.0]], np.float32))
+        err = jnp.zeros((2, 2), jnp.float32)
+        sparse, resid = _dgc_sparsify(g, err, 1)
+        np.testing.assert_allclose(np.asarray(sparse),
+                                   [[0, 0], [0, 3.0]], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(resid),
+                                   [[1.0, 0.1], [0.2, 0]], atol=1e-6)
+        # dropped mass comes back next round
+        sparse2, _ = _dgc_sparsify(jnp.zeros((2, 2)), resid, 1)
+        np.testing.assert_allclose(np.asarray(sparse2),
+                                   [[1.0, 0], [0, 0]], atol=1e-6)
+
+
+class TestDGCStrategyWiring:
+    def test_dgc_via_strategy(self):
+        from paddle_tpu.distributed.fleet import DGCMomentum
+        from paddle_tpu.optimizer import Momentum
+
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 2, "sparsity": 0.5}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            w = paddle.to_tensor(r(4, 4))
+            w.stop_gradient = False
+            opt = fleet.distributed_optimizer(
+                Momentum(learning_rate=0.01, momentum=0.9, parameters=[w]))
+            assert isinstance(opt, DGCMomentum)
+            assert opt.rampup_begin_step == 2
+            for _ in range(4):
+                loss = (w ** 2).sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            assert opt._dgc_step == 4
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
+
+    def test_dgc_ignored_for_adam(self):
+        import warnings as warnings_mod
+
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            w = paddle.to_tensor(r(2, 2))
+            w.stop_gradient = False
+            with warnings_mod.catch_warnings(record=True) as rec:
+                warnings_mod.simplefilter("always")
+                opt = fleet.distributed_optimizer(
+                    AdamW(1e-3, parameters=[w]))
+            assert any("dgc" in str(x.message) for x in rec)
+            assert isinstance(opt, AdamW)
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None

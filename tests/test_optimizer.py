@@ -49,6 +49,33 @@ class TestConvergence:
         w, target = run_steps(Lamb, n=800, lr=0.01, lamb_weight_decay=0.0)
         np.testing.assert_allclose(w, target, atol=0.1)
 
+    def test_lars_converges(self):
+        from paddle_tpu.optimizer import LarsMomentum
+
+        # lars scales lr by ||w||/||g||; decays toward 0 with wd, so test
+        # pure descent with wd=0
+        w, target = run_steps(LarsMomentum, n=800, lr=1.0,
+                              lars_weight_decay=0.0)
+        np.testing.assert_allclose(w, target, atol=0.1)
+
+    def test_lars_rule_matches_numpy(self):
+        from paddle_tpu.optimizer.optimizer import _lars_rule
+
+        rng = np.random.default_rng(0)
+        p = rng.normal(size=(4, 3)).astype(np.float32)
+        g = rng.normal(size=(4, 3)).astype(np.float32)
+        vel = np.zeros_like(p)
+        lr, mu, coeff, wd, eps = 0.1, 0.9, 0.001, 0.0005, 0.0
+        local_lr = lr * coeff * np.linalg.norm(p) / (
+            np.linalg.norm(g) + wd * np.linalg.norm(p) + eps)
+        vel_ref = mu * vel + local_lr * (g + wd * p)
+        p_ref = p - vel_ref
+        import jax.numpy as jnp
+        p_new, vel_new = _lars_rule(jnp.asarray(p), jnp.asarray(vel),
+                                    jnp.asarray(g), lr, mu, coeff, wd, eps)
+        np.testing.assert_allclose(np.asarray(p_new), p_ref, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(vel_new), vel_ref, rtol=1e-5)
+
     def test_adagrad_adadelta_steps(self):
         w, target = run_steps(Adagrad, n=500, lr=0.5)
         np.testing.assert_allclose(w, target, atol=0.2)
