@@ -131,6 +131,8 @@ def distributed_optimizer(optimizer, strategy=None):
                 parameters=optimizer._parameter_list,
                 grad_clip=optimizer._grad_clip,
                 weight_decay=optimizer._weight_decay or None,
+                use_nesterov=optimizer._use_nesterov,
+                multi_precision=optimizer._multi_precision,
                 **(strategy.dgc_configs or {}))
         elif not isinstance(optimizer, DGCMomentum):
             import warnings
@@ -138,6 +140,22 @@ def distributed_optimizer(optimizer, strategy=None):
             warnings.warn("strategy.dgc only applies to Momentum optimizers "
                           f"(got {type(optimizer).__name__}); ignored — "
                           "matching the reference DGCOptimizer restriction")
+    if strategy is not None and getattr(strategy, "lars", False):
+        from ...optimizer.optimizer import LarsMomentum, Momentum
+
+        if type(optimizer) is Momentum:
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                **(getattr(strategy, "lars_configs", None) or {}))
+        elif not isinstance(optimizer, LarsMomentum):
+            import warnings
+
+            warnings.warn("strategy.lars only applies to Momentum optimizers "
+                          f"(got {type(optimizer).__name__}); ignored — "
+                          "matching the reference LarsOptimizer restriction")
     optimizer._is_distributed = True
     orig_add = optimizer._add_accumulator
 

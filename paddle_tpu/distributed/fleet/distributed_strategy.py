@@ -40,6 +40,7 @@ class DistributedStrategy:
         # misc toggles kept for parity
         self.lamb = False
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005}
         self.dgc = False
         self.dgc_configs = {"rampup_begin_step": 0, "sparsity": 0.999}
         self.localsgd = False

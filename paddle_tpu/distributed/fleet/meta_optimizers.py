@@ -40,6 +40,8 @@ class LocalSGDOptimizer:
         self._step_count = 0
 
     def __getattr__(self, name):
+        if name.startswith("_inner") or name.startswith("__"):
+            raise AttributeError(name)
         return getattr(self._inner, name)
 
     def step(self):
@@ -59,8 +61,13 @@ class LocalSGDOptimizer:
         from ..collective import ReduceOp, all_reduce
 
         params = getattr(self._inner, "_parameter_list", None) or []
-        for p in params:
-            all_reduce(p, op=ReduceOp.AVG)
+        for entry in params:
+            # _parameter_list may hold parameter-group dicts (same contract
+            # as Optimizer._collect_params_grads).
+            group = entry.get("params", []) if isinstance(entry, dict) \
+                else [entry]
+            for p in group:
+                all_reduce(p, op=ReduceOp.AVG)
 
     def clear_grad(self):
         self._inner.clear_grad()

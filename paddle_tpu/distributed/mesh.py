@@ -21,6 +21,18 @@ _GLOBAL_MESH: Optional[Mesh] = None
 _GLOBAL_HCG: Optional["HybridCommunicateGroup"] = None
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """jax.shard_map across JAX versions (top-level since 0.4.31+ with
+    check_vma; jax.experimental.shard_map with check_rep before)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
 def init_mesh(axes: Dict[str, int], devices=None) -> Mesh:
     """Create and install the global mesh, e.g. init_mesh({"dp": 2, "mp": 4}).
 

@@ -28,17 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import nn
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
-from .mesh import get_mesh
-
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=False)
+from .mesh import get_mesh, shard_map_compat as _shard_map
 
 
 def gpipe_spmd(stage_fn: Callable, stacked_params, x_microbatches,

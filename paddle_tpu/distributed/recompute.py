@@ -49,7 +49,15 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
             for p, v in zip(params, param_vals):
                 p._value = v
                 p._grad_node = None
-            out = function(*new_args, **kwargs)
+            # Run with the tape disabled: inside jax.checkpoint the segment
+            # must be differentiated by JAX itself (per-op jax.vjp calls
+            # would bake non-redifferentiable pallas_call jaxprs into the
+            # remat body).  Every op's fn is jax-differentiable by
+            # construction, so outer AD flows through.
+            from ..core import dispatch as _dispatch
+
+            with _dispatch.no_grad_ctx():
+                out = function(*new_args, **kwargs)
         finally:
             for p, (v, node, idx) in zip(params, saved):
                 p._value = v

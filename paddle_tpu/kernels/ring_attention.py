@@ -99,15 +99,11 @@ def ring_attention(q, k, v, mesh: Mesh = None, axis_name: str = "sp",
         return _plain_attention(q, k, v, causal,
                                 scale or 1.0 / math.sqrt(q.shape[-1]))
 
+    from ..distributed.mesh import shard_map_compat
+
     spec = P(batch_axis, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False) if hasattr(jax, "shard_map") else \
-        jax.experimental.shard_map.shard_map(
-            functools.partial(_ring_attention_local, axis_name=axis_name,
-                              causal=causal, scale=scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_rep=False)
+        mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

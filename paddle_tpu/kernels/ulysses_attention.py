@@ -25,13 +25,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map
+    # Lazy import: distributed/__init__ imports this module at load time.
+    from ..distributed.mesh import shard_map_compat
 
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=False)
+    return shard_map_compat(fn, mesh, in_specs, out_specs)
 
 
 def _plain_attention(q, k, v, causal, scale):

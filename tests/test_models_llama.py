@@ -81,3 +81,30 @@ class TestGraftEntry:
         m = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(m)
         m.dryrun_multichip(8)
+
+
+class TestFusedLMLoss:
+    def test_matches_unfused(self):
+        cfg = LlamaConfig.tiny(fused_lm_loss=False)
+        model = LlamaForCausalLM(cfg)
+        loss_ref, _ = model(tokens(), labels=tokens())
+        model.config.fused_lm_loss = True
+        model.config.lm_loss_chunk = 7  # force multi-chunk + padding path
+        loss_fused, logits = model(tokens(), labels=tokens())
+        assert logits is None
+        np.testing.assert_allclose(
+            float(loss_ref.numpy()), float(loss_fused.numpy()), rtol=2e-3)
+
+    def test_fused_grads_flow(self):
+        model = LlamaForCausalLM(LlamaConfig.tiny(lm_loss_chunk=8))
+        loss, _ = model(tokens(), labels=tokens())
+        loss.backward()
+        assert model.lm_head.weight.grad is not None
+        assert model.model.embed_tokens.weight.grad is not None
+
+    def test_fused_tied(self):
+        model = LlamaForCausalLM(
+            LlamaConfig.tiny(tie_word_embeddings=True, lm_loss_chunk=8))
+        loss, _ = model(tokens(), labels=tokens())
+        loss.backward()
+        assert model.model.embed_tokens.weight.grad is not None
