@@ -1,0 +1,38 @@
+"""Shared loader for the native (C++) runtime components.
+
+Each component is a single .cc compiled on first use into a .so next to its
+source (g++ -O2 -shared, same contract as the reference's cpp_extension JIT
+build — python/paddle/utils/cpp_extension) and bound via ctypes.  Callers
+keep a pure-Python fallback so the package works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(__file__)
+_locks: dict = {}
+_libs: dict = {}
+_guard = threading.Lock()
+
+
+def load_native(name: str, extra_flags=()):
+    """Compile (if stale) and dlopen lib<name>.so from <name>.cc; returns the
+    ctypes CDLL.  Raises on compile failure — callers catch and fall back."""
+    with _guard:
+        lock = _locks.setdefault(name, threading.Lock())
+    with lock:
+        if name in _libs:
+            return _libs[name]
+        src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+        so = os.path.join(_NATIVE_DIR, f"lib{name}.so")
+        if not os.path.exists(so) or (
+                os.path.getmtime(src) > os.path.getmtime(so)):
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+                 src, "-o", so, *extra_flags],
+                check=True, capture_output=True)
+        _libs[name] = ctypes.CDLL(so)
+        return _libs[name]

@@ -1,0 +1,149 @@
+// Host-side trace event recorder.
+//
+// TPU-native equivalent of the reference's profiler host tracer
+// (/root/reference/paddle/fluid/platform/profiler/host_event_recorder.h:
+// lock-free per-thread event buffers; host_tracer.cc records RecordEvent
+// ranges).  Design: each thread owns a chunked event list guarded only at
+// registration/collection time, so ht_begin/ht_end on the hot path are a
+// clock read + vector push with no lock contention.  Strings are interned
+// once (ht_intern) so events carry a 4-byte id, not a pointer.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+static inline uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Event {
+  uint32_t name_id;
+  uint64_t start_ns;
+  uint64_t end_ns;
+};
+
+struct ThreadBuffer {
+  uint64_t tid;
+  std::vector<Event> events;
+  std::vector<Event> open;  // stack of in-flight ranges
+  std::mutex mu;            // taken by owner on push and by collector on drain
+};
+
+struct Recorder {
+  std::mutex registry_mu;
+  std::vector<ThreadBuffer*> buffers;
+  std::mutex intern_mu;
+  std::unordered_map<std::string, uint32_t> intern;
+  std::vector<std::string> names;
+  std::atomic<bool> enabled{false};
+};
+
+static Recorder g_rec;
+static std::atomic<uint64_t> g_tid_counter{1};
+
+static thread_local ThreadBuffer* tl_buf = nullptr;
+
+static ThreadBuffer* buf() {
+  if (tl_buf == nullptr) {
+    auto* b = new ThreadBuffer();
+    b->tid = g_tid_counter.fetch_add(1);
+    std::lock_guard<std::mutex> g(g_rec.registry_mu);
+    g_rec.buffers.push_back(b);
+    tl_buf = b;
+  }
+  return tl_buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t ht_intern(const char* name) {
+  std::lock_guard<std::mutex> g(g_rec.intern_mu);
+  auto it = g_rec.intern.find(name);
+  if (it != g_rec.intern.end()) return it->second;
+  uint32_t id = (uint32_t)g_rec.names.size();
+  g_rec.names.push_back(name);
+  g_rec.intern.emplace(name, id);
+  return id;
+}
+
+void ht_enable(int on) { g_rec.enabled.store(on != 0); }
+int ht_enabled() { return g_rec.enabled.load() ? 1 : 0; }
+
+void ht_begin(uint32_t name_id) {
+  if (!g_rec.enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = buf();
+  std::lock_guard<std::mutex> g(b->mu);
+  b->open.push_back(Event{name_id, now_ns(), 0});
+}
+
+void ht_end() {
+  if (!g_rec.enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* b = buf();
+  std::lock_guard<std::mutex> g(b->mu);
+  if (b->open.empty()) return;
+  Event e = b->open.back();
+  b->open.pop_back();
+  e.end_ns = now_ns();
+  b->events.push_back(e);
+}
+
+// One-shot instant/complete event with explicit timestamps (ns).
+void ht_emit(uint32_t name_id, uint64_t start_ns, uint64_t end_ns) {
+  ThreadBuffer* b = buf();
+  std::lock_guard<std::mutex> g(b->mu);
+  b->events.push_back(Event{name_id, start_ns, end_ns});
+}
+
+uint64_t ht_now_ns() { return now_ns(); }
+
+// Collection: snapshot all thread buffers (draining them).  Caller first
+// asks for the count, then reads into parallel arrays.
+static std::vector<Event> g_snapshot;
+static std::vector<uint64_t> g_snapshot_tids;
+
+uint64_t ht_snapshot() {
+  g_snapshot.clear();
+  g_snapshot_tids.clear();
+  std::lock_guard<std::mutex> g(g_rec.registry_mu);
+  for (ThreadBuffer* b : g_rec.buffers) {
+    std::lock_guard<std::mutex> gb(b->mu);
+    for (const Event& e : b->events) {
+      g_snapshot.push_back(e);
+      g_snapshot_tids.push_back(b->tid);
+    }
+    b->events.clear();
+  }
+  return g_snapshot.size();
+}
+
+void ht_read(uint64_t i, uint32_t* name_id, uint64_t* tid, uint64_t* start_ns,
+             uint64_t* end_ns) {
+  const Event& e = g_snapshot[i];
+  *name_id = e.name_id;
+  *tid = g_snapshot_tids[i];
+  *start_ns = e.start_ns;
+  *end_ns = e.end_ns;
+}
+
+// Interned-name lookup; returns bytes copied (0 if id unknown).
+uint32_t ht_name(uint32_t id, char* out, uint32_t cap) {
+  std::lock_guard<std::mutex> g(g_rec.intern_mu);
+  if (id >= g_rec.names.size()) return 0;
+  const std::string& s = g_rec.names[id];
+  uint32_t n = (uint32_t)s.size() < cap - 1 ? (uint32_t)s.size() : cap - 1;
+  std::memcpy(out, s.data(), n);
+  out[n] = '\0';
+  return n;
+}
+
+}  // extern "C"

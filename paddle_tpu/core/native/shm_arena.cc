@@ -1,0 +1,218 @@
+// Shared-memory arena for DataLoader worker -> parent tensor transfer.
+//
+// TPU-native equivalent of the reference's mmap allocator for DataLoader
+// shared-memory tensors (/root/reference/paddle/fluid/memory/allocation/
+// mmap_allocator.cc, used by fluid/dataloader worker.py): instead of
+// pickling ndarray payloads through a pipe, workers memcpy them into a
+// POSIX shm arena and send only (offset, shape, dtype) through the queue;
+// the parent maps the same arena and wraps the bytes zero-copy.
+//
+// Allocation is a first-fit free list guarded by a process-shared robust
+// mutex living in the arena header, so a crashed worker can't wedge the
+// parent (EOWNERDEAD recovers the lock).
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x50414441544D454DULL;  // "PADDATMEM"
+constexpr uint32_t kMaxBlocks = 4096;
+
+struct Block {
+  uint64_t off;
+  uint64_t size;
+  uint32_t used;
+  uint32_t pad;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;      // payload bytes (after header)
+  pthread_mutex_t mu;     // process-shared, robust
+  uint32_t n_blocks;
+  uint32_t pad;
+  Block blocks[kMaxBlocks];
+};
+
+struct Arena {
+  Header* h;
+  uint8_t* payload;
+  uint64_t map_len;
+  int fd;
+};
+
+static int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (parent) or attach (worker) an arena of `capacity` payload bytes.
+void* shm_arena_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale arena from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  std::memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  h->n_blocks = 1;
+  h->blocks[0] = Block{0, capacity, 0, 0};
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &attr);
+  pthread_mutexattr_destroy(&attr);
+  h->magic = kMagic;
+  Arena* a = new Arena{h, (uint8_t*)mem + sizeof(Header), total, fd};
+  return a;
+}
+
+void* shm_arena_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  if (h->magic != kMagic) {
+    munmap(mem, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Arena* a = new Arena{h, (uint8_t*)mem + sizeof(Header), (uint64_t)st.st_size,
+                       fd};
+  return a;
+}
+
+// Returns payload offset or UINT64_MAX when full / fragmented.
+uint64_t shm_arena_alloc(void* arena, uint64_t size) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->h;
+  size = (size + 63) & ~63ULL;  // 64B alignment
+  if (size == 0) size = 64;
+  if (lock(h) != 0) return UINT64_MAX;
+  uint64_t got = UINT64_MAX;
+  for (uint32_t i = 0; i < h->n_blocks; ++i) {
+    Block& b = h->blocks[i];
+    if (b.used || b.size < size) continue;
+    if (b.size > size && h->n_blocks < kMaxBlocks) {  // split
+      std::memmove(&h->blocks[i + 2], &h->blocks[i + 1],
+                   (h->n_blocks - i - 1) * sizeof(Block));
+      h->blocks[i + 1] = Block{b.off + size, b.size - size, 0, 0};
+      b.size = size;
+      h->n_blocks++;
+    }
+    b.used = 1;
+    got = b.off;
+    break;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return got;
+}
+
+int shm_arena_free(void* arena, uint64_t off) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->h;
+  if (lock(h) != 0) return -1;
+  int rc = -1;
+  for (uint32_t i = 0; i < h->n_blocks; ++i) {
+    if (h->blocks[i].off != off || !h->blocks[i].used) continue;
+    h->blocks[i].used = 0;
+    // coalesce with right then left neighbour
+    if (i + 1 < h->n_blocks && !h->blocks[i + 1].used) {
+      h->blocks[i].size += h->blocks[i + 1].size;
+      std::memmove(&h->blocks[i + 1], &h->blocks[i + 2],
+                   (h->n_blocks - i - 2) * sizeof(Block));
+      h->n_blocks--;
+    }
+    if (i > 0 && !h->blocks[i - 1].used) {
+      h->blocks[i - 1].size += h->blocks[i].size;
+      std::memmove(&h->blocks[i], &h->blocks[i + 1],
+                   (h->n_blocks - i - 1) * sizeof(Block));
+      h->n_blocks--;
+    }
+    rc = 0;
+    break;
+  }
+  pthread_mutex_unlock(&h->mu);
+  return rc;
+}
+
+// Raw pointer to payload at offset (valid while the mapping lives).
+void* shm_arena_ptr(void* arena, uint64_t off) {
+  Arena* a = (Arena*)arena;
+  return a->payload + off;
+}
+
+void shm_arena_write(void* arena, uint64_t off, const void* src, uint64_t n) {
+  Arena* a = (Arena*)arena;
+  std::memcpy(a->payload + off, src, n);
+}
+
+void shm_arena_read(void* arena, uint64_t off, void* dst, uint64_t n) {
+  Arena* a = (Arena*)arena;
+  std::memcpy(dst, a->payload + off, n);
+}
+
+uint64_t shm_arena_capacity(void* arena) { return ((Arena*)arena)->h->capacity; }
+
+// Bytes currently allocated (diagnostics / tests).
+uint64_t shm_arena_used(void* arena) {
+  Arena* a = (Arena*)arena;
+  Header* h = a->h;
+  if (lock(h) != 0) return 0;
+  uint64_t used = 0;
+  for (uint32_t i = 0; i < h->n_blocks; ++i)
+    if (h->blocks[i].used) used += h->blocks[i].size;
+  pthread_mutex_unlock(&h->mu);
+  return used;
+}
+
+void shm_arena_detach(void* arena) {
+  Arena* a = (Arena*)arena;
+  munmap((void*)a->h, a->map_len);
+  close(a->fd);
+  delete a;
+}
+
+void shm_arena_destroy(void* arena, const char* name) {
+  shm_arena_detach(arena);
+  shm_unlink(name);
+}
+
+}  // extern "C"

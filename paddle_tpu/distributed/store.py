@@ -7,13 +7,8 @@ fallback keeps the API available without a toolchain.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "core", "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libtcp_store.so")
-_SRC_PATH = os.path.join(_NATIVE_DIR, "tcp_store.cc")
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -23,13 +18,9 @@ def _load_native():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH) or (
-                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
-            subprocess.run(
-                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-                 _SRC_PATH, "-o", _SO_PATH],
-                check=True, capture_output=True)
-        lib = ctypes.CDLL(_SO_PATH)
+        from ..core.native.build import load_native
+
+        lib = load_native("tcp_store")
         lib.tcp_store_server_start.restype = ctypes.c_void_p
         lib.tcp_store_server_start.argtypes = [ctypes.c_int]
         lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue as queue_mod
+import time
 import threading
 from typing import Iterable, List, Optional
 
@@ -288,7 +290,7 @@ def default_collate_fn(batch):
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, seed):
+                 num_workers, seed, arena=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed(seed)
@@ -301,6 +303,10 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
             samples = [dataset[i] for i in indices]
             batch = collate_fn(samples)
             batch = _to_numpy_tree(batch)
+            if arena is not None:
+                from .shm import pack_tree
+
+                batch = pack_tree(batch, arena)
             data_queue.put((task_id, batch, None))
         except Exception as e:  # propagate worker errors
             data_queue.put((task_id, None, e))
@@ -337,6 +343,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -389,19 +396,68 @@ class DataLoader:
     def _iter_multiprocess(self):
         import multiprocessing as mp
 
-        ctx = mp.get_context("fork")
+        # fork is only safe while JAX has no live non-CPU backend: the TPU /
+        # tunnel clients own threads+locks that deadlock a forked child (the
+        # reference hits the same with CUDA contexts and also switches to
+        # spawn-style workers).  spawn children are exec-fresh and read the
+        # parent env at start() time; worker payloads (dataset, collate_fn)
+        # must then be picklable.
+        method = os.environ.get("PT_DATALOADER_START_METHOD")
+        if method is None:
+            unsafe = False
+            try:
+                from jax._src import xla_bridge as _xb
+
+                unsafe = any(k != "cpu"
+                             for k in getattr(_xb, "_backends", {}))
+            except Exception:
+                pass
+            method = "spawn" if unsafe else "fork"
+        ctx = mp.get_context(method)
         index_queues = [ctx.SimpleQueue() for _ in range(self.num_workers)]
-        data_queue = ctx.SimpleQueue()
+        data_queue = ctx.Queue()
+        arena = None
         workers = []
-        for wid in range(self.num_workers):
-            w = ctx.Process(
-                target=_worker_loop,
-                args=(self.dataset, index_queues[wid], data_queue,
-                      self.collate_fn, wid, self.num_workers,
-                      np.random.randint(0, 2 ** 31)),
-                daemon=True)
-            w.start()
-            workers.append(w)
+        # Keep worker processes off the accelerator: they produce host
+        # batches only, and a fresh child dialing the TPU client would race
+        # the parent for the chip.  (fork children never re-init JAX, so the
+        # env is only mutated for exec-fresh start methods.)
+        saved_platforms = os.environ.get("JAX_PLATFORMS")
+        try:
+            if method != "fork":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+            # Shared-memory transport (reference: use_shared_memory + the
+            # mmap allocator): fork workers inherit the arena mapping;
+            # spawn workers re-attach by name when unpickling it.
+            if self.use_shared_memory:
+                from . import shm
+
+                if shm.shm_available():
+                    try:
+                        arena = shm.ShmArena()
+                    except Exception:
+                        arena = None
+            for wid in range(self.num_workers):
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(self.dataset, index_queues[wid], data_queue,
+                          self.collate_fn, wid, self.num_workers,
+                          np.random.randint(0, 2 ** 31), arena),
+                    daemon=True)
+                w.start()
+                workers.append(w)
+        except BaseException:
+            for w in workers:
+                w.terminate()
+            if arena is not None:
+                arena.destroy()
+            raise
+        finally:
+            if method != "fork":
+                if saved_platforms is not None:
+                    os.environ["JAX_PLATFORMS"] = saved_platforms
+                else:
+                    os.environ.pop("JAX_PLATFORMS", None)
 
         try:
             batches = list(self.batch_sampler)
@@ -419,7 +475,25 @@ class DataLoader:
                 inflight += 1
             while want < n_tasks:
                 while want not in results:
-                    task_id, data, err = data_queue.get()
+                    # Liveness-aware get: a worker that dies before putting
+                    # (unpicklable payload, failed arena attach, OOM-kill)
+                    # must raise here, not hang the training loop.
+                    deadline = time.monotonic() + (self.timeout or 3600)
+                    while True:
+                        try:
+                            task_id, data, err = data_queue.get(timeout=1)
+                            break
+                        except queue_mod.Empty:
+                            dead = [w for w in workers if not w.is_alive()]
+                            if dead:
+                                raise RuntimeError(
+                                    "DataLoader worker (pid "
+                                    f"{dead[0].pid}) exited unexpectedly "
+                                    f"with code {dead[0].exitcode}")
+                            if time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    f"DataLoader timed out after "
+                                    f"{self.timeout}s waiting for a batch")
                     if err is not None:
                         raise err
                     results[task_id] = data
@@ -429,7 +503,12 @@ class DataLoader:
                             (next_task, batches[next_task]))
                         next_task += 1
                         inflight += 1
-                yield _to_tensor_tree(results.pop(want))
+                data = results.pop(want)
+                if arena is not None:
+                    from .shm import unpack_tree
+
+                    data = unpack_tree(data, arena)
+                yield _to_tensor_tree(data)
                 want += 1
         finally:
             for q in index_queues:
@@ -438,3 +517,5 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if arena is not None:
+                arena.destroy()

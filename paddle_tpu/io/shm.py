@@ -1,0 +1,172 @@
+"""Shared-memory tensor transport for multiprocess DataLoader workers.
+
+Python face of paddle_tpu/core/native/shm_arena.cc (TPU-native equivalent of
+the reference's mmap shared-memory DataLoader tensors —
+paddle/fluid/memory/allocation/mmap_allocator.cc + fluid/dataloader
+worker.py `use_shared_memory`).  Workers memcpy ndarray payloads into a
+POSIX shm arena created by the parent before fork; only (offset, shape,
+dtype) travels through the result queue, so large batches skip pickling.
+
+Fork-only: the child inherits the parent's mapping, so the raw arena handle
+(a heap pointer) stays valid across the process boundary.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+_lib = None
+_lib_failed = False
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        from ..core.native.build import load_native
+
+        lib = load_native("shm_arena", extra_flags=("-lrt",))
+        lib.shm_arena_create.restype = ctypes.c_void_p
+        lib.shm_arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_arena_attach.restype = ctypes.c_void_p
+        lib.shm_arena_attach.argtypes = [ctypes.c_char_p]
+        lib.shm_arena_alloc.restype = ctypes.c_uint64
+        lib.shm_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_free.restype = ctypes.c_int
+        lib.shm_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_ptr.restype = ctypes.c_void_p
+        lib.shm_arena_ptr.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_write.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_read.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_used.restype = ctypes.c_uint64
+        lib.shm_arena_used.argtypes = [ctypes.c_void_p]
+        lib.shm_arena_capacity.restype = ctypes.c_uint64
+        lib.shm_arena_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_arena_detach.argtypes = [ctypes.c_void_p]
+        lib.shm_arena_destroy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+_UINT64_MAX = 2 ** 64 - 1
+_arena_counter = 0
+
+# Leaves smaller than this stay on the pickle path (header overhead wins).
+MIN_SHM_BYTES = 4096
+
+
+@dataclass
+class ShmRef:
+    """Queue-transportable handle to an array living in the arena."""
+    offset: int
+    shape: tuple
+    dtype: str
+
+
+class ShmArena:
+    """First-fit shm allocator shared parent<->worker processes.
+
+    Forked workers inherit the mapping; spawned/forkserver workers re-attach
+    by name via ``__reduce__`` (shm_open of the same POSIX object)."""
+
+    def __init__(self, capacity: int = 256 << 20):
+        global _arena_counter
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shm_arena unavailable")
+        _arena_counter += 1
+        self.name = f"/pt_shm_{os.getpid()}_{_arena_counter}".encode()
+        self._lib = lib
+        self._h = lib.shm_arena_create(self.name, capacity)
+        if not self._h:
+            raise RuntimeError("shm_arena_create failed")
+        self._owner_pid = os.getpid()
+
+    @classmethod
+    def _attach(cls, name: bytes) -> "ShmArena":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shm_arena unavailable")
+        self = cls.__new__(cls)
+        self.name = name
+        self._lib = lib
+        self._h = lib.shm_arena_attach(name)
+        if not self._h:
+            raise RuntimeError(f"shm_arena_attach({name!r}) failed")
+        self._owner_pid = -1  # attached: never unlink, only detach
+        return self
+
+    def __reduce__(self):
+        return (ShmArena._attach, (self.name,))
+
+    def put_array(self, arr: np.ndarray) -> Optional[ShmRef]:
+        arr = np.ascontiguousarray(arr)
+        off = self._lib.shm_arena_alloc(self._h, arr.nbytes)
+        if off == _UINT64_MAX:
+            return None  # arena full — caller falls back to pickling
+        self._lib.shm_arena_write(self._h, off, arr.ctypes.data, arr.nbytes)
+        return ShmRef(off, arr.shape, arr.dtype.str)
+
+    def get_array(self, ref: ShmRef, free: bool = True) -> np.ndarray:
+        out = np.empty(ref.shape, dtype=np.dtype(ref.dtype))
+        self._lib.shm_arena_read(self._h, ref.offset, out.ctypes.data,
+                                 out.nbytes)
+        if free:
+            self._lib.shm_arena_free(self._h, ref.offset)
+        return out
+
+    def free(self, ref: ShmRef):
+        self._lib.shm_arena_free(self._h, ref.offset)
+
+    def used_bytes(self) -> int:
+        return self._lib.shm_arena_used(self._h)
+
+    def destroy(self):
+        if self._h:
+            if os.getpid() == self._owner_pid:
+                self._lib.shm_arena_destroy(self._h, self.name)
+            else:
+                self._lib.shm_arena_detach(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort cleanup
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+def pack_tree(obj, arena: ShmArena):
+    """Replace large ndarray leaves with ShmRefs (worker side)."""
+    if isinstance(obj, np.ndarray) and obj.nbytes >= MIN_SHM_BYTES:
+        ref = arena.put_array(obj)
+        return ref if ref is not None else obj
+    if isinstance(obj, (list, tuple)):
+        return [pack_tree(v, arena) for v in obj]
+    if isinstance(obj, dict):
+        return {k: pack_tree(v, arena) for k, v in obj.items()}
+    return obj
+
+
+def unpack_tree(obj, arena: ShmArena):
+    """Materialize ShmRefs back to ndarrays, freeing slots (parent side)."""
+    if isinstance(obj, ShmRef):
+        return arena.get_array(obj, free=True)
+    if isinstance(obj, (list, tuple)):
+        return [unpack_tree(v, arena) for v in obj]
+    if isinstance(obj, dict):
+        return {k: unpack_tree(v, arena) for k, v in obj.items()}
+    return obj
+
+
+def shm_available() -> bool:
+    return _load() is not None

@@ -41,6 +41,7 @@ class _HostEventRecorder:
         self._local = threading.local()
         self._all_buffers = []
         self._lock = threading.Lock()
+        self._native = None  # None = undecided, False = python fallback
 
     def _buffer(self):
         buf = getattr(self._local, "buf", None)
@@ -52,11 +53,24 @@ class _HostEventRecorder:
         return buf
 
     def record(self, name, start_ns, end_ns, category="host"):
-        self._buffer().append((name, start_ns, end_ns, category))
+        # Prefer the native recorder (core/native/host_tracer.cc) for the
+        # default category: the hot path is a C++ clock read + push.  The
+        # native buffer carries no category, so non-host events stay on the
+        # Python buffer.  The native-vs-fallback decision is resolved once.
+        if self._native is None:
+            from . import host_tracer
+
+            self._native = host_tracer if host_tracer.available() else False
+        if self._native and category == "host":
+            self._native.emit(name, start_ns, end_ns)
+        else:
+            self._buffer().append((name, start_ns, end_ns, category))
 
     def drain(self):
+        from . import host_tracer
+
+        out = list(host_tracer.drain())
         with self._lock:
-            out = []
             for tid, buf in self._all_buffers:
                 out.extend((tid,) + e for e in buf)
                 buf.clear()

@@ -1,0 +1,110 @@
+"""ctypes binding for the native host event recorder
+(paddle_tpu/core/native/host_tracer.cc — reference:
+paddle/fluid/platform/profiler/host_event_recorder.h).
+
+Event begin/end on the hot path happens in C++ (clock read + vector push);
+Python only interns names once and drains snapshots at profiler stop.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Tuple
+
+_lib = None
+_lib_failed = False
+_intern_cache: dict = {}
+
+
+def _load():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        from ..core.native.build import load_native
+
+        lib = load_native("host_tracer")
+        lib.ht_intern.restype = ctypes.c_uint32
+        lib.ht_intern.argtypes = [ctypes.c_char_p]
+        lib.ht_enable.argtypes = [ctypes.c_int]
+        lib.ht_enabled.restype = ctypes.c_int
+        lib.ht_begin.argtypes = [ctypes.c_uint32]
+        lib.ht_emit.argtypes = [ctypes.c_uint32, ctypes.c_uint64,
+                                ctypes.c_uint64]
+        lib.ht_now_ns.restype = ctypes.c_uint64
+        lib.ht_snapshot.restype = ctypes.c_uint64
+        lib.ht_read.argtypes = [ctypes.c_uint64,
+                                ctypes.POINTER(ctypes.c_uint32),
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.ht_name.restype = ctypes.c_uint32
+        lib.ht_name.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                ctypes.c_uint32]
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def intern(name: str) -> int:
+    nid = _intern_cache.get(name)
+    if nid is None:
+        lib = _load()
+        if lib is None:
+            return 0
+        nid = lib.ht_intern(name.encode())
+        _intern_cache[name] = nid
+    return nid
+
+
+def enable(on: bool = True):
+    lib = _load()
+    if lib is not None:
+        lib.ht_enable(1 if on else 0)
+
+
+def emit(name: str, start_ns: int, end_ns: int):
+    lib = _load()
+    if lib is not None:
+        lib.ht_emit(intern(name), start_ns, end_ns)
+
+
+def begin(name: str):
+    lib = _load()
+    if lib is not None:
+        lib.ht_begin(intern(name))
+
+
+def end():
+    lib = _load()
+    if lib is not None:
+        lib.ht_end()
+
+
+def drain() -> List[Tuple[int, str, int, int, str]]:
+    """(tid, name, start_ns, end_ns, 'host') tuples, clearing the buffers."""
+    lib = _load()
+    if lib is None:
+        return []
+    n = lib.ht_snapshot()
+    out = []
+    name_id = ctypes.c_uint32()
+    tid = ctypes.c_uint64()
+    s = ctypes.c_uint64()
+    e = ctypes.c_uint64()
+    buf = ctypes.create_string_buffer(512)
+    names: dict = {}
+    for i in range(n):
+        lib.ht_read(i, ctypes.byref(name_id), ctypes.byref(tid),
+                    ctypes.byref(s), ctypes.byref(e))
+        nm = names.get(name_id.value)
+        if nm is None:
+            ln = lib.ht_name(name_id.value, buf, 512)
+            nm = buf.raw[:ln].decode(errors="replace")
+            names[name_id.value] = nm
+        out.append((tid.value, nm, s.value, e.value, "host"))
+    return out

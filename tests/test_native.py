@@ -1,0 +1,181 @@
+"""Native (C++) runtime components: shm arena, host tracer.
+
+Reference parity: mmap_allocator (DataLoader shared-memory tensors) and
+profiler host_event_recorder.h.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import shm
+from paddle_tpu.profiler import host_tracer
+
+
+needs_shm = pytest.mark.skipif(not shm.shm_available(),
+                               reason="native shm arena unavailable")
+needs_tracer = pytest.mark.skipif(not host_tracer.available(),
+                                  reason="native host tracer unavailable")
+
+
+@needs_shm
+class TestShmArena:
+    def test_roundtrip(self):
+        arena = shm.ShmArena(capacity=1 << 20)
+        a = np.arange(5000, dtype=np.float32).reshape(50, 100)
+        ref = arena.put_array(a)
+        assert ref is not None
+        out = arena.get_array(ref)
+        np.testing.assert_array_equal(out, a)
+        assert arena.used_bytes() == 0  # freed on read
+        arena.destroy()
+
+    def test_alloc_free_coalesce(self):
+        arena = shm.ShmArena(capacity=1 << 20)
+        refs = [arena.put_array(np.zeros(10000, np.uint8)) for _ in range(3)]
+        assert all(r is not None for r in refs)
+        for r in refs:
+            arena.free(r)
+        assert arena.used_bytes() == 0
+        # after coalescing a full-capacity alloc must succeed
+        big = arena.put_array(np.zeros((1 << 20) - 64, np.uint8))
+        assert big is not None
+        arena.destroy()
+
+    def test_full_arena_returns_none(self):
+        arena = shm.ShmArena(capacity=1 << 16)
+        assert arena.put_array(np.zeros(1 << 20, np.uint8)) is None
+        arena.destroy()
+
+    def test_pack_unpack_tree(self):
+        arena = shm.ShmArena(capacity=1 << 20)
+        big = np.random.rand(100, 100)
+        small = np.arange(3)
+        tree = {"x": big, "y": [small, big * 2], "z": "meta"}
+        packed = shm.pack_tree(tree, arena)
+        assert isinstance(packed["x"], shm.ShmRef)
+        assert isinstance(packed["y"][0], np.ndarray)  # under threshold
+        out = shm.unpack_tree(packed, arena)
+        np.testing.assert_array_equal(out["x"], big)
+        np.testing.assert_array_equal(out["y"][1], big * 2)
+        assert out["z"] == "meta"
+        assert arena.used_bytes() == 0
+        arena.destroy()
+
+    def test_dataloader_uses_shm(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.full((64, 64), i, np.float32), np.int64(i)
+
+        dl = DataLoader(DS(), batch_size=2, num_workers=2,
+                        use_shared_memory=True)
+        seen = []
+        for x, y in dl:
+            assert x.shape == [2, 64, 64]
+            seen.extend(np.asarray(y.numpy()).tolist())
+        assert sorted(seen) == list(range(8))
+
+
+@needs_tracer
+class TestHostTracer:
+    def test_emit_drain(self):
+        host_tracer.drain()  # clear
+        host_tracer.emit("step", 100, 250)
+        host_tracer.emit("io", 300, 400)
+        evs = host_tracer.drain()
+        names = {e[1] for e in evs}
+        assert {"step", "io"} <= names
+        ev = next(e for e in evs if e[1] == "step")
+        assert ev[3] - ev[2] == 150
+        assert host_tracer.drain() == []  # drained
+
+    def test_begin_end(self):
+        host_tracer.enable(True)
+        host_tracer.begin("ranged")
+        host_tracer.end()
+        host_tracer.enable(False)
+        evs = host_tracer.drain()
+        assert any(e[1] == "ranged" and e[3] >= e[2] for e in evs)
+
+    def test_profiler_integration(self):
+        import paddle_tpu.profiler as profiler
+
+        p = profiler.Profiler()
+        p.start()
+        with profiler.RecordEvent("my_range"):
+            pass
+        p.stop()
+        assert any(name == "my_range" for _, name, *_ in p.events)
+
+
+class TestExecFreshWorkers:
+    """spawn/forkserver workers (the fork-unsafe-backend path): dataset is
+    pickled and the shm arena re-attaches by name in the child."""
+
+    @pytest.mark.parametrize("method", ["spawn", "forkserver"])
+    def test_dataloader_exec_fresh(self, tmp_path, method):
+        import os
+        import subprocess
+        import sys
+
+        import paddle_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
+        script = tmp_path / "dl_fs.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class DS:\n"
+            "    def __len__(self): return 16\n"
+            "    def __getitem__(self, i):\n"
+            "        return (np.random.rand(64, 64).astype(np.float32),\n"
+            "                np.int64(i))\n"
+            "if __name__ == '__main__':\n"
+            "    from paddle_tpu.io import DataLoader\n"
+            "    dl = DataLoader(DS(), batch_size=4, num_workers=2,\n"
+            "                    use_shared_memory=True)\n"
+            "    ys = []\n"
+            "    for x, y in dl:\n"
+            "        assert x.shape == [4, 64, 64]\n"
+            "        ys.extend(np.asarray(y.numpy()).tolist())\n"
+            "    assert sorted(ys) == list(range(16)), ys\n"
+            "    print('FS-OK')\n")
+        env = dict(os.environ, PYTHONPATH=repo_root,
+                   JAX_PLATFORMS="cpu",
+                   PT_DATALOADER_START_METHOD=method)
+        out = subprocess.run([sys.executable, "-u", str(script)], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert "FS-OK" in out.stdout, out.stderr[-2000:]
+
+    def test_dead_worker_raises(self, tmp_path):
+        """A worker that dies before producing must raise, not hang."""
+        import os
+        import subprocess
+        import sys
+
+        import paddle_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
+        script = tmp_path / "dl_dead.py"
+        script.write_text(
+            "import numpy as np, os\n"
+            "class DS:\n"
+            "    def __len__(self): return 8\n"
+            "    def __getitem__(self, i):\n"
+            "        os._exit(3)  # simulate a crashed worker\n"
+            "if __name__ == '__main__':\n"
+            "    from paddle_tpu.io import DataLoader\n"
+            "    dl = DataLoader(DS(), batch_size=2, num_workers=1,\n"
+            "                    use_shared_memory=False)\n"
+            "    try:\n"
+            "        next(iter(dl))\n"
+            "    except RuntimeError as e:\n"
+            "        assert 'exited unexpectedly' in str(e), e\n"
+            "        print('DEAD-OK')\n")
+        env = dict(os.environ, PYTHONPATH=repo_root, JAX_PLATFORMS="cpu",
+                   PT_DATALOADER_START_METHOD="spawn")
+        out = subprocess.run([sys.executable, "-u", str(script)], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert "DEAD-OK" in out.stdout, out.stderr[-2000:]
