@@ -37,14 +37,21 @@ def define_flag(name: str, default, help: str = ""):
     return _REGISTRY[name]
 
 
+def _canon(name: str) -> str:
+    # paddle.get_flags/set_flags take "FLAGS_<name>" keys; the registry
+    # stores bare names.  Accept both.
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
 def get_flags(names):
     if isinstance(names, str):
         names = [names]
-    return {n: _REGISTRY[n].value for n in names}
+    return {n: _REGISTRY[_canon(n)].value for n in names}
 
 
 def set_flags(flags: Dict[str, Any]):
     for name, value in flags.items():
+        name = _canon(name)
         if name not in _REGISTRY:
             raise KeyError(f"unknown flag {name!r}")
         flag = _REGISTRY[name]

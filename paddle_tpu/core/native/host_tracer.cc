@@ -7,6 +7,9 @@
 // registration/collection time, so ht_begin/ht_end on the hot path are a
 // clock read + vector push with no lock contention.  Strings are interned
 // once (ht_intern) so events carry a 4-byte id, not a pointer.
+#include <sys/syscall.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -47,14 +50,15 @@ struct Recorder {
 };
 
 static Recorder g_rec;
-static std::atomic<uint64_t> g_tid_counter{1};
 
 static thread_local ThreadBuffer* tl_buf = nullptr;
 
 static ThreadBuffer* buf() {
   if (tl_buf == nullptr) {
     auto* b = new ThreadBuffer();
-    b->tid = g_tid_counter.fetch_add(1);
+    // OS thread id: matches python threading.get_native_id(), so native and
+    // python-buffered events merge into one per-thread timeline.
+    b->tid = (uint64_t)syscall(SYS_gettid);
     std::lock_guard<std::mutex> g(g_rec.registry_mu);
     g_rec.buffers.push_back(b);
     tl_buf = b;

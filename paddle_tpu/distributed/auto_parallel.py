@@ -15,6 +15,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from .converter import Converter  # noqa: F401
 from .mesh import ProcessMesh, get_mesh, set_mesh
 from .sharding import shard_tensor as _shard_tensor
 

@@ -1,0 +1,101 @@
+"""Cross-mesh checkpoint conversion (reference:
+python/paddle/distributed/auto_parallel/converter.py — merge per-rank
+shards saved under one ProcessMesh/dims_mapping and re-slice them for a
+different one).
+
+The orbax path (checkpoint.py) reshards natively; this Converter covers the
+reference's explicit API: numpy-level merge + re-split driven by strategy
+dicts {name: {"process_shape": [...], "dims_mapping": [...]}} where
+dims_mapping[i] = mesh axis tensor-dim i is sharded on (-1 = replicated).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["Converter"]
+
+
+def _rank_coords(process_shape):
+    """Rank -> mesh coordinates, row-major over the process grid."""
+    coords = []
+    n = int(np.prod(process_shape))
+    for r in range(n):
+        c, rem = [], r
+        for dim in reversed(process_shape):
+            c.append(rem % dim)
+            rem //= dim
+        coords.append(tuple(reversed(c)))
+    return coords
+
+
+def merge_shards(shards: List[np.ndarray], process_shape,
+                 dims_mapping) -> np.ndarray:
+    """Reassemble the global tensor from per-rank shards."""
+    coords = _rank_coords(process_shape)
+    sample = shards[0]
+    global_shape = list(sample.shape)
+    for tdim, mdim in enumerate(dims_mapping):
+        if mdim >= 0:
+            global_shape[tdim] = sample.shape[tdim] * process_shape[mdim]
+    out = np.zeros(global_shape, sample.dtype)
+    for rank, shard in enumerate(shards):
+        idx = []
+        for tdim, mdim in enumerate(dims_mapping):
+            if mdim >= 0:
+                i = coords[rank][mdim]
+                step = shard.shape[tdim]
+                idx.append(slice(i * step, (i + 1) * step))
+            else:
+                idx.append(slice(None))
+        out[tuple(idx)] = shard
+    return out
+
+
+def split_tensor(tensor: np.ndarray, process_shape,
+                 dims_mapping) -> List[np.ndarray]:
+    """Slice the global tensor into one shard per rank."""
+    coords = _rank_coords(process_shape)
+    shards = []
+    for rank in range(int(np.prod(process_shape))):
+        idx = []
+        for tdim, mdim in enumerate(dims_mapping):
+            if mdim >= 0:
+                parts = process_shape[mdim]
+                step = tensor.shape[tdim] // parts
+                i = coords[rank][mdim]
+                idx.append(slice(i * step, (i + 1) * step))
+            else:
+                idx.append(slice(None))
+        shards.append(np.ascontiguousarray(tensor[tuple(idx)]))
+    return shards
+
+
+class Converter:
+    """convert(): pre-strategy per-rank shards -> cur-strategy shards."""
+
+    def __init__(self, tensors_dict: Dict[str, List[np.ndarray]],
+                 pre_strategy: Dict[str, dict],
+                 cur_strategy: Dict[str, dict]):
+        self.tensors_dict = tensors_dict
+        self.pre_strategy = pre_strategy
+        self.cur_strategy = cur_strategy
+
+    def convert(self) -> Dict[str, List[np.ndarray]]:
+        out = {}
+        for name, shards in self.tensors_dict.items():
+            if not isinstance(shards, (list, tuple)):
+                shards = [shards]
+            shards = [np.asarray(s) for s in shards]
+            pre = self.pre_strategy.get(name)
+            cur = self.cur_strategy.get(name)
+            merged = (merge_shards(shards, pre["process_shape"],
+                                   pre["dims_mapping"])
+                      if pre is not None else shards[0])
+            if cur is None:
+                out[name] = [merged]
+            else:
+                out[name] = split_tensor(merged, cur["process_shape"],
+                                         cur["dims_mapping"])
+        return out

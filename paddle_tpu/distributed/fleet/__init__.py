@@ -20,6 +20,7 @@ from ..mesh import (CommunicateTopology, HybridCommunicateGroup, fleet_mesh,
                     get_hybrid_communicate_group, get_mesh)
 from .distributed_strategy import DistributedStrategy
 from .meta_optimizers import DGCMomentum, LocalSGDOptimizer  # noqa: F401
+from . import elastic  # noqa: F401
 
 _FLEET = None
 

@@ -1,0 +1,125 @@
+"""Elastic training manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:130 ElasticManager —
+etcd node watches, lease heartbeats, scale up/down detection, trainer
+relaunch; and launch/controllers/master.py rendezvous).
+
+TPU-native twist: the rendezvous/heartbeat KV is our own native TCPStore
+(distributed/store.py, C++ server) instead of etcd — one fewer external
+service, same watch/lease semantics.  Each node registers under
+``nodes/<host>``, refreshes a heartbeat lease in a daemon thread, and the
+manager detects membership changes (dead lease or new registration) to
+drive scale-up/down: on change it rebuilds the endpoint list and invokes
+the restart callback (which reloads from checkpoint, reference behavior).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Membership + heartbeat over a TCPStore; decides when the job must
+    restart (membership changed) or hold (within min/max nodes)."""
+
+    def __init__(self, store, node_id: str, np_range=(1, 1),
+                 heartbeat_interval: float = 2.0,
+                 lease_ttl: float = 6.0,
+                 on_restart: Optional[Callable[[List[str]], None]] = None):
+        self.store = store
+        self.node_id = node_id
+        self.min_np, self.max_np = (np_range if isinstance(np_range, tuple)
+                                    else (np_range, np_range))
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.on_restart = on_restart
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_members: List[str] = []
+
+    # ---------------------------------------------------------- membership
+    def register(self):
+        self.store.set(f"nodes/{self.node_id}",
+                       json.dumps({"ts": time.time()}))
+        # Registry is append-only via the store's atomic counter: slot n is
+        # claimed with add() (no lost updates under concurrent joins),
+        # then written once.  Readers scan slots 1..count and dedupe.
+        slot = self.store.add("nodes/__count__", 1)
+        self.store.set(f"nodes/__reg__/{slot}", self.node_id)
+        members = self._alive_members()
+        self._last_members = members
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _heartbeat(self):
+        self.store.set(f"nodes/{self.node_id}",
+                       json.dumps({"ts": time.time()}))
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._heartbeat()
+            except Exception:
+                pass
+            self._stop.wait(self.heartbeat_interval)
+
+    def _alive_members(self) -> List[str]:
+        """Nodes whose lease is fresher than lease_ttl, discovered through
+        the append-only slot registry (atomic-counter claims, so concurrent
+        registrations are never lost)."""
+        now = time.time()
+        count = int(self.store.add("nodes/__count__", 0))
+        index = set()
+        for slot in range(1, count + 1):
+            try:
+                nid = self.store.get(f"nodes/__reg__/{slot}", wait=False)
+                if nid:
+                    index.add(nid.decode() if isinstance(nid, bytes) else nid)
+            except Exception:
+                continue
+        index.add(self.node_id)
+        alive = []
+        for nid in sorted(index):
+            try:
+                info = json.loads(self.store.get(f"nodes/{nid}", wait=False))
+                if now - float(info["ts"]) <= self.lease_ttl:
+                    alive.append(nid)
+            except Exception:
+                continue
+        return alive
+
+    # ------------------------------------------------------------- control
+    def watch(self) -> str:
+        """One scheduling decision (reference: manager.py watch loop)."""
+        members = self._alive_members()
+        if members != self._last_members:
+            self._last_members = members
+            if len(members) < self.min_np:
+                return ElasticStatus.HOLD  # wait for nodes to come back
+            if self.on_restart is not None:
+                self.on_restart(members)
+            return ElasticStatus.RESTART
+        if not (self.min_np <= len(members) <= self.max_np):
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    def exit(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self.store.delete_key(f"nodes/{self.node_id}")
+        except Exception:
+            pass

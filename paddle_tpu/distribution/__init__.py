@@ -460,3 +460,124 @@ def _kl_exponential(p, q):
         ratio = qr / pr
         return ratio - jnp.log(ratio) - 1
     return apply("kl_exponential", _kl, p.rate, q.rate)
+
+
+def _sum_rightmost(v, k):
+    return jnp.sum(v, axis=tuple(range(-k, 0))) if k > 0 else v
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of y = T(x), x ~ base (reference: the 2.x
+    paddle.distribution.TransformedDistribution API).  Event-dim
+    bookkeeping follows the torch/paddle convention: a transform's
+    log-det-jacobian comes back with its codomain event dims already
+    reduced, and the remaining event dims are summed here."""
+
+    def __init__(self, base: Distribution, transforms):
+        from .transform import ChainTransform, Transform
+
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out = self._chain.forward_shape(shape)
+        event_dim = max([len(base.event_shape)]
+                        + [t._codomain_event_dim for t in self.transforms])
+        cut = len(out) - event_dim
+        super().__init__(batch_shape=out[:cut], event_shape=out[cut:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(self._shape(shape))
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(self._shape(shape))
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        # event_dim evolution is static (no tensor dependence)
+        event_dims = []
+        event_dim = len(self.event_shape)
+        for t in reversed(self.transforms):
+            event_dims.append(event_dim)
+            event_dim += t._domain_event_dim - t._codomain_event_dim
+
+        def _lp(y):
+            acc = None
+            for t, ed in zip(reversed(self.transforms), event_dims):
+                x = t._inverse(y)
+                ildj = _sum_rightmost(-t._forward_log_det_jacobian(x),
+                                      ed - t._codomain_event_dim)
+                acc = ildj if acc is None else acc + ildj
+                y = x
+            return y, acc
+
+        x, ildj = apply("transformed_invert", _lp, value)
+        base_lp = self.base.log_prob(x)
+        extra = event_dim - len(self.base.event_shape)
+        if extra > 0:
+            def _sum(v):
+                return _sum_rightmost(v, extra)
+
+            base_lp = apply("sum_event_dims", _sum, base_lp)
+        return base_lp + ildj
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims of
+    `base` as event dims: log_prob sums over them."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        k = self.reinterpreted_batch_rank
+        super().__init__(batch_shape=bshape[:len(bshape) - k],
+                         event_shape=bshape[len(bshape) - k:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        from ..core.dispatch import apply
+
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+
+        def _sum(v):
+            return jnp.sum(v, axis=axes)
+
+        return apply("independent_sum", _sum, lp)
+
+    def entropy(self):
+        from ..core.dispatch import apply
+
+        ent = self.base.entropy()
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+
+        def _sum(v):
+            return jnp.sum(v, axis=axes)
+
+        return apply("independent_sum", _sum, ent)
+
+
+from . import transform  # noqa: E402,F401
+from .transform import (AbsTransform, AffineTransform, ChainTransform,  # noqa: E402,F401
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform)
+
+__all__ += ["TransformedDistribution", "Independent", "Transform",
+            "AbsTransform", "AffineTransform", "ChainTransform",
+            "ExpTransform", "IndependentTransform", "PowerTransform",
+            "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+            "StackTransform", "StickBreakingTransform", "TanhTransform"]

@@ -1,0 +1,339 @@
+"""paddle.distribution.transform (reference:
+python/paddle/distribution/ transform APIs of the 2.x line; the 2022
+snapshot ships the Distribution zoo in python/paddle/distribution/ and the
+transform family completes it).
+
+Bijective tensor transforms with log-det-jacobian tracking, composable via
+ChainTransform and lifted over batch dims by IndependentTransform; used by
+TransformedDistribution.  All math is jnp (XLA-fusable, TPU-safe).
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return Tensor(jnp.asarray(x))
+
+
+class Transform:
+    """Base transform; subclasses implement _forward/_inverse and
+    _forward_log_det_jacobian (per-element)."""
+
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+    def forward(self, x):
+        return _t(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._forward_log_det_jacobian(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _v(y)
+        return _t(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective; inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    """Compose transforms left-to-right: y = tN(...t1(x))."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        dims = [(t._domain_event_dim, t._codomain_event_dim)
+                for t in self.transforms] or [(0, 0)]
+        self._domain_event_dim = max(d for d, _ in dims)
+        self._codomain_event_dim = max(c for _, c in dims)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t._forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` dims as event
+    dims: the log-det sums over them."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._domain_event_dim = (base._domain_event_dim
+                                  + self.reinterpreted_batch_rank)
+        self._codomain_event_dim = (base._codomain_event_dim
+                                    + self.reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        j = self.base._forward_log_det_jacobian(x)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(j, axis=axes)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part of the tensor."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_dim = len(self.in_event_shape)
+        self._codomain_event_dim = len(self.out_event_shape)
+        if int(jnp.prod(jnp.array(self.in_event_shape or (1,)))) != int(
+                jnp.prod(jnp.array(self.out_event_shape or (1,)))):
+            raise ValueError("in/out event shapes must have equal size")
+
+    def _batch(self, x, event_shape):
+        n = len(event_shape)
+        return x.shape[:x.ndim - n] if n else x.shape
+
+    def _forward(self, x):
+        return x.reshape(self._batch(x, self.in_event_shape)
+                         + self.out_event_shape)
+
+    def _inverse(self, y):
+        return y.reshape(self._batch(y, self.out_event_shape)
+                         + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros(self._batch(x, self.in_event_shape), x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not bijective; inverse = log)."""
+
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not bijective")
+
+
+class StackTransform(Transform):
+    """Apply a different transform to each slice along `axis`."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _apply(self, x, method):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, method)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._apply(x, "_forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} -> K-simplex via stick breaking."""
+
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        # d y_i / d x_i factors: sigmoid' * remaining stick
+        log_stick = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumsum(jnp.log1p(-z), axis=-1)[..., :-1]], axis=-1)
+        return jnp.sum(-jax.nn.softplus(-xo) - jax.nn.softplus(xo)
+                       + log_stick, axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

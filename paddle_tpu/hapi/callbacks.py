@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 import os
+
+import numpy as np
 import time
 
 
@@ -180,3 +182,65 @@ class LRScheduler(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if self.by_epoch and self._sched() is not None:
             self._sched().step()
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: python/paddle/hapi/callbacks.py
+    VisualDL writes via the visualdl LogWriter).  Zero-dep fallback: one
+    JSONL file per run under log_dir, same scalar stream (loss/metrics per
+    step, eval metrics per epoch); uses visualdl when importable."""
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self._writer = None
+        self._file = None
+        self._step = 0
+
+    def _ensure_writer(self):
+        if self._writer is None and self._file is None:
+            try:
+                from visualdl import LogWriter  # optional
+
+                self._writer = LogWriter(logdir=self.log_dir)
+            except Exception:
+                import os
+                import time
+
+                os.makedirs(self.log_dir, exist_ok=True)
+                self._file = open(
+                    os.path.join(self.log_dir,
+                                 f"scalars_{int(time.time())}.jsonl"), "a")
+
+    def _scalar(self, tag, value, step):
+        import json
+
+        self._ensure_writer()
+        if self._writer is not None:
+            self._writer.add_scalar(tag=tag, value=float(value), step=step)
+        else:
+            self._file.write(json.dumps(
+                {"tag": tag, "value": float(value), "step": step}) + "\n")
+            self._file.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._scalar(f"train/{k}", np.mean(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._scalar(f"eval/{k}", np.mean(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

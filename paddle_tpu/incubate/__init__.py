@@ -96,3 +96,62 @@ def softmax_mask_fuse(x, mask, name=None):
     def _fn(v, m):
         return jax.nn.softmax(v + m, axis=-1)
     return apply("softmax_mask_fuse", _fn, _t(x), _t(mask))
+
+
+_khop_rng = None
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       return_eids=False, name=None, seed=None):
+    """K-hop neighbor sampling over a CSC graph (reference:
+    python/paddle/incubate/operators/graph_khop_sampler.py backed by
+    graph_khop_sampler_op.cu).  Host-side numpy sampling (graph prep is a
+    host workload feeding the device), returns Tensors."""
+    import numpy as np
+
+    rowv = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colv = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes
+                       ).reshape(-1)
+    global _khop_rng
+    if seed is not None:
+        rng = np.random.RandomState(seed)
+    else:  # fresh draws across calls, seeded once per process
+        if _khop_rng is None:
+            _khop_rng = np.random.RandomState()
+        rng = _khop_rng
+    edge_src, edge_dst, eids = [], [], []
+    cur = nodes
+    seen = list(nodes.tolist())
+    index = {int(n): i for i, n in enumerate(seen)}
+    for k in sample_sizes:
+        nxt = []
+        for dst in cur:
+            dst = int(dst)
+            lo, hi = int(colv[dst]), int(colv[dst + 1])
+            neigh = rowv[lo:hi]
+            ids = np.arange(lo, hi)
+            if 0 < k < len(neigh):
+                pick = rng.choice(len(neigh), size=k, replace=False)
+                neigh, ids = neigh[pick], ids[pick]
+            for n, eid in zip(neigh, ids):
+                n = int(n)
+                if n not in index:
+                    index[n] = len(seen)
+                    seen.append(n)
+                    nxt.append(n)
+                edge_src.append(index[n])
+                edge_dst.append(index[dst])
+                eids.append(int(eid))
+        cur = np.asarray(nxt, dtype=rowv.dtype)
+    out = (to_tensor(np.asarray(edge_src, np.int64)),
+           to_tensor(np.asarray(edge_dst, np.int64)),
+           to_tensor(np.asarray(seen, np.int64)),
+           to_tensor(np.asarray([len(seen)], np.int64)))
+    if return_eids:
+        return out + (to_tensor(np.asarray(eids, np.int64)),)
+    return out
+
+
+from . import autotune  # noqa: E402,F401

@@ -1,0 +1,34 @@
+"""paddle.incubate.autotune (reference: python/paddle/incubate/autotune.py
+set_config: kernel/layout/dataloader tuning switches backed by the C++
+autotune cache — phi/kernels/autotune/).
+
+TPU mapping: "kernel" tuning = Pallas block-size search for the flash
+attention / rms-norm kernels (cached per shape), "layout" is XLA's domain
+(no-op kept for parity), "dataloader" tunes num_workers by timing.
+"""
+from __future__ import annotations
+
+import json
+
+from . import _autotune_config
+
+__all__ = ["set_config"]
+
+
+def set_config(config=None):
+    """Accepts a dict or a JSON file path (reference accepts both)."""
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if config:
+        for key, val in config.items():
+            cur = _autotune_config.setdefault(key, {})
+            if isinstance(val, dict):
+                cur.update(val)
+            else:
+                _autotune_config[key] = val
+    return dict(_autotune_config)
+
+
+def get_config():
+    return dict(_autotune_config)

@@ -4,3 +4,6 @@ from .optimizer import (  # noqa: F401
     Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
     Optimizer, RMSProp, SGD,
 )
+
+# alias parity with the reference's LARS optimizer naming
+Lars = LarsMomentum

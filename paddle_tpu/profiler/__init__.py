@@ -49,7 +49,9 @@ class _HostEventRecorder:
             buf = []
             self._local.buf = buf
             with self._lock:
-                self._all_buffers.append((threading.get_ident(), buf))
+                # OS thread id, same namespace as the native tracer's
+                # SYS_gettid, so both sources merge per-thread.
+                self._all_buffers.append((threading.get_native_id(), buf))
         return buf
 
     def record(self, name, start_ns, end_ns, category="host"):

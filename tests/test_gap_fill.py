@@ -1,0 +1,274 @@
+"""Distribution transforms, elastic manager, converter, misc parity names."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+class TestTransforms:
+    def test_lognormal_equivalence(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), D.ExpTransform())
+        lp_td = float(td.log_prob(np.float32(1.7)).numpy())
+        lp_ln = float(D.LogNormal(0.0, 1.0).log_prob(np.float32(1.7)).numpy())
+        np.testing.assert_allclose(lp_td, lp_ln, rtol=1e-5)
+
+    @pytest.mark.parametrize("t,x", [
+        (D.AffineTransform(1.0, 2.0), [0.3, -1.2]),
+        (D.ExpTransform(), [0.3, -1.2]),
+        (D.SigmoidTransform(), [0.3, -1.2]),
+        (D.TanhTransform(), [0.3, -0.2]),
+        (D.PowerTransform(2.0), [0.3, 1.2]),
+    ])
+    def test_roundtrip(self, t, x):
+        x = np.asarray(x, np.float32)
+        y = t.forward(x)
+        np.testing.assert_allclose(np.asarray(t.inverse(y).numpy()), x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_jacobian_numeric(self):
+        # fldj must equal log|dy/dx| measured by finite differences
+        for t in [D.ExpTransform(), D.SigmoidTransform(),
+                  D.AffineTransform(0.5, 3.0)]:
+            x = np.asarray([0.4], np.float32)
+            eps = 1e-3
+            y1 = np.asarray(t.forward(x + eps).numpy())
+            y0 = np.asarray(t.forward(x - eps).numpy())
+            num = np.log(np.abs((y1 - y0) / (2 * eps)))
+            ana = np.asarray(t.forward_log_det_jacobian(x).numpy())
+            np.testing.assert_allclose(ana, num, rtol=1e-2, atol=1e-3)
+
+    def test_chain_and_independent(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = np.asarray([[0.1, 0.2], [0.3, 0.4]], np.float32)
+        y = chain.forward(x)
+        np.testing.assert_allclose(np.asarray(chain.inverse(y).numpy()), x,
+                                   rtol=1e-5)
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        j = it.forward_log_det_jacobian(x)
+        assert tuple(j.shape) == (2,)
+
+    def test_stickbreaking_simplex(self):
+        sb = D.StickBreakingTransform()
+        v = np.asarray([0.2, -0.5, 1.0], np.float32)
+        y = sb.forward(v)
+        assert y.shape == [4]
+        assert abs(float(np.asarray(y.numpy()).sum()) - 1.0) < 1e-5
+        np.testing.assert_allclose(np.asarray(sb.inverse(y).numpy()), v,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_independent_distribution(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        iid = D.Independent(base, 1)
+        lp = iid.log_prob(np.zeros((3, 4), np.float32))
+        assert tuple(lp.shape) == (3,)
+        # sums the per-dim logprobs
+        full = np.asarray(base.log_prob(np.zeros((3, 4), np.float32)).numpy())
+        np.testing.assert_allclose(np.asarray(lp.numpy()), full.sum(-1),
+                                   rtol=1e-5)
+
+
+class TestElastic:
+    def test_membership_and_restart(self):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus)
+        from paddle_tpu.distributed.store import TCPStore
+
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        store = TCPStore("127.0.0.1", port, is_master=True)
+        restarts = []
+        m1 = ElasticManager(store, "node-a", np_range=(1, 3),
+                            heartbeat_interval=0.2, lease_ttl=1.0,
+                            on_restart=lambda members: restarts.append(members))
+        m1.register()
+        assert m1.watch() == ElasticStatus.COMPLETED
+        # scale up: second node joins
+        m2 = ElasticManager(store, "node-b", np_range=(1, 3),
+                            heartbeat_interval=0.2, lease_ttl=1.0)
+        m2.register()
+        assert m1.watch() == ElasticStatus.RESTART
+        assert restarts and restarts[-1] == ["node-a", "node-b"]
+        # scale down: node-b lease expires
+        m2.exit()
+        import time
+
+        time.sleep(1.3)
+        assert m1.watch() == ElasticStatus.RESTART
+        assert restarts[-1] == ["node-a"]
+        m1.exit()
+
+
+class TestConverter:
+    def test_merge_resplit(self):
+        from paddle_tpu.distributed.auto_parallel import Converter
+
+        full = np.arange(24, dtype=np.float32).reshape(6, 4)
+        # saved on 2 ranks, row-sharded
+        pre = {"w": {"process_shape": [2], "dims_mapping": [0, -1]}}
+        shards = [full[:3], full[3:]]
+        # target: 4 ranks, column-sharded on axis 1? 4 cols / 4 ranks
+        cur = {"w": {"process_shape": [4], "dims_mapping": [-1, 0]}}
+        out = Converter({"w": shards}, pre, cur).convert()
+        assert len(out["w"]) == 4
+        for i, shard in enumerate(out["w"]):
+            np.testing.assert_array_equal(shard, full[:, i:i + 1])
+
+    def test_2d_mesh(self):
+        from paddle_tpu.distributed.converter import (merge_shards,
+                                                      split_tensor)
+
+        full = np.arange(64, dtype=np.float32).reshape(8, 8)
+        shards = split_tensor(full, [2, 2], [0, 1])
+        assert len(shards) == 4 and shards[0].shape == (4, 4)
+        back = merge_shards(shards, [2, 2], [0, 1])
+        np.testing.assert_array_equal(back, full)
+
+
+class TestMiscParity:
+    def test_names_exist(self):
+        import paddle_tpu.incubate as incubate
+        import paddle_tpu.quantization as q
+        from paddle_tpu.hapi import callbacks
+        from paddle_tpu.optimizer import Lars
+        from paddle_tpu.vision.datasets import VOC2012, Flowers
+
+        assert callable(incubate.autotune.set_config)
+        assert callable(incubate.graph_khop_sampler)
+        assert q.QAT is q.ImperativeQuantAware
+        assert callable(q.quant_post_static)
+        assert callbacks.VisualDL is not None
+        assert Lars is not None
+        assert len(Flowers(size=4)) == 4
+        img, mask = VOC2012(size=2)[0]
+        assert mask.shape == (128, 128)
+
+    def test_flags_prefix(self):
+        flags = paddle.get_flags(["FLAGS_check_nan_inf"])
+        assert flags["FLAGS_check_nan_inf"] is False
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            assert paddle.get_flags("check_nan_inf")["check_nan_inf"] is True
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_inf_check_fires(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(Exception, match="[Nn]an|[Ii]nf"):
+                _ = x / paddle.to_tensor(np.zeros(2, np.float32))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_visualdl_writes_jsonl(self, tmp_path):
+        import json
+
+        from paddle_tpu.hapi.callbacks import VisualDL
+
+        cb = VisualDL(log_dir=str(tmp_path))
+        cb.on_train_batch_end(0, {"loss": 1.5})
+        cb.on_train_batch_end(1, {"loss": 1.2})
+        cb.on_train_end()
+        files = list(tmp_path.glob("scalars_*.jsonl"))
+        assert files
+        lines = [json.loads(l) for l in files[0].read_text().splitlines()]
+        assert lines[0]["tag"] == "train/loss"
+
+    def test_khop_sampler(self):
+        import paddle_tpu.incubate as incubate
+
+        # chain graph 0->1->2->3 in CSC: colptr over dst, row = srcs
+        row = np.array([0, 1, 2], np.int64)      # edges (0->1),(1->2),(2->3)
+        colptr = np.array([0, 0, 1, 2, 3], np.int64)
+        src, dst, nodes, cnt, eids = incubate.graph_khop_sampler(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([3], np.int64)), [1, 1],
+            return_eids=True)
+        ns = np.asarray(nodes.numpy()).tolist()
+        assert ns[0] == 3 and 2 in ns and 1 in ns
+
+
+class TestReviewRegressions:
+    def test_quant_post_static_calibrates(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import quant_post_static
+
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+
+        big = np.full((2, 8), 50.0, np.float32)
+
+        def gen():
+            for _ in range(3):
+                yield (paddle.to_tensor(big),)
+
+        q = quant_post_static(model, sample_generator=gen, batch_nums=3)
+        # calibration must have moved act scales off the 1.0 default
+        scales = [float(l.act_quant.scale.numpy())
+                  for l in q.sublayers() if hasattr(l, "act_quant")]
+        assert any(s > 10.0 for s in scales), scales
+
+    def test_transformed_event_dim(self):
+        # base: 3 iid normals (event after transform), stick-breaking maps
+        # R^3 -> 4-simplex; log_prob must be scalar per batch element
+        base = D.Independent(D.Normal(np.zeros(3, np.float32),
+                                      np.ones(3, np.float32)), 1)
+        td = D.TransformedDistribution(base, D.StickBreakingTransform())
+        assert tuple(td.event_shape) == (4,)
+        y = td.sample()
+        lp = td.log_prob(y)
+        assert tuple(lp.shape) == ()
+        # numeric check vs change-of-variables by hand
+        sb = D.StickBreakingTransform()
+        x = np.asarray(sb.inverse(y).numpy())
+        manual = (np.asarray(base.log_prob(x).numpy())
+                  - np.asarray(sb.forward_log_det_jacobian(x).numpy()))
+        np.testing.assert_allclose(float(lp.numpy()), float(manual),
+                                   rtol=1e-4)
+
+    def test_khop_sampler_varies(self):
+        import paddle_tpu.incubate as incubate
+
+        # star graph: node 0 has many neighbors; k=2 sampling should vary
+        n = 12
+        row = np.arange(1, n, dtype=np.int64)
+        colptr = np.array([0] + [n - 1] * n, np.int64)
+        draws = set()
+        for _ in range(8):
+            src, dst, nodes, cnt = incubate.graph_khop_sampler(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(np.array([0], np.int64)), [2])
+            draws.add(tuple(np.asarray(nodes.numpy()).tolist()))
+        assert len(draws) > 1  # not the same neighborhood every call
+        # seeded: reproducible
+        a = incubate.graph_khop_sampler(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0], np.int64)), [2], seed=7)
+        b = incubate.graph_khop_sampler(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0], np.int64)), [2], seed=7)
+        np.testing.assert_array_equal(np.asarray(a[2].numpy()),
+                                      np.asarray(b[2].numpy()))
+
+    def test_tracer_tids_merge(self):
+        from paddle_tpu.profiler import host_tracer
+
+        if not host_tracer.available():
+            return
+        import threading
+
+        import paddle_tpu.profiler as profiler
+
+        rec = profiler._recorder
+        host_tracer.drain()
+        rec.record("native_ev", 1, 2, category="host")
+        rec.record("python_ev", 3, 4, category="op")
+        evs = rec.drain()
+        tids = {name: tid for tid, name, *_ in evs}
+        assert tids["native_ev"] == tids["python_ev"] == \
+            threading.get_native_id()
