@@ -37,7 +37,7 @@ struct Header {
   uint64_t capacity;      // payload bytes (after header)
   pthread_mutex_t mu;     // process-shared, robust
   uint32_t n_blocks;
-  uint32_t pad;
+  uint32_t generation;    // bumped when the free list is reset after a crash
   Block blocks[kMaxBlocks];
 };
 
@@ -48,10 +48,33 @@ struct Arena {
   int fd;
 };
 
+// The memmove block-split/coalesce in alloc/free is not atomic: a worker
+// killed inside the critical section can leave an inconsistent free list
+// (overlapping or lost blocks).  After EOWNERDEAD we must validate before
+// allocating again, else two live tensors could share an offset.
+static bool list_valid(const Header* h) {
+  if (h->n_blocks == 0 || h->n_blocks > kMaxBlocks) return false;
+  uint64_t expect = 0;
+  for (uint32_t i = 0; i < h->n_blocks; ++i) {
+    const Block& b = h->blocks[i];
+    if (b.off != expect || b.size == 0) return false;
+    expect += b.size;
+  }
+  return expect == h->capacity;
+}
+
 static int lock(Header* h) {
   int rc = pthread_mutex_lock(&h->mu);
   if (rc == EOWNERDEAD) {
     pthread_mutex_consistent(&h->mu);
+    if (!list_valid(h)) {
+      // Reset to one free block.  In-flight offsets handed to workers
+      // become invalid; the Python transport detects the generation bump
+      // and refuses to materialize those refs (possibly-reused bytes).
+      h->n_blocks = 1;
+      h->blocks[0] = Block{0, h->capacity, 0, 0};
+      h->generation++;
+    }
     rc = 0;
   }
   return rc;
@@ -190,6 +213,12 @@ void shm_arena_read(void* arena, uint64_t off, void* dst, uint64_t n) {
 }
 
 uint64_t shm_arena_capacity(void* arena) { return ((Arena*)arena)->h->capacity; }
+
+// Current free-list generation; bumped when a crash forced a reset.  Refs
+// allocated under an older generation must not be trusted.
+uint32_t shm_arena_generation(void* arena) {
+  return ((Arena*)arena)->h->generation;
+}
 
 // Bytes currently allocated (diagnostics / tests).
 uint64_t shm_arena_used(void* arena) {

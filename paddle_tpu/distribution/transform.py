@@ -33,6 +33,13 @@ def _t(x):
     return Tensor(jnp.asarray(x))
 
 
+def _sum_rightmost(x, n):
+    """Sum over the rightmost n dims (no-op for n <= 0)."""
+    if n <= 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-n, 0)))
+
+
 class Transform:
     """Base transform; subclasses implement _forward/_inverse and
     _forward_log_det_jacobian (per-element)."""
@@ -177,11 +184,20 @@ class ChainTransform(Transform):
         return y
 
     def _forward_log_det_jacobian(self, x):
-        total = None
+        # Track the evolving event rank through the chain (reference:
+        # python/paddle/distribution/transform.py:535): each transform's
+        # per-element jacobian is summed over the rightmost
+        # (event_rank - t._domain_event_dim) dims before accumulating, so
+        # mixed event-dim chains (e.g. Affine then StickBreaking) reduce to
+        # a consistent shape instead of broadcast-adding wrongly.
+        total = 0.0
+        event_rank = self._domain_event_dim
         for t in self.transforms:
             j = t._forward_log_det_jacobian(x)
-            total = j if total is None else total + j
+            total = total + _sum_rightmost(
+                j, event_rank - t._domain_event_dim)
             x = t._forward(x)
+            event_rank += t._codomain_event_dim - t._domain_event_dim
         return total
 
     def forward_shape(self, shape):

@@ -337,7 +337,7 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=60, worker_init_fn=None,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
@@ -478,7 +478,11 @@ class DataLoader:
                     # Liveness-aware get: a worker that dies before putting
                     # (unpicklable payload, failed arena attach, OOM-kill)
                     # must raise here, not hang the training loop.
-                    deadline = time.monotonic() + (self.timeout or 3600)
+                    # timeout in (None, 0) = no deadline (reference
+                    # convention); the dead-worker liveness check still
+                    # runs every second either way.
+                    deadline = (time.monotonic() + self.timeout
+                                if self.timeout else None)
                     while True:
                         try:
                             task_id, data, err = data_queue.get(timeout=1)
@@ -490,7 +494,8 @@ class DataLoader:
                                     "DataLoader worker (pid "
                                     f"{dead[0].pid}) exited unexpectedly "
                                     f"with code {dead[0].exitcode}")
-                            if time.monotonic() > deadline:
+                            if (deadline is not None
+                                    and time.monotonic() > deadline):
                                 raise RuntimeError(
                                     f"DataLoader timed out after "
                                     f"{self.timeout}s waiting for a batch")

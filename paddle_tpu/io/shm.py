@@ -49,6 +49,8 @@ def _load():
         lib.shm_arena_used.argtypes = [ctypes.c_void_p]
         lib.shm_arena_capacity.restype = ctypes.c_uint64
         lib.shm_arena_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_arena_generation.restype = ctypes.c_uint32
+        lib.shm_arena_generation.argtypes = [ctypes.c_void_p]
         lib.shm_arena_detach.argtypes = [ctypes.c_void_p]
         lib.shm_arena_destroy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         _lib = lib
@@ -70,6 +72,7 @@ class ShmRef:
     offset: int
     shape: tuple
     dtype: str
+    generation: int = 0
 
 
 class ShmArena:
@@ -114,9 +117,17 @@ class ShmArena:
         if off == _UINT64_MAX:
             return None  # arena full — caller falls back to pickling
         self._lib.shm_arena_write(self._h, off, arr.ctypes.data, arr.nbytes)
-        return ShmRef(off, arr.shape, arr.dtype.str)
+        return ShmRef(off, arr.shape, arr.dtype.str,
+                      self._lib.shm_arena_generation(self._h))
 
     def get_array(self, ref: ShmRef, free: bool = True) -> np.ndarray:
+        if ref.generation != self._lib.shm_arena_generation(self._h):
+            # A worker crashed mid-critical-section and the free list was
+            # reset; this ref's bytes may already be reused by a newer
+            # allocation.  Never hand back possibly-corrupt batch data.
+            raise RuntimeError(
+                "shm arena was reset after a worker crash; in-flight batch "
+                "lost (allocated under an older arena generation)")
         out = np.empty(ref.shape, dtype=np.dtype(ref.dtype))
         self._lib.shm_arena_read(self._h, ref.offset, out.ctypes.data,
                                  out.nbytes)

@@ -46,8 +46,10 @@ class LlamaConfig:
     # Chunked fused lm-head + cross-entropy: the [B,T,V] logits are never
     # materialized in HBM (computed per token-chunk under remat).  Saves
     # ~4x vocab*tokens bytes of activation memory on the pretrain path;
-    # forward(labels=...) then returns (loss, None).
-    fused_lm_loss: bool = True
+    # forward(labels=...) then returns (loss, None).  Opt-in (off by
+    # default) because callers that consume logits — token accuracy,
+    # per-token ppl, distillation — would silently get None.
+    fused_lm_loss: bool = False
     lm_loss_chunk: int = 2048
     # Per-decoder-layer activation rematerialization (reference:
     # fleet/utils/recompute.py) — XLA recomputes the layer in backward,

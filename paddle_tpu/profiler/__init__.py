@@ -69,9 +69,15 @@ class _HostEventRecorder:
             self._buffer().append((name, start_ns, end_ns, category))
 
     def drain(self):
-        from . import host_tracer
+        # Only touch the native tracer if it was actually used for
+        # recording — host_tracer.drain() JIT-compiles the C++ library on
+        # first use, which must not be triggered by merely stopping a
+        # session that recorded nothing natively.
+        out = []
+        if self._native:
+            from . import host_tracer
 
-        out = list(host_tracer.drain())
+            out = list(host_tracer.drain())
         with self._lock:
             for tid, buf in self._all_buffers:
                 out.extend((tid,) + e for e in buf)

@@ -49,6 +49,22 @@ class TestTransforms:
         j = it.forward_log_det_jacobian(x)
         assert tuple(j.shape) == (2,)
 
+    def test_chain_mixed_event_dims(self):
+        # AffineTransform (event dim 0) then StickBreakingTransform (event
+        # dim 1): per-element affine jacobian must be summed over the
+        # stick-breaking event dim before accumulating, yielding a scalar
+        # per batch element — not a broadcast-added (…, K) array.
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.StickBreakingTransform()])
+        x = np.asarray([[0.1, 0.2, -0.3], [0.4, -0.5, 0.6]], np.float32)
+        j = chain.forward_log_det_jacobian(x)
+        assert tuple(j.shape) == (2,)
+        sb = D.StickBreakingTransform()
+        expect = (np.log(2.0) * x.shape[-1]
+                  + np.asarray(sb.forward_log_det_jacobian(2.0 * x).numpy()))
+        np.testing.assert_allclose(np.asarray(j.numpy()), expect,
+                                   rtol=1e-5, atol=1e-6)
+
     def test_stickbreaking_simplex(self):
         sb = D.StickBreakingTransform()
         v = np.asarray([0.2, -0.5, 1.0], np.float32)
