@@ -40,29 +40,58 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _expects_accelerator() -> bool:
+    import os
+
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    return bool(plats) and "cpu" not in plats.split(",")
+
+
 def _init_backend(max_tries: int = 4):
     """Return (devices, backend_name); retry init with backoff.
 
     A TPU held by a stale process (or a racing tunnel) raises
-    RuntimeError("... UNAVAILABLE ...") from the first devices() call.  The
-    failure is often transient — retry with backoff before giving up, and
-    report what held us up via stderr so the driver log shows it.
+    RuntimeError("... UNAVAILABLE ...") from the first devices() call.
+    JAX caches backend-init state after the first in-process attempt (a
+    failed TPU init leaves a CPU-only backend dict that later calls return
+    silently), so retries probe in a FRESH SUBPROCESS; jax is only
+    imported here once a probe confirms the accelerator answers.  Without
+    the probe, a retry would "succeed" on CPU and the bench would report a
+    smoke-path number as the real perf result.
     """
-    import jax
+    import os
+    import subprocess
 
     last_err = None
     for attempt in range(max_tries):
-        try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ))
+        probed = probe.stdout.strip().splitlines()[-1] if \
+            probe.stdout.strip() else ""
+        if probe.returncode == 0 and (
+                probed != "cpu" or not _expects_accelerator()):
+            import jax
+
             devices = jax.devices()
-            return devices, jax.default_backend()
-        except RuntimeError as e:  # backend init failure (UNAVAILABLE etc.)
-            last_err = e
-            wait = 5.0 * (attempt + 1)
-            print(f"# backend init attempt {attempt + 1}/{max_tries} failed: "
-                  f"{e}; retrying in {wait:.0f}s", file=sys.stderr)
-            time.sleep(wait)
+            backend = jax.default_backend()
+            if backend == "cpu" and _expects_accelerator():
+                # probe saw the accelerator but our init lost the race
+                raise RuntimeError(
+                    "accelerator probe succeeded but in-process init fell "
+                    "back to cpu — TPU likely grabbed by another process")
+            return devices, backend
+        last_err = (probe.stderr or probe.stdout or "").strip()[-500:]
+        wait = 5.0 * (attempt + 1)
+        print(f"# backend probe {attempt + 1}/{max_tries} failed "
+              f"(backend={probed or 'none'}): {last_err!r}; retrying in "
+              f"{wait:.0f}s", file=sys.stderr)
+        time.sleep(wait)
     raise RuntimeError(
-        f"backend init failed after {max_tries} attempts: {last_err}")
+        f"backend init failed after {max_tries} probes: {last_err}")
 
 
 def _emit(result: dict):

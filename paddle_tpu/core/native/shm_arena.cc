@@ -142,13 +142,16 @@ void* shm_arena_attach(const char* name) {
   return a;
 }
 
-// Returns payload offset or UINT64_MAX when full / fragmented.
-uint64_t shm_arena_alloc(void* arena, uint64_t size) {
+// Returns payload offset or UINT64_MAX when full / fragmented.  gen_out
+// (optional) receives the free-list generation observed UNDER the mutex —
+// the only sample that is race-free against a concurrent crash reset.
+uint64_t shm_arena_alloc2(void* arena, uint64_t size, uint32_t* gen_out) {
   Arena* a = (Arena*)arena;
   Header* h = a->h;
   size = (size + 63) & ~63ULL;  // 64B alignment
   if (size == 0) size = 64;
   if (lock(h) != 0) return UINT64_MAX;
+  if (gen_out) *gen_out = h->generation;
   uint64_t got = UINT64_MAX;
   for (uint32_t i = 0; i < h->n_blocks; ++i) {
     Block& b = h->blocks[i];
@@ -166,6 +169,10 @@ uint64_t shm_arena_alloc(void* arena, uint64_t size) {
   }
   pthread_mutex_unlock(&h->mu);
   return got;
+}
+
+uint64_t shm_arena_alloc(void* arena, uint64_t size) {
+  return shm_arena_alloc2(arena, size, nullptr);
 }
 
 int shm_arena_free(void* arena, uint64_t off) {

@@ -82,6 +82,143 @@ def gpipe_spmd(stage_fn: Callable, stacked_params, x_microbatches,
     return fn(stacked_params, x_microbatches)
 
 
+def pipeline_1f1b(stage_fn: Callable, stacked_params, shared_params,
+                  inputs_mb, targets_mb, act_example,
+                  mesh: Optional[Mesh] = None, axis_name: str = "pp",
+                  data_axis: Optional[str] = None):
+    """Synchronous 1F1B pipeline schedule, compiled into ONE XLA program.
+
+    Reference semantics: fleet/meta_parallel/pipeline_parallel.py:81
+    (forward_backward_pipeline warmup/steady/cooldown) with P2P via
+    pp_utils/p2p_communication.py:217 _p2p_helper.  TPU-native design: the
+    schedule is a lax.scan over ticks inside shard_map over the pp mesh
+    axis; P2P hops are lax.ppermute over ICI (forward activations one hop
+    down, backward grads one hop up, both per tick).  Unlike gpipe_spmd
+    (autodiff through the scan → all microbatch activations live through
+    the F phase), each stage here runs its OWN vjp per tick and stores only
+    the stage *inputs* still in flight — at most min(M, 2*S-1) microbatches
+    — recomputing the stage forward in the backward tick (activation
+    recompute, reference fleet/utils/recompute.py).  Heterogeneous stages
+    are first-class: stage_fn receives the stage index and applies
+    embedding at stage 0 / head+loss at stage S-1 (reference
+    SharedLayerDesc placement); shared-param grads (tied embeddings) are
+    summed across stages by the closing psum — the reference's
+    shared-embedding allreduce (pipeline_parallel.py _broadcast).
+
+    Args:
+      stage_fn(stage, shared, local, x, mb_inputs, mb_targets) -> (y, loss)
+        stage: traced int32 stage id.  local: this stage's slice of
+        stacked_params (leading S axis consumed).  x: activation with
+        act_example's shape — ignored by stage 0, which embeds mb_inputs.
+        y must have act_example's shape; loss must be this microbatch's
+        scalar loss at stage S-1 and 0.0 elsewhere.
+      stacked_params: pytree, every leaf with leading axis S.
+      shared_params: pytree replicated to every stage (embedding, final
+        norm, lm head, ...).
+      inputs_mb / targets_mb: [M, micro, ...] microbatched tokens/labels.
+      act_example: zeros with the canonical activation shape [micro, ...].
+      data_axis: optional mesh axis the microbatch dim is sharded over
+        (DP); grads/loss are psum-averaged over it.
+
+    Returns (mean_loss, grads_stacked, grads_shared) — grads laid out like
+    the corresponding params.
+    """
+    mesh = mesh or get_mesh()
+    n_stages = mesh.shape[axis_name]
+    M = inputs_mb.shape[0]
+    S = n_stages
+    ticks = M + 2 * (S - 1)
+    depth = min(M, 2 * S - 1)
+    dp_size = mesh.shape.get(data_axis, 1) if data_axis else 1
+
+    def local_fn(stacked_local, shared, inputs, targets):
+        stage = jax.lax.axis_index(axis_name)
+        local = jax.tree_util.tree_map(lambda p: p[0], stacked_local)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+        zero_act = jnp.zeros_like(act_example)
+        act_buf0 = jnp.zeros((depth,) + act_example.shape,
+                             act_example.dtype)
+        g_local0 = jax.tree_util.tree_map(jnp.zeros_like, local)
+        g_shared0 = jax.tree_util.tree_map(jnp.zeros_like, shared)
+
+        def tick(carry, t):
+            fwd_msg, bwd_msg, act_buf, g_local, g_shared, loss_sum = carry
+            x_recv = jax.lax.ppermute(fwd_msg, axis_name, fwd_perm)
+            g_recv = jax.lax.ppermute(bwd_msg, axis_name, bwd_perm)
+
+            f_mb = t - stage
+            b_mb = t - (2 * (S - 1) - stage)
+            f_valid = (f_mb >= 0) & (f_mb < M)
+            b_valid = (b_mb >= 0) & (b_mb < M)
+            f_idx = jnp.clip(f_mb, 0, M - 1)
+            b_idx = jnp.clip(b_mb, 0, M - 1)
+
+            # ---- forward: one microbatch down the pipe ----
+            slot_f = f_idx % depth
+            act_buf = act_buf.at[slot_f].set(
+                jnp.where(f_valid, x_recv, act_buf[slot_f]))
+            y, loss_f = stage_fn(stage, shared, local, x_recv,
+                                 inputs[f_idx], targets[f_idx])
+            fwd_next = jnp.where(f_valid, y, zero_act)
+            loss_sum = loss_sum + jnp.where(
+                f_valid, loss_f.astype(jnp.float32), 0.0)
+
+            # ---- backward: vjp at the stored stage input ----
+            # vjp is linear in the cotangent, so zero cotangents on
+            # invalid/non-participating ticks yield zero grads; the
+            # explicit masks below only guard against NaN from garbage
+            # buffer slots.
+            x_b = act_buf[b_idx % depth]
+            last = stage == S - 1
+
+            def fb(sh, lo, xx):
+                return stage_fn(stage, sh, lo, xx, inputs[b_idx],
+                                targets[b_idx])
+
+            (y_b, loss_b), vjp_fn = jax.vjp(fb, shared, local, x_b)
+            g_y = jnp.where(last, jnp.zeros_like(y_b),
+                            g_recv.astype(y_b.dtype))
+            g_loss = jnp.where(last & b_valid, 1.0 / M, 0.0).astype(
+                loss_b.dtype)
+            d_shared, d_local, d_x = vjp_fn((g_y, g_loss))
+            mask = b_valid
+            g_local = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_local, d_local)
+            g_shared = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_shared, d_shared)
+            bwd_next = jnp.where(mask, d_x, zero_act)
+
+            return (fwd_next, bwd_next, act_buf, g_local, g_shared,
+                    loss_sum), None
+
+        carry0 = (zero_act, zero_act, act_buf0, g_local0, g_shared0,
+                  jnp.float32(0.0))
+        (fw, bw, buf, g_local, g_shared, loss_sum), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(ticks))
+
+        loss = jax.lax.psum(loss_sum, axis_name) / M
+        g_shared = jax.lax.psum(g_shared, axis_name)
+        if data_axis is not None and dp_size > 1:
+            loss = jax.lax.psum(loss, data_axis) / dp_size
+            g_shared = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, data_axis) / dp_size, g_shared)
+            g_local = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, data_axis) / dp_size, g_local)
+        g_stacked = jax.tree_util.tree_map(lambda g: g[None], g_local)
+        return loss, g_stacked, g_shared
+
+    pp_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    rep = jax.tree_util.tree_map(lambda _: P(), shared_params)
+    mb_spec = (P(None, data_axis) if data_axis is not None else P())
+    fn = _shard_map(local_fn, mesh,
+                    (pp_specs, rep, mb_spec, mb_spec),
+                    (P(), pp_specs, rep))
+    return fn(stacked_params, shared_params, inputs_mb, targets_mb)
+
+
 class LayerDesc:
     """Deferred layer construction (reference: pp_layers.py LayerDesc)."""
 

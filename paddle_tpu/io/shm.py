@@ -37,6 +37,10 @@ def _load():
         lib.shm_arena_attach.argtypes = [ctypes.c_char_p]
         lib.shm_arena_alloc.restype = ctypes.c_uint64
         lib.shm_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shm_arena_alloc2.restype = ctypes.c_uint64
+        lib.shm_arena_alloc2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32)]
         lib.shm_arena_free.restype = ctypes.c_int
         lib.shm_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shm_arena_ptr.restype = ctypes.c_void_p
@@ -113,24 +117,31 @@ class ShmArena:
 
     def put_array(self, arr: np.ndarray) -> Optional[ShmRef]:
         arr = np.ascontiguousarray(arr)
-        off = self._lib.shm_arena_alloc(self._h, arr.nbytes)
+        gen = ctypes.c_uint32(0)
+        # generation is sampled under the alloc mutex: race-free against a
+        # concurrent crash-reset bumping it between alloc and stamping.
+        off = self._lib.shm_arena_alloc2(self._h, arr.nbytes,
+                                         ctypes.byref(gen))
         if off == _UINT64_MAX:
             return None  # arena full — caller falls back to pickling
         self._lib.shm_arena_write(self._h, off, arr.ctypes.data, arr.nbytes)
-        return ShmRef(off, arr.shape, arr.dtype.str,
-                      self._lib.shm_arena_generation(self._h))
+        return ShmRef(off, arr.shape, arr.dtype.str, gen.value)
 
     def get_array(self, ref: ShmRef, free: bool = True) -> np.ndarray:
-        if ref.generation != self._lib.shm_arena_generation(self._h):
-            # A worker crashed mid-critical-section and the free list was
-            # reset; this ref's bytes may already be reused by a newer
-            # allocation.  Never hand back possibly-corrupt batch data.
-            raise RuntimeError(
-                "shm arena was reset after a worker crash; in-flight batch "
-                "lost (allocated under an older arena generation)")
+        def _check():
+            if ref.generation != self._lib.shm_arena_generation(self._h):
+                # A worker crashed mid-critical-section and the free list
+                # was reset; this ref's bytes may already be reused by a
+                # newer allocation.  Never hand back possibly-corrupt data.
+                raise RuntimeError(
+                    "shm arena was reset after a worker crash; in-flight "
+                    "batch lost (allocated under an older generation)")
+
+        _check()
         out = np.empty(ref.shape, dtype=np.dtype(ref.dtype))
         self._lib.shm_arena_read(self._h, ref.offset, out.ctypes.data,
                                  out.nbytes)
+        _check()  # a reset DURING the copy would have bumped it
         if free:
             self._lib.shm_arena_free(self._h, ref.offset)
         return out
