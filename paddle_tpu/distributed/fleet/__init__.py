@@ -76,8 +76,6 @@ def distributed_model(model):
       - pp_degree>1 → the model must be a PipelineLayer (stage stacking)
     """
     from ..mesh import get_mesh
-    from ..sharding import mark_sharding
-    from jax.sharding import PartitionSpec
 
     hcg = fleet.hcg or get_hybrid_communicate_group()
     mesh = get_mesh()
@@ -85,33 +83,115 @@ def distributed_model(model):
         return model
 
     if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
-        _apply_zero3_sharding(model, mesh)
+        stage = 3
+        if fleet.strategy is not None:
+            stage = int((fleet.strategy.sharding_configs or {}).get(
+                "stage", 3))
+        apply_group_sharding(model, mesh, stage=stage)
     return model
 
 
-def _apply_zero3_sharding(model, mesh):
-    """ZeRO-3/FSDP: shard every unannotated parameter's largest divisible
-    axis over the 'sharding' mesh axis (reference GroupShardedStage3
-    partitions params by rank, group_sharded_stage3.py:58 — GSPMD makes the
-    gather/release compiler-scheduled)."""
+def _zero_spec(p, mesh):
+    """Largest divisible axis of p over the 'sharding' mesh axis."""
+    from jax.sharding import PartitionSpec
+
+    deg = mesh.shape.get("sharding", 1)
+    for axis, size in enumerate(p.shape):
+        if size % deg == 0 and size >= deg:
+            spec = [None] * len(p.shape)
+            spec[axis] = "sharding"
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def apply_group_sharding(model, mesh, stage=3):
+    """ZeRO stages over the 'sharding' mesh axis (reference:
+    sharding_optimizer.py stage 1, group_sharded_stage2.py,
+    group_sharded_stage3.py:58).
+
+    stage 1: optimizer state sharded (params+grads replicated) — slots are
+      device_put onto the spec by distributed_optimizer's accumulator hook.
+    stage 2: + gradients sharded (the reference's reduce-scatter becomes a
+      sharding constraint applied to each grad at step time; XLA lowers the
+      dp/sharding reduction to reduce-scatter instead of all-reduce).
+    stage 3: + parameters sharded (the reference's on-demand allgather +
+      release hooks become compiler-scheduled GSPMD gathers).
+    """
     from jax.sharding import PartitionSpec
 
     from ..sharding import get_sharding_spec, mark_sharding
 
-    deg = mesh.shape.get("sharding", 1)
     for _, p in model.named_parameters():
         if get_sharding_spec(p) is not None:
-            continue
-        placed = False
-        for axis, size in enumerate(p.shape):
-            if size % deg == 0 and size >= deg:
-                spec = [None] * len(p.shape)
-                spec[axis] = "sharding"
-                mark_sharding(p, PartitionSpec(*spec))
-                placed = True
-                break
-        if not placed:
+            continue  # e.g. mp-annotated parallel layers keep their spec
+        spec = _zero_spec(p, mesh)
+        p._zero_opt_spec = spec  # stage >= 1: shard the slots
+        if stage >= 2:
+            p._zero_grad_spec = spec
+        if stage >= 3:
+            mark_sharding(p, spec)
+        else:
+            # params stay REPLICATED but must live on the mesh, else the
+            # compiled step is a single-device program and the slot/grad
+            # shardings above never materialize.
             mark_sharding(p, PartitionSpec())
+
+
+# round-1 name, kept for compatibility
+def _apply_zero3_sharding(model, mesh):
+    apply_group_sharding(model, mesh, stage=3)
+
+
+def _pin_slot_shardings(optimizer):
+    """ZeRO stage >= 1: re-constrain param-shaped optimizer slots onto
+    their sharding spec after the update, and params onto THEIR declared
+    spec.  GSPMD would otherwise pick layouts freely — dissolving the slot
+    partition (m_new = f(m_sharded, g_replicated) → replicated) or,
+    conversely, leaking the slot sharding onto stage-1/2 params that must
+    stay replicated."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..mesh import get_mesh
+    from ..sharding import get_sharding_spec
+
+    mesh = get_mesh()
+    if mesh is None:
+        return
+    params = {id(p): p for p, _, _ in optimizer._collect_params_grads()}
+    for p in params.values():
+        pspec = get_sharding_spec(p)
+        if pspec is None or not isinstance(p._value, jax.core.Tracer):
+            continue
+        try:
+            p._value = jax.lax.with_sharding_constraint(
+                p._value, NamedSharding(mesh, pspec))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"could not pin param sharding {pspec}: {e}")
+    for store in optimizer._accumulators.values():
+        for pid, arr in list(store.items()):
+            p = params.get(pid)
+            spec = getattr(p, "_zero_opt_spec", None) if p is not None \
+                else None
+            if (spec is None or not hasattr(arr, "shape")
+                    or tuple(arr.shape) != tuple(p.shape)):
+                continue
+            sh = NamedSharding(mesh, spec)
+            try:
+                # NB: hasattr(tracer, "addressable_shards") raises
+                # ConcretizationTypeError (not AttributeError) — test the
+                # type, don't probe the attribute.
+                if isinstance(arr, jax.core.Tracer):
+                    store[pid] = jax.lax.with_sharding_constraint(arr, sh)
+                else:
+                    store[pid] = jax.device_put(arr, sh)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"could not pin optimizer-slot sharding {spec}: {e}")
 
 
 def distributed_optimizer(optimizer, strategy=None):
@@ -162,26 +242,54 @@ def distributed_optimizer(optimizer, strategy=None):
 
     def _add_accumulator(name, param, **kwargs):
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding
 
         from ..mesh import get_mesh
         from ..sharding import get_sharding_spec
 
         arr = orig_add(name, param, **kwargs)
         mesh = get_mesh()
-        spec = get_sharding_spec(param)
+        # ZeRO stage 1/2: slots shard over the 'sharding' axis even when
+        # the param itself stays replicated (reference
+        # sharding_optimizer.py opt-state partition) — so the opt-state
+        # spec takes priority over the param's own (replicated) spec.
+        spec = getattr(param, "_zero_opt_spec", None)
+        if spec is None:
+            spec = get_sharding_spec(param)
         if mesh is None:
             return arr
         try:
-            is_concrete = hasattr(arr, "addressable_shards")
-            if spec is not None and is_concrete:
-                arr = jax.device_put(arr, NamedSharding(mesh, spec))
+            if spec is not None and arr.shape == tuple(param.shape):
+                sh = NamedSharding(mesh, spec)
+                if isinstance(arr, jax.core.Tracer):
+                    arr = jax.lax.with_sharding_constraint(arr, sh)
+                else:
+                    arr = jax.device_put(arr, sh)
                 optimizer._accumulators[name][id(param)] = arr
         except Exception:
             pass
         return arr
 
     optimizer._add_accumulator = _add_accumulator
+
+    orig_step = optimizer.step
+
+    def _step():
+        # ZeRO stage 2: constrain grads onto the sharding axis before the
+        # update (the reference's reduce-scatter grad placement,
+        # group_sharded_stage2.py) — under jit GSPMD turns the grad
+        # reduction into reduce-scatter + sharded update.
+        from ..sharding import shard_tensor
+
+        for p, _, _ in optimizer._collect_params_grads():
+            spec = getattr(p, "_zero_grad_spec", None)
+            if spec is not None and p.grad is not None:
+                p.grad = shard_tensor(p.grad, placements=spec)
+        out = orig_step()
+        _pin_slot_shardings(optimizer)
+        return out
+
+    optimizer.step = _step
     if strategy is not None and getattr(strategy, "localsgd", False):
         from .meta_optimizers import LocalSGDOptimizer
 

@@ -23,10 +23,14 @@ class DistributedStrategy:
         # recompute (proto RecomputeConfig:26)
         self.recompute = False
         self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
-        # ZeRO sharding (proto ShardingConfig:32)
+        # ZeRO sharding (proto ShardingConfig:32).  Default stage is 3
+        # (full FSDP-style param sharding): deviates from the reference
+        # static sharding_optimizer's stage-1 default on purpose — GSPMD
+        # makes stage 3 the natural TPU formulation, and sharding_degree>1
+        # with no explicit stage has meant ZeRO-3 here since round 1.
         self.sharding = False
         self.sharding_configs: Dict[str, Any] = {
-            "sharding_degree": 1, "stage": 1, "offload": False,
+            "sharding_degree": 1, "stage": 3, "offload": False,
             "segment_broadcast_MB": 32,
         }
         # gradient merge (proto:84)
