@@ -31,14 +31,16 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None, name=None) -> List[Optional[Tensor]]:
-    """Functional gradients of outputs w.r.t. inputs, without touching .grad."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet; "
-            "use paddle_tpu.jit.grad for higher-order derivatives of compiled fns")
+    """Functional gradients of outputs w.r.t. inputs, without touching .grad.
+
+    create_graph=True records the backward computation itself on the tape
+    (reference: eager GeneralGrad + double-grad ops,
+    paddle/fluid/eager/backward.cc:37), so the returned gradients can be
+    differentiated again — gradient penalties, grad-of-grad checks.
+    """
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
-    retain = retain_graph if retain_graph is not None else False
+    retain = retain_graph if retain_graph is not None else create_graph
 
     capture = {}
     capture_points = {}
@@ -49,7 +51,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 (id(t._grad_node), t._output_index), []).append(id(t))
 
     tape_mod.run_backward(outputs, grad_outputs, retain_graph=retain,
-                          capture=capture, capture_points=capture_points)
+                          capture=capture, capture_points=capture_points,
+                          create_graph=create_graph)
 
     results = []
     for t in inputs:
@@ -60,6 +63,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "One of the differentiated tensors appears to not have "
                     "been used in the graph (set allow_unused=True to allow)")
             results.append(None)
+        elif isinstance(c, Tensor):  # create_graph: keep the grad's graph
+            results.append(c)
         else:
             results.append(Tensor(c, stop_gradient=True))
     return results
@@ -135,7 +140,20 @@ class PyLayer:
                     t.shape, t._value.dtype))
             return tuple(vals)
 
+        def record_vjp(cot_tensors):
+            # create_graph path: run the user backward WITH recording so
+            # the produced grads carry their own graph.
+            with enable_grad_ctx():
+                grads = cls.backward(ctx, *cot_tensors)
+            if isinstance(grads, Tensor) or grads is None:
+                grads = (grads,)
+            by_input = {id(t): g for t, g in zip(tensor_inputs, grads)}
+            return [by_input.get(id(t)) if isinstance(
+                by_input.get(id(t)), Tensor) else None
+                for t in diff_inputs]
+
         node = tape_mod.GradNode(f"pylayer_{cls.__name__}", vjp_fn)
+        node.record_vjp = record_vjp
         node.finalize(
             out_avals=[(tuple(o.shape), o._value.dtype) for o in out_list],
             single_output=single,

@@ -198,6 +198,7 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
         diff_vals = [flat[i]._value for i in diff_idx]
         out_raw, vjp_fn = jax.vjp(raw_fn, *diff_vals)
         node = tape_mod.GradNode(name, vjp_fn)
+        node.grad_raw_fn = raw_fn  # double-grad: recordable vjp recompute
     else:
         out_raw = raw_fn()
         node = None

@@ -35,6 +35,10 @@ class GradNode:
         "pending",
         "edges",
         "out_hooks",
+        "input_tensors",
+        "input_versions",
+        "grad_raw_fn",
+        "record_vjp",
         "__weakref__",
     )
 
@@ -48,11 +52,26 @@ class GradNode:
         #   ("node", producer_node, out_index) or ("leaf", tensor)
         self.edges: List[tuple] = []
         self.out_hooks: Dict[int, list] = {}
+        # double-grad support (reference GeneralGrad + double-grad ops,
+        # /root/reference/paddle/fluid/eager/backward.cc:37): the recorded
+        # op's pure function + its differentiable input Tensors, so a
+        # create_graph sweep can re-run the vjp THROUGH dispatch and give
+        # the cotangents their own grad nodes.  Memory note: raw_fn's
+        # closure (and these Tensor refs) pin the op's inputs for the
+        # node's lifetime — for most ops the jax vjp residuals already do;
+        # the increment is limited to residual-free ops (add & co) and is
+        # bounded by the graph's lifetime (released after backward).
+        self.input_tensors: Optional[List[Any]] = None
+        self.input_versions: Optional[List[int]] = None
+        self.grad_raw_fn = None
+        self.record_vjp = None  # custom recordable vjp (PyLayer)
 
     def finalize(self, out_avals, single_output, inputs):
         self.out_avals = out_avals
         self.single_output = single_output
         self.pending = [None] * len(out_avals)
+        self.input_tensors = list(inputs)
+        self.input_versions = [t._version for t in inputs]
         for t in inputs:
             if t._grad_node is not None:
                 self.edges.append(("node", t._grad_node, t._output_index))
@@ -65,27 +84,49 @@ class GradNode:
         else:
             self.pending[idx] = self.pending[idx] + cotangent
 
-    def assembled_cotangents(self):
+    def assembled_cotangents(self, as_tensor=False):
         cots = []
         for i, (shape, dtype) in enumerate(self.out_avals):
             c = self.pending[i]
             if c is None:
                 c = _zero_cotangent(shape, dtype)
+                if as_tensor:  # float0 zeros wrap too: PyLayer backward's
+                    c = _wrap(c)  # contract is Tensors for every cotangent
             for hook in self.out_hooks.get(i, ()):
                 out = hook(_wrap(c))
                 if out is not None:
-                    c = _unwrap(out)
+                    c = out if as_tensor else _unwrap(out)
             cots.append(c)
         return cots
+
+    def check_versions(self):
+        """Raise if any input was mutated in place after recording
+        (reference: eager VariableWrapper inplace_version check)."""
+        if not self.input_tensors:
+            return
+        for t, v0 in zip(self.input_tensors, self.input_versions):
+            if t._version != v0:
+                raise RuntimeError(
+                    f"a tensor consumed by op '{self.name}' was modified "
+                    f"by an inplace operation after being recorded "
+                    f"(version {t._version} vs {v0}); gradients would be "
+                    "wrong — clone() before mutating, or mutate after "
+                    "backward")
 
     def release(self):
         self.vjp_fn = None
         self.pending = [None] * len(self.out_avals)
+        self.input_tensors = None
+        self.input_versions = None
+        self.grad_raw_fn = None
+        self.record_vjp = None
 
 
 def _wrap(raw):
     from .tensor import Tensor
 
+    if isinstance(raw, Tensor):
+        return raw
     return Tensor(raw, stop_gradient=True)
 
 
@@ -95,6 +136,12 @@ def _unwrap(t):
     return t._value if isinstance(t, Tensor) else t
 
 
+def _cot_dtype(c):
+    from .tensor import Tensor
+
+    return c._value.dtype if isinstance(c, Tensor) else c.dtype
+
+
 def _accumulate_leaf_grad(tensor, cotangent):
     from .tensor import Tensor
 
@@ -102,11 +149,45 @@ def _accumulate_leaf_grad(tensor, cotangent):
     for hook in tensor._hooks:
         out = hook(_wrap(c))
         if out is not None:
-            c = _unwrap(out)
-    if tensor.grad is None:
+            c = out if isinstance(cotangent, Tensor) else _unwrap(out)
+    if isinstance(c, Tensor):  # create_graph sweep: grads keep their graph
+        tensor.grad = c if tensor.grad is None else tensor.grad + c
+    elif tensor.grad is None:
         tensor.grad = Tensor(c, stop_gradient=True)
     else:
         tensor.grad = Tensor(tensor.grad._value + c, stop_gradient=True)
+
+
+def _record_vjp_via_apply(node, cot_tensors):
+    """Compute node's vjp THROUGH dispatch so the resulting cotangents are
+    themselves recorded (the double-grad op of the reference's codegen'd
+    GradNode pairs).  Re-runs the op's forward for the residuals — the
+    standard recompute formulation of grad-of-grad."""
+    from . import dispatch
+
+    raw_fn = node.grad_raw_fn
+    n_in = len(node.input_tensors)
+    out_avals = node.out_avals
+    single = node.single_output
+    inexact = [i for i, (_, d) in enumerate(out_avals)
+               if jnp.issubdtype(d, jnp.inexact)]
+    passed = [cot_tensors[i] for i in inexact]
+
+    def op(*vals):
+        primals, cvals = vals[:n_in], list(vals[n_in:])
+        cots = []
+        for i, (shape, dtype) in enumerate(out_avals):
+            if jnp.issubdtype(dtype, jnp.inexact):
+                cots.append(cvals.pop(0))
+            else:
+                cots.append(np.zeros(shape, jax.dtypes.float0))
+        _, vjp = jax.vjp(raw_fn, *primals)
+        return tuple(vjp(cots[0] if single else tuple(cots)))
+
+    with dispatch.enable_grad_ctx():
+        res = dispatch.apply(f"{node.name}_grad", op,
+                             *node.input_tensors, *passed)
+    return list(res) if isinstance(res, tuple) else [res]
 
 
 def _discover(roots):
@@ -131,15 +212,23 @@ def _discover(roots):
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False,
                  capture: Optional[Dict[int, Any]] = None,
-                 capture_points: Optional[Dict[Tuple[int, int], list]] = None):
+                 capture_points: Optional[Dict[Tuple[int, int], list]] = None,
+                 create_graph: bool = False):
     """Reverse-mode sweep from `tensors`.
 
     capture/capture_points support the functional paddle.grad API: when a
     target tensor is an intermediate, its fully-assembled cotangent is
     recorded at (producer node, output index) processing time.
+
+    create_graph: cotangents flow as Tensors and each node's vjp runs
+    THROUGH dispatch (recorded), so the produced gradients are themselves
+    differentiable (reference: eager double-grad ops + GeneralGrad,
+    backward.cc:37).  Implies retain_graph.
     """
     from .tensor import Tensor
 
+    if create_graph:
+        retain_graph = True
     if isinstance(tensors, Tensor):
         tensors = [tensors]
     if grad_tensors is None:
@@ -151,6 +240,10 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     for t, g in zip(tensors, grad_tensors):
         if g is None:
             g_val = jnp.ones(t.shape, t._value.dtype)
+            if create_graph:
+                g_val = _wrap(g_val)
+        elif create_graph:
+            g_val = g if isinstance(g, Tensor) else _wrap(jnp.asarray(g))
         else:
             g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._grad_node
@@ -173,42 +266,68 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
     queue = deque(n for n in nodes.values() if in_deg.get(id(n), 0) == 0)
     processed = set()
 
-    while queue:
-        node = queue.popleft()
-        if id(node) in processed:
-            continue
-        processed.add(id(node))
+    # create_graph: the whole sweep (cotangent adds included) must record,
+    # even when the caller sits inside no_grad.
+    import contextlib
 
-        cots = node.assembled_cotangents()
-        if capture_points:
-            for (nid, idx), sinks in capture_points.items():
-                if nid == id(node):
-                    for sink in sinks:
-                        capture[sink] = cots[idx]
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                f"grad node {node.name} already released; use retain_graph=True"
-            )
-        in_cots = node.vjp_fn(cots[0] if node.single_output else tuple(cots))
+    from . import dispatch
 
-        for (kind, *rest), cot in zip(node.edges, in_cots):
-            if cot is None or (hasattr(cot, "dtype") and cot.dtype == jax.dtypes.float0):
+    grad_ctx = (dispatch.enable_grad_ctx() if create_graph
+                else contextlib.nullcontext())
+    with grad_ctx:
+        while queue:
+            node = queue.popleft()
+            if id(node) in processed:
                 continue
-            if kind == "leaf":
-                tensor = rest[0]
-                if capture is not None and id(tensor) in capture:
-                    prev = capture[id(tensor)]
-                    capture[id(tensor)] = cot if prev is None else prev + cot
-                else:
-                    _accumulate_leaf_grad(tensor, cot)
-            else:
-                prod, idx = rest
-                prod.accumulate(idx, cot)
-                in_deg[id(prod)] -= 1
-                if in_deg[id(prod)] == 0:
-                    queue.append(prod)
+            processed.add(id(node))
 
-        if not retain_graph:
-            node.release()
-        else:
-            node.pending = [None] * len(node.out_avals)
+            cots = node.assembled_cotangents(as_tensor=create_graph)
+            if capture_points:
+                for (nid, idx), sinks in capture_points.items():
+                    if nid == id(node):
+                        for sink in sinks:
+                            capture[sink] = cots[idx]
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"grad node {node.name} already released; use "
+                    "retain_graph=True")
+            node.check_versions()
+            if create_graph:
+                if node.record_vjp is not None:
+                    in_cots = node.record_vjp(cots)
+                elif node.grad_raw_fn is not None and \
+                        node.input_tensors is not None:
+                    in_cots = _record_vjp_via_apply(node, cots)
+                else:
+                    raise RuntimeError(
+                        f"op '{node.name}' does not support create_graph "
+                        "(no recordable vjp)")
+            else:
+                in_cots = node.vjp_fn(
+                    cots[0] if node.single_output else tuple(cots))
+
+            for (kind, *rest), cot in zip(node.edges, in_cots):
+                if cot is None or _cot_dtype(cot) == jax.dtypes.float0:
+                    continue
+                if kind == "leaf":
+                    tensor = rest[0]
+                    if capture is not None:
+                        if id(tensor) in capture:
+                            prev = capture[id(tensor)]
+                            capture[id(tensor)] = (cot if prev is None
+                                                   else prev + cot)
+                        # else: functional grad (only_inputs) — never
+                        # touch .grad of tensors outside `inputs`
+                    else:
+                        _accumulate_leaf_grad(tensor, cot)
+                else:
+                    prod, idx = rest
+                    prod.accumulate(idx, cot)
+                    in_deg[id(prod)] -= 1
+                    if in_deg[id(prod)] == 0:
+                        queue.append(prod)
+
+            if not retain_graph:
+                node.release()
+            else:
+                node.pending = [None] * len(node.out_avals)

@@ -32,6 +32,7 @@ class Tensor:
         "name",
         "persistable",
         "trainable",
+        "_version",
         "__weakref__",
         "__dict__",
     )
@@ -48,6 +49,15 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.trainable = True
+        # In-place version counter (reference: eager VariableWrapper
+        # inplace_version checking): bumped by every in-place mutation;
+        # the tape compares it against the version recorded at op time and
+        # raises instead of producing silently wrong gradients.
+        self._version = 0
+
+    @property
+    def inplace_version(self):
+        return self._version
 
     # ---------------------------------------------------------------- shape
     @property
@@ -202,12 +212,31 @@ class Tensor:
     # ----------------------------------------------------------- in-place
     def _rebind(self, new_tensor: "Tensor"):
         """In-place semantics over immutable XLA buffers: take over the new
-        value and its position in the autograd graph."""
+        value and its position in the autograd graph.
+
+        This is the RECORDED in-place path (setitem, add_, ...): the op's
+        grad node legitimately consumed the pre-mutation tensor, so swap a
+        snapshot (old value, old graph position, old version) into the
+        node's input records — backward and double-grad then see the value
+        the op actually read, while the version bump still flags any OTHER
+        node that consumed this tensor before the mutation."""
+        node = new_tensor._grad_node
+        if node is not None and node.input_tensors:
+            for i, t in enumerate(node.input_tensors):
+                if t is self:
+                    snap = Tensor(self._value,
+                                  stop_gradient=self.stop_gradient)
+                    snap._grad_node = self._grad_node
+                    snap._output_index = self._output_index
+                    snap._version = self._version
+                    node.input_tensors[i] = snap
+                    node.input_versions[i] = self._version
         self._value = new_tensor._value
         self._grad_node = new_tensor._grad_node
         self._output_index = new_tensor._output_index
         if not new_tensor.stop_gradient:
             self.stop_gradient = False
+        self._version += 1
         return self
 
     def set_value(self, value):
@@ -216,19 +245,23 @@ class Tensor:
         elif isinstance(value, np.ndarray):
             value = jnp.asarray(value, dtype=self._value.dtype)
         self._value = value
+        self._version += 1
         return self
 
     def fill_(self, value):
         self._value = jnp.full_like(self._value, value)
+        self._version += 1
         return self
 
     def zero_(self):
         self._value = jnp.zeros_like(self._value)
+        self._version += 1
         return self
 
     def copy_(self, other, blocking=True):
         src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
         self._value = jnp.asarray(src, dtype=self._value.dtype)
+        self._version += 1
         return self
 
     # __getitem__/__setitem__ and arithmetic operators are attached by

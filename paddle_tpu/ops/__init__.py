@@ -198,6 +198,7 @@ def monkey_patch():
         r = idx + max(-offset, 0)
         c = idx + max(offset, 0)
         self._value = self._value.at[..., r, c].set(value)
+        self._version += 1
         return self
 
     Tensor.fill_diagonal_ = fill_diagonal_

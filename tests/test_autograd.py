@@ -225,3 +225,182 @@ class TestIntDtypeFlow:
         x = paddle.to_tensor(r(3, 4), stop_gradient=False)
         idx = paddle.argmax(x, axis=1)
         assert idx.stop_gradient
+
+
+class TestDoubleGrad:
+    """create_graph=True (reference: eager GeneralGrad + double-grad ops,
+    paddle/fluid/eager/backward.cc:37)."""
+
+    def test_tanh_second_derivative(self):
+        from paddle_tpu import autograd
+
+        xv = np.array([0.3, -0.7, 1.2], np.float32)
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        (g1,) = autograd.grad(paddle.tanh(x).sum(), x, create_graph=True)
+        assert not g1.stop_gradient
+        (g2,) = autograd.grad(g1.sum(), x)
+        t = np.tanh(xv)
+        np.testing.assert_allclose(g2.numpy(), -2 * t * (1 - t ** 2),
+                                   rtol=1e-5)
+
+    def test_matmul_chain_vs_finite_differences(self):
+        from paddle_tpu import autograd
+
+        rng = np.random.RandomState(0)
+        W = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        x0 = rng.randn(2, 4).astype(np.float32)
+
+        def first_grad(xv, create=False):
+            xt = paddle.to_tensor(xv, stop_gradient=False)
+            y = (paddle.matmul(xt, W) ** 2).sum()
+            (g,) = autograd.grad(y, xt, create_graph=create)
+            return xt, g
+
+        xt, g1 = first_grad(x0, create=True)
+        (g2,) = autograd.grad((g1 ** 2).sum(), xt)
+        eps, fd = 1e-3, np.zeros_like(x0)
+        for i in range(x0.shape[0]):
+            for j in range(x0.shape[1]):
+                xp, xm = x0.copy(), x0.copy()
+                xp[i, j] += eps
+                xm[i, j] -= eps
+                fp = float((first_grad(xp)[1] ** 2).sum().numpy())
+                fm = float((first_grad(xm)[1] ** 2).sum().numpy())
+                fd[i, j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(g2.numpy(), fd, rtol=2e-3, atol=2e-3)
+
+    def test_conv2d_grad_of_grad(self):
+        from paddle_tpu import autograd
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(1)
+        w = paddle.to_tensor(rng.randn(2, 1, 3, 3).astype(np.float32) * 0.3)
+        x0 = rng.randn(1, 1, 5, 5).astype(np.float32)
+
+        def first_grad(xv, create=False):
+            xt = paddle.to_tensor(xv, stop_gradient=False)
+            y = (F.conv2d(xt, w) ** 2).sum()
+            (g,) = autograd.grad(y, xt, create_graph=create)
+            return xt, g
+
+        xt, g1 = first_grad(x0, create=True)
+        (g2,) = autograd.grad((g1 ** 2).sum(), xt)
+        eps = 1e-3
+        fd = np.zeros_like(x0)
+        it = np.nditer(x0, flags=["multi_index"])
+        for _ in it:
+            idx = it.multi_index
+            xp, xm = x0.copy(), x0.copy()
+            xp[idx] += eps
+            xm[idx] -= eps
+            fp = float((first_grad(xp)[1] ** 2).sum().numpy())
+            fm = float((first_grad(xm)[1] ** 2).sum().numpy())
+            fd[idx] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(g2.numpy(), fd, rtol=5e-3, atol=5e-3)
+
+    def test_third_order(self):
+        from paddle_tpu import autograd
+
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        (g1,) = autograd.grad((x ** 4).sum(), x, create_graph=True)
+        (g2,) = autograd.grad(g1.sum(), x, create_graph=True)
+        (g3,) = autograd.grad(g2.sum(), x)
+        np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-5)
+
+    def test_gradient_penalty_backward_to_params(self):
+        from paddle_tpu import autograd
+        import paddle_tpu.nn as nn
+
+        rng = np.random.RandomState(2)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        xin = paddle.to_tensor(rng.randn(3, 4).astype(np.float32),
+                               stop_gradient=False)
+        (gx,) = autograd.grad(net(xin).sum(), xin, create_graph=True)
+        penalty = ((gx ** 2).sum() - 1) ** 2
+        penalty.backward()
+        gw = net[0].weight.grad
+        assert gw is not None and np.isfinite(gw.numpy()).all()
+        assert float(np.abs(gw.numpy()).sum()) > 0
+
+    def test_pylayer_create_graph(self):
+        from paddle_tpu import autograd
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return g * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = Cube.apply(x)
+        (g1,) = autograd.grad(y.sum(), x, create_graph=True)
+        (g2,) = autograd.grad(g1.sum(), x)  # d2/dx2 x^3 = 6x
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-5)
+
+
+class TestInplaceVersionCheck:
+    """Reference: eager VariableWrapper inplace_version checking — mutating
+    a tensor consumed by a recorded op must raise at backward, not corrupt
+    gradients silently."""
+
+    def test_fill_after_forward_raises(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        x.fill_(100.0)
+        with pytest.raises(RuntimeError, match="inplace"):
+            y.backward()
+
+    def test_set_value_after_forward_raises(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        x.set_value(np.array([7.0], np.float32))
+        with pytest.raises(RuntimeError, match="inplace"):
+            from paddle_tpu import autograd
+
+            autograd.grad(y, x, create_graph=True)
+
+    def test_recorded_inplace_still_works(self):
+        # setitem IS the recorded mutation — its own node must not trip
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        x[0] = 5.0
+        (x * x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 4.0, 6.0])
+
+    def test_mutation_after_backward_is_fine(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        (x * x).sum().backward()
+        x.fill_(0.0)  # nodes already released — no raise
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_functional_grad_does_not_touch_other_leaves(self):
+        from paddle_tpu import autograd
+
+        w = paddle.to_tensor(r(3, 3), stop_gradient=False)
+        x = paddle.to_tensor(r(2, 3), stop_gradient=False)
+        y = paddle.matmul(x, w).sum()
+        (gx,) = autograd.grad(y, x, create_graph=True)
+        assert w.grad is None, "grad() must not write .grad of non-inputs"
+
+    def test_create_graph_under_no_grad(self):
+        from paddle_tpu import autograd
+
+        x = paddle.to_tensor(np.array([0.5], np.float32),
+                             stop_gradient=False)
+        y = (x * x + x * x).sum()  # fan-in at leaf
+        with paddle.no_grad():
+            (g1,) = autograd.grad(y, x, create_graph=True)
+        assert not g1.stop_gradient
+        (g2,) = autograd.grad(g1.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [4.0], rtol=1e-6)
