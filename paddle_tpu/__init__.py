@@ -70,8 +70,35 @@ from .nn import utils as _nn_utils  # noqa: F401
 from .models import bert as _bert_models  # noqa: F401
 from . import models  # noqa: F401
 
-# paddle.linalg namespace is the ops.linalg module re-exported
+# paddle.linalg namespace is the ops.linalg module re-exported; register
+# it in sys.modules so `import paddle_tpu.linalg` works like the reference
+# `import paddle.linalg` (a real module there).
+import sys as _sys
+
 from .ops import linalg  # noqa: F401
+
+_sys.modules.setdefault(__name__ + ".linalg", linalg)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr printing options (reference:
+    python/paddle/tensor/to_string.py set_printoptions) — host-side, maps
+    onto numpy's printoptions since Tensor.__repr__ renders via numpy."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
 
 
 def disable_static(place=None):

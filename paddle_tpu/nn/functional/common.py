@@ -249,3 +249,73 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         return apply("bilinear", _bilinear, _t(x1), _t(x2), _t(weight), _t(bias))
     return apply("bilinear", _bilinear, _t(x1), _t(x2), _t(weight))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample input at normalized grid locations (reference:
+    python/paddle/nn/functional/vision.py grid_sample → grid_sampler op).
+
+    x: [N, C, H, W]; grid: [N, Ho, Wo, 2] with (x, y) in [-1, 1]."""
+    def _gs(xv, gv):
+        N, C, H, W = xv.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) / 2.0 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        gx = unnorm(gv[..., 0], W)  # [N, Ho, Wo]
+        gy = unnorm(gv[..., 1], H)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            def reflect(c, size):
+                if align_corners:  # mirror around 0 and size-1
+                    span = size - 1
+                    if span == 0:  # single-pixel axis: everything maps to 0
+                        return jnp.zeros_like(c)
+                    c = span - jnp.abs(jnp.mod(c, 2 * span) - span)
+                else:  # mirror around -0.5 and size-0.5
+                    span = size
+                    c = span - jnp.abs(jnp.mod(c + 0.5, 2 * span)
+                                       - span) - 0.5
+                return jnp.clip(c, 0, size - 1)
+            gx = reflect(gx, W)
+            gy = reflect(gy, H)
+
+        def sample_img(img, sy, sx):
+            # img [C, H, W]; sy/sx [Ho, Wo]
+            if mode == "nearest":
+                yi = jnp.clip(jnp.round(sy), 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(jnp.round(sx), 0, W - 1).astype(jnp.int32)
+                v = img[:, yi, xi]
+                if padding_mode == "zeros":
+                    ok = ((sy >= -0.5) & (sy <= H - 0.5) & (sx >= -0.5)
+                          & (sx <= W - 0.5)).astype(img.dtype)
+                    v = v * ok[None]
+                return v
+            y0 = jnp.floor(sy)
+            x0 = jnp.floor(sx)
+            wy = sy - y0
+            wx = sx - x0
+
+            def corner(yi, xi):
+                ok = ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                      & (xi <= W - 1)).astype(img.dtype)
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                v = img[:, yc, xc]
+                if padding_mode == "zeros":
+                    v = v * ok[None]
+                return v
+
+            return (corner(y0, x0) * (1 - wy) * (1 - wx)
+                    + corner(y0, x0 + 1) * (1 - wy) * wx
+                    + corner(y0 + 1, x0) * wy * (1 - wx)
+                    + corner(y0 + 1, x0 + 1) * wy * wx)
+
+        return jax.vmap(sample_img)(xv, gy, gx)
+
+    return apply("grid_sample", _gs, _t(x), _t(grid))

@@ -177,7 +177,8 @@ def cond(x, p=None, name=None):
 def lu(x, pivot=True, get_infos=False, name=None):
     def _lu(v):
         lu_mat, piv = jax.scipy.linalg.lu_factor(v)
-        return lu_mat, piv.astype(jnp.int32)
+        # LAPACK/paddle convention: 1-indexed pivots
+        return lu_mat, piv.astype(jnp.int32) + 1
     out = apply("lu", _lu, _t(x), _differentiable=False)
     if get_infos:
         return out[0], out[1], Tensor(jnp.zeros((), jnp.int32))
@@ -197,3 +198,42 @@ def householder_product(x, tau, name=None):
             q = q @ h
         return q[..., :, :n]
     return apply("householder_product", _hp, _t(x), _t(tau))
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (reference: python/paddle/tensor/linalg.py mv)."""
+    return apply("mv", lambda m, v: m @ v, _t(x), _t(vec))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu results into (P, L, U) (reference:
+    python/paddle/tensor/linalg.py lu_unpack).  Batched LU data is
+    supported; disabled parts return None like the reference."""
+    L = U = P = None
+    if unpack_ludata:
+        def _unpack(lu_mat):
+            m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+            k = min(m, n)
+            L_ = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(
+                m, k, dtype=lu_mat.dtype)
+            U_ = jnp.triu(lu_mat[..., :k, :])
+            return L_, U_
+        L, U = apply("lu_unpack", _unpack, _t(x))
+    if unpack_pivots:
+        # pivots (1-indexed LAPACK row swaps) -> permutation matrices,
+        # per batch element (host math, int path)
+        import numpy as np
+
+        piv = np.asarray(_t(y)._value)
+        m = int(_t(x)._value.shape[-2])
+        batch_shape = piv.shape[:-1]
+        piv2 = piv.reshape(-1, piv.shape[-1])
+        Ps = np.zeros((piv2.shape[0], m, m), np.float32)
+        for b in range(piv2.shape[0]):
+            perm = np.arange(m)
+            for i in range(min(m, piv2.shape[1])):
+                j = int(piv2[b, i]) - 1
+                perm[i], perm[j] = perm[j], perm[i]
+            Ps[b, perm, np.arange(m)] = 1.0
+        P = Tensor(jnp.asarray(Ps.reshape(batch_shape + (m, m))))
+    return P, L, U

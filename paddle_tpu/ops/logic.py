@@ -93,3 +93,15 @@ def all(x, axis=None, keepdim=False, name=None):
         return tuple(a) if isinstance(a, (list, tuple)) else int(a)
     return apply("all", lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim),
                  _t(x), _differentiable=False)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._value.dtype, jnp.integer)

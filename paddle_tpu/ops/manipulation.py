@@ -464,3 +464,48 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         in_shard = (v >= lo) & (v < lo + size)
         return jnp.where(in_shard, v - lo, ignore_value)
     return apply("shard_index", _shard, _t(input), _differentiable=False)
+
+
+def shape(input, name=None):
+    """Shape as an int32 tensor (reference: paddle.shape op)."""
+    return Tensor(jnp.asarray(_t(input)._value.shape, jnp.int32))
+
+
+def rank(input, name=None):
+    """Rank (ndim) as a 0-D int32 tensor."""
+    return Tensor(jnp.asarray(_t(input)._value.ndim, jnp.int32))
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+# ------------------------------------------------- TensorArray (dygraph)
+# Reference python/paddle/tensor/array.py: in dygraph these operate on a
+# plain Python list (the LoDTensorArray analog).
+def create_array(dtype="float32", initialized_list=None):
+    array = list(initialized_list) if initialized_list is not None else []
+    for v in array:
+        if not isinstance(v, Tensor):
+            raise TypeError(
+                f"initialized_list items must be Tensors, got {type(v)}")
+    return array
+
+
+def array_write(x, i, array=None):
+    idx = int(i.item()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = _t(x)
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.item()) if isinstance(i, Tensor) else int(i)
+    return array[idx]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array)))  # int32 — TPU-native index width

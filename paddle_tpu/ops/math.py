@@ -343,3 +343,44 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference: paddle.add_n over
+    the sum op, python/paddle/tensor/math.py)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    def _sum(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+    return apply("add_n", _sum, *[_t(v) for v in inputs])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize sub-tensors along `axis` whose p-norm exceeds max_norm
+    (reference: python/paddle/tensor/math.py renorm)."""
+    def _renorm(v):
+        nd = v.ndim
+        ax = axis if axis >= 0 else axis + nd
+        reduce_axes = tuple(i for i in range(nd) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=reduce_axes,
+                        keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * scale
+    return apply("renorm", _renorm, _t(x))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Cumulative logsumexp (reference: python/paddle/tensor/math.py
+    logcumsumexp)."""
+    def _lce(v):
+        ax = axis
+        if ax is None:
+            v = v.reshape(-1)
+            ax = 0
+        vmax = jnp.max(v, axis=ax, keepdims=True)
+        out = jnp.log(jnp.cumsum(jnp.exp(v - vmax), axis=ax)) + vmax
+        return out
+    return apply("logcumsumexp", _lce, _t(x))

@@ -104,13 +104,366 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply("roi_align", _roi, _t(x), _t(boxes))
 
 
+def _bin_masks(lo, hi, n_bins, size, quantize):
+    """Per-bin membership masks over a length-`size` axis.
+
+    Returns [R, n_bins, size] bool: position p belongs to bin i of roi r.
+    quantize=True floors/ceils bin edges (RoIPool semantics)."""
+    edges = lo[:, None] + (hi - lo)[:, None] / n_bins * jnp.arange(
+        n_bins + 1, dtype=lo.dtype)[None, :]
+    start = jnp.floor(edges[:, :-1]) if quantize else edges[:, :-1]
+    end = jnp.ceil(edges[:, 1:]) if quantize else edges[:, 1:]
+    p = jnp.arange(size, dtype=lo.dtype)[None, None, :]
+    return (p >= start[:, :, None]) & (p < jnp.maximum(
+        end, start + 1)[:, :, None])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool: exact max over each quantized bin (reference:
+    vision/ops.py roi_pool → roi_pool op), computed as masked max
+    reductions per output bin — static shapes, XLA-friendly."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _roi(feat, rois):
+        C, H, W = feat.shape[1:]
+        x1 = jnp.floor(rois[:, 0] * spatial_scale)
+        y1 = jnp.floor(rois[:, 1] * spatial_scale)
+        x2 = jnp.ceil(rois[:, 2] * spatial_scale)
+        y2 = jnp.ceil(rois[:, 3] * spatial_scale)
+        row_m = _bin_masks(y1, jnp.maximum(y2, y1 + 1), oh, H, True)
+        col_m = _bin_masks(x1, jnp.maximum(x2, x1 + 1), ow, W, True)
+        img = feat[0]  # [C, H, W]
+        neg = jnp.asarray(-3.4e38, img.dtype)
+        outs = []
+        for i in range(oh):  # static tiny loops over bins
+            rm = row_m[:, i][:, None, :, None]  # [R,1,H,1]
+            rowred = jnp.max(jnp.where(rm, img[None], neg), axis=2)
+            # rowred: [R, C, W]
+            cols = []
+            for j in range(ow):
+                cm = col_m[:, j][:, None, :]
+                cols.append(jnp.max(jnp.where(cm, rowred, neg), axis=2))
+            outs.append(jnp.stack(cols, axis=-1))  # [R, C, ow]
+        return jnp.stack(outs, axis=2)  # [R, C, oh, ow]
+
+    return apply("roi_pool", _roi, _t(x), _t(boxes))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool op): input
+    channels C = out_C * oh * ow; bin (i, j) AVERAGES its own channel
+    plane over the bin's positions."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _roi(feat, rois):
+        C, H, W = feat.shape[1:]
+        out_c = C // (oh * ow)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        row_m = _bin_masks(y1, y2, oh, H, True)
+        col_m = _bin_masks(x1, x2, ow, W, True)
+        planes = feat[0].reshape(out_c, oh, ow, H, W)
+        outs = []
+        for i in range(oh):
+            rm = row_m[:, i].astype(planes.dtype)  # [R, H]
+            cols = []
+            for j in range(ow):
+                cm = col_m[:, j].astype(planes.dtype)  # [R, W]
+                mask2 = rm[:, :, None] * cm[:, None, :]  # [R, H, W]
+                s = jnp.einsum("chw,rhw->rc", planes[:, i, j], mask2)
+                cnt = jnp.maximum(mask2.sum(axis=(1, 2)), 1.0)[:, None]
+                cols.append(s / cnt)
+            outs.append(jnp.stack(cols, axis=-1))  # [R, out_c, ow]
+        return jnp.stack(outs, axis=2)  # [R, out_c, oh, ow]
+
+    return apply("psroi_pool", _roi, _t(x), _t(boxes))
+
+
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
              clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
              iou_aware_factor=0.5):
-    raise NotImplementedError("yolo_box: planned detection-suite op")
+    """Decode YOLOv3 head predictions into boxes + scores (reference:
+    paddle/fluid/operators/detection/yolo_box_op.h semantics)."""
+    an = len(anchors) // 2
+
+    def _decode(xv, imgs):
+        N, C, H, W = xv.shape
+        attrs = 5 + class_num
+        if iou_aware:
+            # layout (reference yolo_box_util.h GetIoUIndex): an iou
+            # channels first, then the an*(5+cls) prediction block
+            iou = jax.nn.sigmoid(xv[:, :an].reshape(N, an, H, W))
+            p = xv[:, an:].reshape(N, an, attrs, H, W)
+        else:
+            p = xv.reshape(N, an, attrs, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        sig = jax.nn.sigmoid
+        bias_ = 0.5 * (scale_x_y - 1.0)
+        cx = (sig(p[:, :, 0]) * scale_x_y - bias_ + gx) / W
+        cy = (sig(p[:, :, 1]) * scale_x_y - bias_ + gy) / H
+        bw = jnp.exp(p[:, :, 2]) * aw / (downsample_ratio * W)
+        bh = jnp.exp(p[:, :, 3]) * ah / (downsample_ratio * H)
+        conf = sig(p[:, :, 4])
+        if iou_aware:
+            conf = (conf ** (1.0 - iou_aware_factor)
+                    * iou ** iou_aware_factor)
+        cls = sig(p[:, :, 5:])
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * iw
+        y1 = (cy - bh / 2) * ih
+        x2 = (cx + bw / 2) * iw
+        y2 = (cy + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        keep = (conf >= conf_thresh).astype(xv.dtype)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=2) * keep[:, :, None]
+        scores = cls * (conf * keep)[:, :, None]
+        boxes = jnp.transpose(boxes, (0, 1, 3, 4, 2)).reshape(
+            N, an * H * W, 4)
+        scores = jnp.transpose(scores, (0, 1, 3, 4, 2)).reshape(
+            N, an * H * W, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", _decode, _t(x), _t(img_size))
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                   dilation=1, deformable_groups=1, groups=1, mask=None,
                   name=None):
-    raise NotImplementedError("deform_conv2d: planned detection-suite op")
+    """Deformable convolution v1/v2 (reference:
+    paddle/fluid/operators/deformable_conv_op.* / vision/ops.py
+    deform_conv2d): bilinear sampling at offset-shifted kernel taps, then
+    a grouped matmul — im2col + GEMM, the MXU-friendly formulation.
+
+    offset: [N, 2*dg*kh*kw, Ho, Wo] interleaved (y, x) per tap;
+    mask (v2): [N, dg*kh*kw, Ho, Wo]."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    dg = deformable_groups
+
+    def _dcn(xv, off, w, *rest, has_mask=False, has_bias=False):
+        m = rest[0] if has_mask else None
+        b = rest[-1] if has_bias else None
+        N, C, H, W = xv.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho, Wo = off.shape[2], off.shape[3]
+        off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]
+        ky = (jnp.arange(kh) * dh).repeat(kw)
+        kx = jnp.tile(jnp.arange(kw) * dw, kh)
+        # sampling positions [N, dg, kh*kw, Ho, Wo]
+        py = base_y[None, None] + ky[None, None, :, None, None] \
+            + off[:, :, :, 0]
+        px = base_x[None, None] + kx[None, None, :, None, None] \
+            + off[:, :, :, 1]
+
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        cg = C // dg  # channels per deformable group
+
+        def corner(yi, xi):
+            valid = ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                     & (xi <= W - 1)).astype(xv.dtype)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+
+            def per_image(img, ycn, xcn, vn):
+                # img [dg, cg, H, W]; ycn/xcn [dg, K, Ho, Wo]
+                def per_group(g_img, gy, gx, gv):
+                    return g_img[:, gy, gx] * gv[None]  # [cg, K, Ho, Wo]
+
+                return jax.vmap(per_group)(img, ycn, xcn, vn)
+
+            imgs = xv.reshape(N, dg, cg, H, W)
+            return jax.vmap(per_image)(imgs, yc, xc, valid)
+
+        v00 = corner(y0, x0)
+        v01 = corner(y0, x0 + 1)
+        v10 = corner(y0 + 1, x0)
+        v11 = corner(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, None]
+        wx_ = wx[:, :, None]
+        sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                   + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        # sampled: [N, dg, cg, K, Ho, Wo]
+        if m is not None:
+            sampled = sampled * m.reshape(N, dg, 1, kh * kw, Ho, Wo)
+        cols = sampled.reshape(N, C, kh * kw, Ho, Wo)
+        # grouped GEMM: w [Cout, Cin_g, kh*kw]
+        wg = w.reshape(groups, Cout // groups, Cin_g, kh * kw)
+        colsg = cols.reshape(N, groups, Cin_g, kh * kw, Ho, Wo)
+        out = jnp.einsum("gock,ngckhw->ngohw", wg, colsg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Cout, Ho, Wo).astype(xv.dtype)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    extra = []
+    if mask is not None:
+        extra.append(_t(mask))
+    if bias is not None:
+        extra.append(_t(bias))
+    return apply("deform_conv2d", _dcn, _t(x), _t(offset), _t(weight),
+                 *extra, has_mask=mask is not None,
+                 has_bias=bias is not None)
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference: vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes to [C, H, W] uint8 (reference decode_jpeg, host
+    side).  Uses Pillow when available."""
+    try:
+        import io
+
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow on the host") from e
+    raw = bytes(np.asarray(_t(x)._value, np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+# ------------------------------------------------------- layer wrappers
+def _to_2tuple(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# nn imports vision transforms indirectly, so the Layer subclasses are
+# defined ONCE on first use (stable types: isinstance and
+# type(a) is type(b) behave normally) via this memoized factory.
+_layer_classes = {}
+
+
+def _get_layer_class(name):
+    if name in _layer_classes:
+        return _layer_classes[name]
+    from .. import nn
+    from ..nn import initializer as I
+
+    class _DeformConv2D(nn.Layer):
+        """Layer over deform_conv2d (reference vision/ops.py DeformConv2D)."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, dilation=1, deformable_groups=1,
+                     groups=1, weight_attr=None, bias_attr=None):
+            super().__init__()
+            kh, kw = _to_2tuple(kernel_size)
+            self._attrs = dict(stride=stride, padding=padding,
+                               dilation=dilation,
+                               deformable_groups=deformable_groups,
+                               groups=groups)
+            self.weight = self.create_parameter(
+                [out_channels, in_channels // groups, kh, kw],
+                attr=weight_attr)
+            self.bias = None if bias_attr is False else \
+                self.create_parameter([out_channels], attr=bias_attr,
+                                      default_initializer=I.Constant(0.0))
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(x, offset, self.weight, self.bias,
+                                 mask=mask, **self._attrs)
+
+    def make_pool(pool_fn, cls_name):
+        class _Pool(nn.Layer):
+            def __init__(self, output_size, spatial_scale=1.0):
+                super().__init__()
+                self._output_size = output_size
+                self._spatial_scale = spatial_scale
+
+            def forward(self, x, boxes, boxes_num=None):
+                return pool_fn(x, boxes, boxes_num, self._output_size,
+                               self._spatial_scale)
+
+        _Pool.__name__ = _Pool.__qualname__ = cls_name
+        return _Pool
+
+    _layer_classes.update({
+        "DeformConv2D": _DeformConv2D,
+        "RoIAlign": make_pool(roi_align, "RoIAlign"),
+        "RoIPool": make_pool(roi_pool, "RoIPool"),
+        "PSRoIPool": make_pool(psroi_pool, "PSRoIPool"),
+    })
+    return _layer_classes[name]
+
+
+class _LazyLayer:
+    """Callable + isinstance-able proxy for a lazily-defined Layer class."""
+
+    def __init__(self, name):
+        self._name = name
+        self.__name__ = name
+
+    def __call__(self, *args, **kwargs):
+        return _get_layer_class(self._name)(*args, **kwargs)
+
+    def __instancecheck__(self, obj):
+        return isinstance(obj, _get_layer_class(self._name))
+
+
+DeformConv2D = _LazyLayer("DeformConv2D")
+RoIAlign = _LazyLayer("RoIAlign")
+RoIPool = _LazyLayer("RoIPool")
+PSRoIPool = _LazyLayer("PSRoIPool")
+
+_UNSET = object()
+
+
+def ConvNormActivation(in_channels, out_channels, kernel_size=3, stride=1,
+                       padding=None, groups=1, norm_layer=_UNSET,
+                       activation_layer=_UNSET, dilation=1, bias=None):
+    """Conv2D + Norm + Activation block (reference: vision/ops.py
+    ConvNormActivation).  Pass norm_layer=None / activation_layer=None to
+    genuinely omit that stage (the defaults are BatchNorm2D / ReLU)."""
+    from .. import nn
+
+    if padding is None:
+        padding = (kernel_size - 1) // 2 * dilation
+    if norm_layer is _UNSET:
+        norm_layer = nn.BatchNorm2D
+    if activation_layer is _UNSET:
+        activation_layer = nn.ReLU
+    if bias is None:
+        bias = norm_layer is None
+    layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                        padding, dilation=dilation, groups=groups,
+                        bias_attr=None if bias else False)]
+    if norm_layer is not None:
+        layers.append(norm_layer(out_channels))
+    if activation_layer is not None:
+        layers.append(activation_layer())
+    return nn.Sequential(*layers)
