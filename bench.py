@@ -64,12 +64,18 @@ def _init_backend(max_tries: int = 4):
 
     last_err = None
     for attempt in range(max_tries):
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=300,
-            env=dict(os.environ))
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=600,
+                env=dict(os.environ))
+        except subprocess.TimeoutExpired as e:
+            last_err = f"probe timed out after {e.timeout}s"
+            print(f"# backend probe {attempt + 1}/{max_tries}: {last_err}",
+                  file=sys.stderr)
+            continue
         probed = probe.stdout.strip().splitlines()[-1] if \
             probe.stdout.strip() else ""
         if probe.returncode == 0 and (

@@ -67,6 +67,8 @@ define_flag("check_nan_inf", False, "check every op output for nan/inf")
 define_flag("eager_op_jit", True, "jit-compile eager per-op computations")
 define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA owns HBM")
 define_flag("use_pallas_kernels", True, "use Pallas kernels for fused ops on TPU")
+define_flag("use_autotune", False, "search + cache kernel tile sizes "
+            "(reference: phi/kernels/autotune switch_autotune)")
 define_flag("benchmark", False, "synchronize after every op (timing mode)")
 define_flag("tracer_mkldnn_ops_on", "", "parity stub")
 define_flag("max_inplace_grad_add", 0, "parity stub")

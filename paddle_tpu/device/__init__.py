@@ -35,6 +35,80 @@ def synchronize(device=None):
             pass
 
 
+# ------------------------------------------------------- memory stats
+# Reference: paddle/fluid/memory/stats.h (HostMemoryStat* / DeviceMemoryStat*
+# with peak tracking) and python/paddle/device/cuda max_memory_allocated.
+# TPU-native: PJRT exposes per-device memory_stats() (bytes_in_use,
+# peak_bytes_in_use); on backends without stats (CPU) we fall back to
+# summing live arrays and track the peak at query time.
+_peak_fallback = {"allocated": 0}
+
+
+def _device_obj(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    return device
+
+
+def _mem_stats(device=None):
+    d = _device_obj(device)
+    try:
+        return d.memory_stats()
+    except Exception:
+        return None
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on the device."""
+    stats = _mem_stats(device)
+    if stats:
+        return int(stats.get("bytes_in_use", 0))
+    total = 0
+    for a in (jax.live_arrays() if hasattr(jax, "live_arrays") else []):
+        try:
+            total += a.nbytes
+        except Exception:
+            pass
+    _peak_fallback["allocated"] = max(_peak_fallback["allocated"], total)
+    return total
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of allocated bytes (PJRT peak_bytes_in_use)."""
+    stats = _mem_stats(device)
+    if stats:
+        return int(stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0)))
+    memory_allocated(device)  # refresh the fallback peak
+    return _peak_fallback["allocated"]
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (PJRT bytes_reserved +
+    in-use; CPU fallback: same as allocated)."""
+    stats = _mem_stats(device)
+    if stats:
+        return int(stats.get("bytes_reserved", 0)
+                   + stats.get("bytes_in_use", 0))
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    stats = _mem_stats(device)
+    if stats:
+        return int(stats.get("peak_bytes_reserved",
+                             stats.get("peak_bytes_in_use", 0)))
+    return max_memory_allocated(device)
+
+
+def reset_peak_memory_stats(device=None):
+    """Best-effort peak reset (PJRT peaks are monotonic; the fallback
+    peak is ours to reset)."""
+    _peak_fallback["allocated"] = 0
+
+
 class Stream:
     """API-compat stream object: XLA orders work by program order, so
     streams are identity contexts (reference: phi stream objects)."""
@@ -111,8 +185,16 @@ class cuda:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        return 0
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return 0
+        return memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)

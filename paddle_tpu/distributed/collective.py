@@ -78,6 +78,63 @@ def _group_axis(group):
     return group.axis_name
 
 
+def _world_mesh():
+    """1-device-per-process mesh for eager cross-process collectives.
+
+    Using one device per process (the first of each) keeps the global
+    array's leading dim == process_count divisible regardless of how many
+    chips each host owns; every process still participates in the compiled
+    collective, so the reduction is correct on multi-chip hosts too."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    per_process = {}
+    for d in jax.devices():
+        per_process.setdefault(d.process_index, d)
+    devs = [per_process[p] for p in sorted(per_process)]
+    return Mesh(np.asarray(devs), ("world",))
+
+
+_CROSS_FNS = {}
+
+
+def _cross_process_all_reduce(value, op):
+    """Eager all-reduce across OS processes: every process contributes its
+    local value to one compiled collective over the global mesh (the
+    multi-controller analog of the reference ProcessGroup AllReduce task,
+    ProcessGroup.h:53).  All processes must call this collectively."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = jax.process_count()
+    mesh = _world_mesh()
+    dev = jax.local_devices()[0]
+    sharding = NamedSharding(mesh, P("world"))
+    garr = jax.make_array_from_single_device_arrays(
+        (n,) + value.shape, sharding,
+        [jax.device_put(value[None], dev)])
+    key = (op, value.shape, str(value.dtype))
+    fn = _CROSS_FNS.get(key)
+    if fn is None:
+        def reduce_fn(x):
+            if op == ReduceOp.SUM:
+                return jnp.sum(x, axis=0)
+            if op == ReduceOp.MAX:
+                return jnp.max(x, axis=0)
+            if op == ReduceOp.MIN:
+                return jnp.min(x, axis=0)
+            if op == ReduceOp.AVG:
+                return jnp.mean(x, axis=0)
+            if op == ReduceOp.PROD:
+                return jnp.prod(x, axis=0)
+            raise ValueError(op)
+
+        fn = jax.jit(reduce_fn,
+                     out_shardings=NamedSharding(mesh, P()))
+        _CROSS_FNS[key] = fn
+    out = fn(garr)
+    return out.addressable_shards[0].data
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _group_axis(group)
     if _axis_in_scope(axis):
@@ -95,6 +152,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             raise ValueError(op)
         out = apply("all_reduce", _ar, tensor)
         tensor._rebind(out)
+        return tensor
+    if jax.process_count() > 1 and group is None:
+        # eager cross-process collective (multi-controller runtime)
+        tensor.set_value(_cross_process_all_reduce(tensor._value, op))
         return tensor
     # eager single-controller: group of compiled ranks not in scope → identity
     return tensor
@@ -195,6 +256,13 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             return full[src]
         out = apply("broadcast", _bc, tensor)
         tensor._rebind(out)
+        return tensor
+    if jax.process_count() > 1 and group is None:
+        from .env import get_rank
+
+        v = tensor._value
+        contrib = v if get_rank() == src else jnp.zeros_like(v)
+        tensor.set_value(_cross_process_all_reduce(contrib, ReduceOp.SUM))
     return tensor
 
 

@@ -13,11 +13,19 @@ from ..nn.layer.layers import Layer
 from .env import ParallelEnv, get_rank, get_world_size
 from .mesh import HybridCommunicateGroup, fleet_mesh, get_mesh
 
+_BOOTSTRAP_STORE = None  # rendezvous TCPStore, alive for the process
+
 
 def init_parallel_env():
-    """Bootstrap the parallel environment.  Multi-host rendezvous (the
-    reference's TCPStore + NCCL-id exchange) is handled by
-    jax.distributed.initialize when PADDLE_TRAINER_ENDPOINTS is set."""
+    """Bootstrap the parallel environment.
+
+    Multi-process: rendezvous over our native TCPStore first (the
+    reference's flow — parallel.py:236 builds a TCPStore, then the process
+    group, reference python/paddle/distributed/parallel.py:91), exchanging
+    the coordinator address through the store; then
+    jax.distributed.initialize joins the processes into one
+    multi-controller runtime, after which eager collectives
+    (distributed.all_reduce etc.) execute across OS processes."""
     import os
 
     env = ParallelEnv()
@@ -25,14 +33,29 @@ def init_parallel_env():
     if eps and len(eps.split(",")) > 1:
         import jax
 
-        coord = eps.split(",")[0]
+        from .store import TCPStore
+
+        world = len(eps.split(","))
+        master_host, master_port = eps.split(",")[0].rsplit(":", 1)
+        store = TCPStore(host=master_host, port=int(master_port),
+                         is_master=env.rank == 0, world_size=world)
+        if env.rank == 0:
+            coord = f"{master_host}:{int(master_port) + 1}"
+            store.set("jax_coordinator", coord)
+        else:
+            coord = store.get("jax_coordinator").decode()
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=len(eps.split(",")),
+                num_processes=world,
                 process_id=env.rank)
-        except (RuntimeError, ValueError):
-            pass  # already initialized
+        except (RuntimeError, ValueError) as e:
+            if "already" not in str(e).lower():
+                raise  # only an already-initialized runtime is benign
+        global _BOOTSTRAP_STORE
+        _BOOTSTRAP_STORE = store  # module-level ref: rank 0's server (and
+        # every rank's client) must outlive this call for later barriers/
+        # key exchange; a local would be GC'd at return
     if get_mesh() is None:
         fleet_mesh(dp_degree=1)
         HybridCommunicateGroup()

@@ -7,8 +7,12 @@ materialize in HBM.  Grid = (batch*heads, q_blocks, k_blocks) with the KV
 axis innermost; m/l/acc accumulate in VMEM scratch across k steps and the
 output block is written on the last k step.
 
-Forward = Pallas kernel; backward recomputes through the XLA reference
-(flash-style recompute: no O(T^2) residuals are saved).
+Backward (round 2) = Pallas kernels too (FlashAttention-2 style): the
+forward saves only O and the per-row logsumexp L; backward recomputes
+P = exp(S - L) blockwise and runs two kernels — dQ (grid over q blocks,
+kv innermost) and dK/dV (grid over kv blocks, q innermost) — so no O(T^2)
+tensor ever lives in HBM in either direction.  XLA-recompute backward
+remains the fallback for untileable shapes.
 """
 from __future__ import annotations
 
@@ -87,6 +91,102 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             o_ref.dtype)
 
 
+def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                    l_ref, *, scale, causal, block_q, block_k, kv_len):
+    """Forward that also writes L = m + log(l) for the Pallas backward."""
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                scale=scale, causal=causal, block_q=block_q,
+                block_k=block_k, kv_len=kv_len)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == nk - 1)
+    def _write_lse():
+        lse_ref[0] = (m_ref[:] + jnp.log(
+            jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale, causal, block_q, block_k):
+    """dQ = sum_k dS @ K * scale, dS = P * (dO V^T - D)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]       # [bq, 1]
+    delta = delta_ref[0][:, None]   # [bq, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)  # masked entries: exp(NEG_INF - lse) = 0
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    acc_ref[:] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    """dV = P^T dO ; dK = dS^T Q * scale — grid over kv blocks, q inner."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)  # [bq, bk]
+    dv_acc[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale  # [bq, bk]
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -128,31 +228,183 @@ def _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out.reshape(B, H, Tq, D)
 
 
+def _tileable(Tq, Tk, block_q, block_k):
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    return (Tq % bq == 0 and Tk % bk == 0), bq, bk
+
+
+def _flash_fwd_lse_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Forward returning (out, lse) via the Pallas kernel."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    ok, bq, bk = _tileable(Tq, Tk, block_q, block_k)
+    assert ok
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    grid = (B * H, Tq // bq, Tk // bk)
+    kernel = functools.partial(
+        _fwd_kernel_lse, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        kv_len=Tk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not interpret) else None,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D), lse
+
+
+def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """FlashAttention-2 backward: dq kernel + dkv kernel."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    ok, bq, bk = _tileable(Tq, Tk, block_q, block_k)
+    assert ok
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    gr = g.reshape(B * H, Tq, D)
+    # delta = rowsum(dO * O) — the 'D' vector of FlashAttention-2
+    delta = jnp.sum(gr.astype(jnp.float32)
+                    * out.reshape(B * H, Tq, D).astype(jnp.float32), axis=-1)
+
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
+    q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kv_spec_dq = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, Tq // bq, Tk // bk),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec,
+                  row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not interpret) else None,
+    )(qr, kr, vr, gr, lse, delta)
+
+    # dkv: grid over kv blocks, q innermost
+    q_spec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * H, Tk // bk, Tq // bq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not interpret) else None,
+    )(qr, kr, vr, gr, lse, delta)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
     return _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    ok, _, _ = _tileable(q.shape[2], k.shape[2], block_q, block_k)
+    if not ok:
+        out = _attn_reference(q, k, v, causal, scale)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_fwd_lse_bhtd(q, k, v, causal, scale, block_q, block_k,
+                                   interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # flash-style: recompute attention under XLA and transpose (no O(T^2)
-    # residual was stored by the forward kernel)
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: _attn_reference(q_, k_, v_, causal, scale), q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = res
+    if lse is None:
+        # untileable shape: XLA recompute fallback
+        _, vjp_fn = jax.vjp(
+            lambda q_, k_, v_: _attn_reference(q_, k_, v_, causal, scale),
+            q, k, v)
+        return vjp_fn(g)
+    return _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k, interpret)
 
 
 _flash_attention_bhtd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+_AUTOTUNE_BLOCKS = [(128, 128), (128, 256), (256, 256), (256, 512),
+                    (512, 512), (512, 1024)]
+
+
+def _autotuned_blocks(q, k, causal, scale, interpret):
+    """(block_q, block_k) via the autotune cache (FLAGS_use_autotune)."""
+    from ..core.flags import flag
+    from . import autotune as at
+
+    if interpret or not flag("use_autotune"):
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    key = (B, H, Tq, Tk, D, str(q.dtype), causal)
+    if isinstance(q, jax.core.Tracer):
+        # under a trace: timing is impossible; use a cached winner if one
+        # exists for these (static) shapes, else the defaults
+        return at.lookup("flash_attention", key) or (DEFAULT_BLOCK_Q,
+                                                     DEFAULT_BLOCK_K)
+    cands = [(bq, bk) for bq, bk in _AUTOTUNE_BLOCKS
+             if Tq % min(bq, Tq) == 0 and Tk % min(bk, Tk) == 0]
+    if not cands:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+    v_probe = k  # same shape/dtype as v
+    jitted = {}  # one compiled fn per cfg: the timed iters must hit the
+    # jit cache, else the search measures XLA compile time, not kernels
+
+    def run(cfg):
+        fn = jitted.get(cfg)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                _flash_fwd_bhtd, causal=causal, scale=scale,
+                block_q=cfg[0], block_k=cfg[1], interpret=False))
+            jitted[cfg] = fn
+        fn(q, k, v_probe).block_until_ready()
+
+    best = at.autotune("flash_attention", key, cands, run)
+    return best or (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
 def flash_attention_bhtd(q, k, v, causal=False, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                         interpret=None):
+                         block_q=None, block_k=None, interpret=None):
     """[B, H, T, D] flash attention."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -160,6 +412,10 @@ def flash_attention_bhtd(q, k, v, causal=False, scale=None,
         interpret = jax.default_backend() != "tpu"
     if not _HAS_PLTPU:
         return _attn_reference(q, k, v, causal, scale)
+    if block_q is None or block_k is None:
+        abq, abk = _autotuned_blocks(q, k, causal, scale, interpret)
+        block_q = block_q or abq
+        block_k = block_k or abk
     return _flash_attention_bhtd(q, k, v, causal, scale, block_q, block_k,
                                  interpret)
 

@@ -151,11 +151,16 @@ class LlamaAttention(nn.Layer):
         v = self.v_proj(hidden).reshape([B, T, self.num_kv_heads,
                                          self.head_dim])
 
-        def _rope_q(qv):
-            return apply_rope(qv, cos, sin, position_offset)
-        q = apply("rope", _rope_q, q)
-        k = apply("rope", lambda kv: apply_rope(kv, cos, sin, position_offset),
-                  k)
+        def _rope_fn(xv):
+            from ..core.flags import flag
+
+            if flag("use_pallas_kernels") and jax.default_backend() == "tpu":
+                from ..kernels.rope import fused_rope
+
+                return fused_rope(xv, cos, sin, position_offset)
+            return apply_rope(xv, cos, sin, position_offset)
+        q = apply("rope", _rope_fn, q)
+        k = apply("rope", _rope_fn, k)
 
         if cache is not None:
             from ..ops.manipulation import concat

@@ -111,8 +111,14 @@ def _decoder_layer(h, lp, cos, sin, cfg: LlamaConfig, use_pallas=False):
     q = (x @ lp["q"]).reshape(B, T, n_h, hd)
     k = (x @ lp["k"]).reshape(B, T, n_kv, hd)
     v = (x @ lp["v"]).reshape(B, T, n_kv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if use_pallas:
+        from ..kernels.rope import fused_rope
+
+        q = fused_rope(q, cos, sin)
+        k = fused_rope(k, cos, sin)
+    else:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     if use_pallas:
         from ..kernels.flash_attention import flash_attention_bthd
 

@@ -374,8 +374,11 @@ def renorm(x, p, axis, max_norm, name=None):
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
     """Cumulative logsumexp (reference: python/paddle/tensor/math.py
-    logcumsumexp)."""
+    logcumsumexp).  dtype casts the INPUT before computing, like the
+    reference."""
     def _lce(v):
+        if dtype is not None:
+            v = v.astype(to_np(dtype))
         ax = axis
         if ax is None:
             v = v.reshape(-1)

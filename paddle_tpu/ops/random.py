@@ -23,8 +23,12 @@ from ..core.tensor import Tensor, to_tensor
 
 
 class Generator:
+    """Lazy PRNG state: the key materializes on first use, NOT at
+    construction — creating it at import time would run a computation and
+    poison jax.distributed.initialize (which must run before any)."""
+
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._seed = seed
 
     def manual_seed(self, seed: int):
@@ -36,6 +40,8 @@ class Generator:
         return self._seed
 
     def next_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

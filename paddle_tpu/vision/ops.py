@@ -101,7 +101,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1_, x0) * wy * (1 - wx)
                + g(y0, x1_) * (1 - wy) * wx + g(y1_, x1_) * wy * wx)
         return jnp.transpose(out, (1, 0, 2, 3))  # [R,C,oh,ow]
-    return apply("roi_align", _roi, _t(x), _t(boxes))
+    return _per_image_pool(
+        _t(x), _t(boxes), boxes_num,
+        lambda xi, bi: apply("roi_align", _roi, xi, bi))
 
 
 def _bin_masks(lo, hi, n_bins, size, quantize):
@@ -118,10 +120,36 @@ def _bin_masks(lo, hi, n_bins, size, quantize):
         end, start + 1)[:, :, None])
 
 
+def _per_image_pool(x, boxes, boxes_num, pool_one):
+    """Apply a single-image pooling fn per batch image, splitting `boxes`
+    by boxes_num (host-concrete in eager mode), and concat row-wise."""
+    N = x.shape[0]
+    if boxes_num is None:
+        if N != 1:
+            raise ValueError(
+                "batched input needs boxes_num (rois per image); got "
+                f"batch={N} with boxes_num=None")
+        return pool_one(x, boxes)
+    counts = [int(v) for v in np.asarray(_t(boxes_num)._value).reshape(-1)]
+    if len(counts) != N:
+        raise ValueError(f"boxes_num has {len(counts)} entries for "
+                         f"batch {N}")
+    outs, start = [], 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        outs.append(pool_one(x[i:i + 1], boxes[start:start + c]))
+        start += c
+    from ..ops.manipulation import concat
+
+    return outs[0] if len(outs) == 1 else concat(outs, axis=0)
+
+
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     """RoIPool: exact max over each quantized bin (reference:
     vision/ops.py roi_pool → roi_pool op), computed as masked max
-    reductions per output bin — static shapes, XLA-friendly."""
+    reductions per output bin — static shapes, XLA-friendly.  Batched
+    input routes each roi to its own image via boxes_num."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
@@ -148,7 +176,9 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
             outs.append(jnp.stack(cols, axis=-1))  # [R, C, ow]
         return jnp.stack(outs, axis=2)  # [R, C, oh, ow]
 
-    return apply("roi_pool", _roi, _t(x), _t(boxes))
+    return _per_image_pool(
+        _t(x), _t(boxes), boxes_num,
+        lambda xi, bi: apply("roi_pool", _roi, xi, bi))
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
@@ -183,7 +213,9 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             outs.append(jnp.stack(cols, axis=-1))  # [R, out_c, ow]
         return jnp.stack(outs, axis=2)  # [R, out_c, oh, ow]
 
-    return apply("psroi_pool", _roi, _t(x), _t(boxes))
+    return _per_image_pool(
+        _t(x), _t(boxes), boxes_num,
+        lambda xi, bi: apply("psroi_pool", _roi, xi, bi))
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,

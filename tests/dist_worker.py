@@ -1,0 +1,79 @@
+"""Worker program for the multi-process distributed test (the reference's
+dist_*.py pattern: test_dist_base.py runs the model file standalone vs
+distributed and compares losses — test_dist_base.py:782).
+
+Run with PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_TRAINER_ENDPOINTS
+set; writes a JSON result file given by PADDLE_TEST_OUT.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+
+    env = dist.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    assert jax.process_count() == world, (
+        f"jax runtime has {jax.process_count()} processes, expected {world}")
+
+    # ---- eager cross-process all_reduce ----
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    expect = sum(range(1, world + 1))
+    np.testing.assert_allclose(t.numpy(), np.full((4,), expect), rtol=1e-6)
+
+    # max + broadcast
+    t2 = paddle.to_tensor(np.float32([10.0 * (rank + 1)]))
+    dist.all_reduce(t2, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(t2.numpy(), [10.0 * world])
+    t3 = paddle.to_tensor(np.float32([float(rank + 7)]))
+    dist.broadcast(t3, src=0)
+    np.testing.assert_allclose(t3.numpy(), [7.0])
+
+    # ---- 2-rank DP training step: grads averaged across processes ----
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    rng = np.random.RandomState(42)  # same stream on both ranks
+    losses = []
+    lr = 0.1
+    for step in range(3):
+        xb = rng.rand(4 * world, 8).astype(np.float32)
+        yb = rng.randint(0, 4, (4 * world,)).astype(np.int32)
+        # each rank consumes its shard of the global batch
+        xs = xb[rank * 4:(rank + 1) * 4]
+        ys = yb[rank * 4:(rank + 1) * 4]
+        loss = nn.functional.cross_entropy(
+            net(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        loss.backward()
+        for p in net.parameters():
+            if p.grad is not None:
+                dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+                p.set_value(p._value - lr * p.grad._value)
+        net.clear_gradients()
+        # global loss for comparison = mean over ranks
+        lt = paddle.to_tensor(np.float32([float(loss.numpy())]))
+        dist.all_reduce(lt, op=dist.ReduceOp.AVG)
+        losses.append(float(lt.numpy()))
+
+    out = {"rank": rank, "losses": losses,
+           "w0": np.asarray(net[0].weight.numpy()).tolist()}
+    with open(os.environ["PADDLE_TEST_OUT"], "w") as f:
+        json.dump(out, f)
+    print(f"rank {rank} ok", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

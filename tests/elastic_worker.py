@@ -1,0 +1,57 @@
+"""Elastic-training worker: registers with ElasticManager over TCPStore,
+heartbeats, and trains a tiny model with per-step checkpoints until killed.
+(The reference kills real trainer subprocesses in its elastic tests —
+SURVEY.md §4.)"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    host, port = os.environ["ELASTIC_STORE"].rsplit(":", 1)
+    ckpt = os.environ["ELASTIC_CKPT"]
+    store = TCPStore(host=host, port=int(port), is_master=False,
+                     world_size=2)
+    mgr = ElasticManager(store, node_id=os.environ["ELASTIC_NODE"],
+                         np_range=(1, 2), heartbeat_interval=0.2,
+                         lease_ttl=1.5)
+    mgr.register()
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 2, (8,)).astype(np.int32))
+    step = 0
+    print("worker started", flush=True)
+    while True:  # until killed
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        for p in net.parameters():
+            if p.grad is not None:
+                p.set_value(p._value - 0.1 * p.grad._value)
+        net.clear_gradients()
+        step += 1
+        state = {"step": step, "loss": float(loss.numpy()),
+                 "weights": net.state_dict()}
+        paddle.save(state, ckpt + ".tmp")
+        os.replace(ckpt + ".tmp", ckpt)
+        store.set("worker_step", str(step))
+        if step == 1:
+            print("first checkpoint written", flush=True)
+
+
+if __name__ == "__main__":
+    main()

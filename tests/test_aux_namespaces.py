@@ -244,3 +244,30 @@ class TestBert:
         mask = paddle.to_tensor(np.ones((2, 8), np.float32))
         logits = model(ids, attention_mask=mask)
         assert logits.shape == [2, 3]
+
+
+class TestMemoryStats:
+    """PJRT-backed memory observability (reference:
+    paddle/fluid/memory/stats.h, python/paddle/device/cuda
+    max_memory_allocated)."""
+
+    def test_allocated_and_peak(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.device as D
+
+        x = paddle.to_tensor(np.zeros((128, 128), np.float32))
+        a = D.memory_allocated()
+        m = D.max_memory_allocated()
+        assert a >= 128 * 128 * 4
+        assert m >= a
+        assert D.memory_reserved() >= 0
+        assert D.cuda.memory_allocated() == D.memory_allocated()
+        del x
+
+    def test_reset_peak(self):
+        import paddle_tpu.device as D
+
+        D.reset_peak_memory_stats()
+        assert D.max_memory_allocated() >= 0

@@ -360,3 +360,29 @@ class TestMiscNewOps:
         assert ra(x, rois).shape == [1, 4, 2, 2]
         cn = vops.ConvNormActivation(4, 8)
         assert cn(x).shape == [1, 8, 8, 8]
+
+
+class TestBatchedRoiPools:
+    def test_roi_pool_batched_routes_to_own_image(self):
+        import paddle_tpu.vision.ops as vops
+
+        feat = paddle.to_tensor(rn(2, 4, 8, 8))
+        rois = paddle.to_tensor(np.array(
+            [[0., 0., 8., 8.], [0., 0., 8., 8.]], np.float32))
+        out = vops.roi_pool(feat, rois, paddle.to_tensor(
+            np.array([1, 1], np.int32)), 1)
+        # roi 0 pools image 0, roi 1 pools image 1 — different maxima
+        np.testing.assert_allclose(
+            out.numpy()[0, :, 0, 0], feat.numpy()[0].max(axis=(1, 2)),
+            atol=1e-6)
+        np.testing.assert_allclose(
+            out.numpy()[1, :, 0, 0], feat.numpy()[1].max(axis=(1, 2)),
+            atol=1e-6)
+
+    def test_batched_without_boxes_num_raises(self):
+        import paddle_tpu.vision.ops as vops
+
+        feat = paddle.to_tensor(rn(2, 4, 8, 8))
+        rois = paddle.to_tensor(np.array([[0., 0., 4., 4.]], np.float32))
+        with pytest.raises(ValueError, match="boxes_num"):
+            vops.roi_pool(feat, rois, None, 2)
