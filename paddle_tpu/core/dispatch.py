@@ -229,14 +229,27 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
 
 
 def _check_nan_inf(name, outputs):
-    """FLAGS_check_nan_inf analog (reference: details/nan_inf_utils_detail)."""
+    """FLAGS_check_nan_inf analog (reference: details/nan_inf_utils_detail,
+    hooked into every op run at operator.cc:1270).  Eager: host check.
+    Compiled: a device-side finite-reduction feeds a debug callback that
+    raises — the compiled-mode debug path the reference gets from its
+    per-op nan/inf CUDA kernels."""
     import numpy as np
 
     for t in outputs:
         v = t._value
-        if hasattr(v, "aval") and not hasattr(v, "addressable_shards"):
-            return  # tracer: skip
-        if jnp.issubdtype(v.dtype, jnp.inexact):
-            arr = np.asarray(v.astype(jnp.float32))
-            if not np.isfinite(arr).all():
-                raise FloatingPointError(f"op {name} produced nan/inf")
+        if not jnp.issubdtype(v.dtype, jnp.inexact):
+            continue
+        if isinstance(v, jax.core.Tracer):
+            ok = jnp.isfinite(v.astype(jnp.float32)).all()
+
+            def _host_assert(ok_val, _name=name):
+                if not bool(ok_val):
+                    raise FloatingPointError(
+                        f"op {_name} produced nan/inf (compiled mode)")
+
+            jax.debug.callback(_host_assert, ok)
+            continue
+        arr = np.asarray(v.astype(jnp.float32))
+        if not np.isfinite(arr).all():
+            raise FloatingPointError(f"op {name} produced nan/inf")

@@ -295,6 +295,13 @@ def distributed_optimizer(optimizer, strategy=None):
 
         optimizer = LocalSGDOptimizer(optimizer,
                                       **(strategy.localsgd_configs or {}))
+    if strategy is not None and getattr(strategy, "gradient_merge", False):
+        from .meta_optimizers import GradientMergeOptimizer
+
+        cfg = strategy.gradient_merge_configs or {}
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
     return optimizer
 
 

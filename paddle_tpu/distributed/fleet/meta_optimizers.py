@@ -152,3 +152,114 @@ class DGCMomentum(Momentum):
                         - lr * sparse).astype(p._value.dtype)
         else:
             super()._update_param(p, g, lr)
+
+
+class GradientMergeOptimizer:
+    """k-step gradient accumulation before one real update (reference:
+    meta_optimizers/gradient_merge_optimizer.py — static-mode conditional
+    blocks become value-level jnp.where selects, so the SAME wrapper works
+    eagerly and inside a jit-compiled train step).
+
+    Every step: grads accumulate into an optimizer slot; the inner update
+    runs UNCONDITIONALLY on the running accumulator, and param/state
+    changes are kept only on every k-th step — XLA folds the non-apply
+    branch into a no-op select, keeping the step program static."""
+
+    def __init__(self, inner_optimizer: Optimizer, k_steps: int = 2,
+                 avg: bool = True):
+        self._inner = inner_optimizer
+        self.k_steps = max(1, int(k_steps))
+        self.avg = avg
+        self._probed = set()  # param ids whose slots are materialized
+        self._calls = 0       # python-side, for _step_count bookkeeping
+        inner_optimizer._global_state.setdefault(
+            "grad_merge_step", jnp.asarray(0, jnp.int32))
+
+    def step(self):
+        from ...core.tensor import Tensor
+
+        inner = self._inner
+        k = self.k_steps
+        if k == 1:
+            return inner.step()
+        store = inner._accumulators.setdefault("grad_merge", {})
+        cnt = inner._global_state["grad_merge_step"] + 1
+        inner._global_state["grad_merge_step"] = cnt
+        apply_now = (cnt % k) == 0
+
+        # accumulate this microbatch's grads; `params` covers every param
+        # with EITHER a fresh grad or a pending accumulator, so a param
+        # whose grad is absent in the apply-step microbatch (conditional
+        # branch) still gets its merged gradient applied rather than
+        # silently wiped.
+        all_params = [p for p, _, _ in inner._collect_params_grads()]
+        for p in all_params:
+            if p.grad is not None:
+                g = p.grad._value
+                acc = store.get(id(p))
+                store[id(p)] = g if acc is None else acc + g
+        params = [p for p in all_params if id(p) in store]
+
+        # materialize the inner optimizer's slots BEFORE snapshotting —
+        # slots born inside a non-apply step would dodge the blend and
+        # keep partial-gradient pollution.  Probing runs the full update
+        # rule on a zero probe, so do it once per param.
+        for p in params:
+            if id(p) in self._probed:
+                continue
+            names, inits = inner._probe_accumulators(p)
+            for name, init in zip(names, inits):
+                inner._accumulators.setdefault(name, {}).setdefault(
+                    id(p), init)
+            self._probed.add(id(p))
+
+        # snapshot (COPIES: the inner update rules donate their param and
+        # slot buffers — a reference would be a deleted array afterwards),
+        # run the inner update on the accumulated grad, then blend
+        def _copy(v):
+            return v.copy() if hasattr(v, "copy") else v
+
+        snap_p = [(p, _copy(p._value)) for p in params]
+        snap_acc = {name: {pid: _copy(v) for pid, v in s.items()}
+                    for name, s in inner._accumulators.items()
+                    if name != "grad_merge"}
+        snap_global = {key: _copy(v)
+                       for key, v in inner._global_state.items()}
+        denom = float(k) if self.avg else 1.0
+        for p in params:
+            p.grad = Tensor(store[id(p)] / denom, stop_gradient=True)
+        inner.step()
+        # python-side step counter: count only real (every k-th) updates,
+        # so state_dict()['@step'] matches the device-side blended counter
+        self._calls += 1
+        if self._calls % k != 0:
+            inner._step_count = max(0, inner._step_count - 1)
+        for p, old in snap_p:
+            p._value = jnp.where(apply_now, p._value, old)
+        for name, snap in snap_acc.items():
+            cur = inner._accumulators[name]
+            for pid, old in snap.items():
+                if pid in cur and getattr(cur[pid], "shape", None) == \
+                        getattr(old, "shape", ()):
+                    cur[pid] = jnp.where(apply_now, cur[pid], old)
+        for key, old in snap_global.items():
+            if key == "grad_merge_step":
+                continue
+            try:
+                inner._global_state[key] = jnp.where(
+                    apply_now, inner._global_state[key], old)
+            except Exception:
+                pass
+        for pid in list(store):
+            store[pid] = jnp.where(apply_now,
+                                   jnp.zeros_like(store[pid]), store[pid])
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        if name.startswith("_inner") or name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self._inner, name)

@@ -118,6 +118,13 @@ def _find_optimizers(obj) -> list:
     out = []
     seen = set()
     for v in _flatten_candidates(_referenced_objects(obj)):
+        # meta-optimizer wrappers (GradientMerge/LocalSGD) hold the real
+        # Optimizer as ._inner — unwrap so its state threads through
+        hops = 0
+        while not isinstance(v, Optimizer) and hops < 4 and \
+                getattr(v, "_inner", None) is not None:
+            v = v._inner
+            hops += 1
         if isinstance(v, Optimizer) and id(v) not in seen:
             seen.add(id(v))
             out.append(v)

@@ -319,3 +319,27 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return jax.vmap(sample_img)(xv, gy, gx)
 
     return apply("grid_sample", _gs, _t(x), _t(grid))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Length tensor -> padding mask (reference:
+    python/paddle/fluid/layers/sequence_lod.py sequence_mask):
+    out[..., j] = j < x[...]."""
+    from ...core.dtype import to_np
+
+    def _mask(lens, maxlen_val):
+        m = int(maxlen_val)
+        rng = jnp.arange(m)
+        return (rng[None, :] < lens.reshape(-1, 1)).reshape(
+            tuple(lens.shape) + (m,)).astype(to_np(dtype))
+
+    lens = _t(x)
+    if maxlen is None:
+        if isinstance(lens._value, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask without maxlen has a data-dependent output "
+                "shape; pass maxlen explicitly under jit")
+        import numpy as np
+
+        maxlen = int(np.asarray(lens._value).max())
+    return apply("sequence_mask", _mask, lens, maxlen_val=int(maxlen))
