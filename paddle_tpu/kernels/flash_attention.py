@@ -34,6 +34,9 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+# TPU vector lanes: per-row scalars (lse, delta) are stored broadcast over a
+# trailing lane dim so their blocks satisfy the (8, 128) tiling rule.
+NUM_LANES = 128
 
 
 def _attn_reference(q, k, v, causal, scale):
@@ -102,8 +105,9 @@ def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(ki == nk - 1)
     def _write_lse():
-        lse_ref[0] = (m_ref[:] + jnp.log(
-            jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+        lse = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))  # [bq, 1]
+        lse_ref[0] = jax.lax.broadcast_in_dim(
+            lse[:, 0], lse_ref.shape[1:], (0,))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -121,8 +125,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]       # [bq, 1]
-    delta = delta_ref[0][:, None]   # [bq, 1]
+    lse = lse_ref[0][:, :1]         # [bq, 1] (lanes are identical)
+    delta = delta_ref[0][:, :1]     # [bq, 1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -161,8 +165,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0][:, :1]
+    delta = delta_ref[0][:, :1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -257,11 +261,11 @@ def _flash_fwd_lse_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, NUM_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, NUM_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -273,7 +277,7 @@ def _flash_fwd_lse_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary"))
         if (_HAS_PLTPU and not interpret) else None,
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D), lse
+    return out.reshape(B, H, Tq, D), lse[:, :, 0]
 
 
 def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
@@ -290,11 +294,14 @@ def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     # delta = rowsum(dO * O) — the 'D' vector of FlashAttention-2
     delta = jnp.sum(gr.astype(jnp.float32)
                     * out.reshape(B * H, Tq, D).astype(jnp.float32), axis=-1)
+    # broadcast per-row scalars over lanes so blocks obey the (8,128) tiling
+    lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, NUM_LANES))
+    delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, NUM_LANES))
 
     common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     kv_spec_dq = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, bq, NUM_LANES), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(B * H, Tq // bq, Tk // bk),
@@ -307,12 +314,12 @@ def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
         if (_HAS_PLTPU and not interpret) else None,
-    )(qr, kr, vr, gr, lse, delta)
+    )(qr, kr, vr, gr, lse_l, delta_l)
 
     # dkv: grid over kv blocks, q innermost
     q_spec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
     kv_spec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
-    row_spec2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    row_spec2 = pl.BlockSpec((1, bq, NUM_LANES), lambda b, j, i: (b, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(B * H, Tk // bk, Tq // bq),
@@ -327,7 +334,7 @@ def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
         if (_HAS_PLTPU and not interpret) else None,
-    )(qr, kr, vr, gr, lse, delta)
+    )(qr, kr, vr, gr, lse_l, delta_l)
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 

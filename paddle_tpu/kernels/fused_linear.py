@@ -52,7 +52,7 @@ def _kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, act, has_bias):
     def _epilogue():
         z = acc_ref[:]
         if has_bias:
-            z = z + b_ref[:].astype(jnp.float32)
+            z = z + b_ref[0].astype(jnp.float32)  # [bn] row broadcast
         o_ref[:] = _ACTS[act](z).astype(o_ref.dtype)
 
 
@@ -66,14 +66,15 @@ def _fused_linear_fwd(x, w, b, act, bm, bn, bk, interpret):
             z = z + b
         return _ACTS[act](z).astype(x.dtype)
     has_bias = b is not None
-    b_in = b if has_bias else jnp.zeros((N,), x.dtype)
+    # bias travels as [1, N] — 1-D operands hit XLA/Mosaic layout mismatches
+    b_in = (b if has_bias else jnp.zeros((N,), x.dtype)).reshape(1, N)
     out = pl.pallas_call(
         functools.partial(_kernel, act=act, has_bias=has_bias),
         grid=(M // bm_, N // bn_, K // bk_),
         in_specs=[
             pl.BlockSpec((bm_, bk_), lambda i, j, k: (i, k)),
             pl.BlockSpec((bk_, bn_), lambda i, j, k: (k, j)),
-            pl.BlockSpec((bn_,), lambda i, j, k: (j,)),
+            pl.BlockSpec((1, bn_), lambda i, j, k: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm_, bn_), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
