@@ -290,11 +290,9 @@ def distributed_optimizer(optimizer, strategy=None):
         return out
 
     optimizer.step = _step
-    if strategy is not None and getattr(strategy, "localsgd", False):
-        from .meta_optimizers import LocalSGDOptimizer
-
-        optimizer = LocalSGDOptimizer(optimizer,
-                                      **(strategy.localsgd_configs or {}))
+    # gradient_merge wraps the base optimizer; localsgd goes OUTERMOST so
+    # its sync schedule counts whole train-loop steps and its own counters
+    # are never touched by GradientMerge's step-count bookkeeping.
     if strategy is not None and getattr(strategy, "gradient_merge", False):
         from .meta_optimizers import GradientMergeOptimizer
 
@@ -302,6 +300,11 @@ def distributed_optimizer(optimizer, strategy=None):
         optimizer = GradientMergeOptimizer(
             optimizer, k_steps=cfg.get("k_steps", 1),
             avg=cfg.get("avg", True))
+    if strategy is not None and getattr(strategy, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      **(strategy.localsgd_configs or {}))
     return optimizer
 
 

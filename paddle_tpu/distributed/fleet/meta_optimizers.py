@@ -69,8 +69,10 @@ class LocalSGDOptimizer:
             for p in group:
                 all_reduce(p, op=ReduceOp.AVG)
 
-    def clear_grad(self):
-        self._inner.clear_grad()
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
 
     def minimize(self, loss, *a, **k):
         return self._inner.minimize(loss, *a, **k)
@@ -200,6 +202,25 @@ class GradientMergeOptimizer:
                 store[id(p)] = g if acc is None else acc + g
         params = [p for p in all_params if id(p) in store]
 
+        # Eager fast path: outside a trace apply_now is concrete, so the
+        # snapshot/update/blend dance (which runs the full inner update and
+        # copies every slot just to discard them on non-apply steps) is
+        # unnecessary — accumulate-and-return, or apply the merged grad.
+        if not isinstance(cnt, jax.core.Tracer):
+            self._calls += 1
+            if not bool(apply_now):
+                return
+            denom = float(k) if self.avg else 1.0
+            for p in params:
+                p.grad = Tensor(store[id(p)] / denom, stop_gradient=True)
+            inner.step()
+            # zero-fill (not clear): the traced path keeps keys alive, so a
+            # param that stops receiving grads still gets zero-grad updates
+            # (weight decay etc.) — eager must match compiled semantics.
+            for pid in list(store):
+                store[pid] = jnp.zeros_like(store[pid])
+            return
+
         # materialize the inner optimizer's slots BEFORE snapshotting —
         # slots born inside a non-apply step would dodge the blend and
         # keep partial-gradient pollution.  Probing runs the full update
@@ -230,10 +251,15 @@ class GradientMergeOptimizer:
             p.grad = Tensor(store[id(p)] / denom, stop_gradient=True)
         inner.step()
         # python-side step counter: count only real (every k-th) updates,
-        # so state_dict()['@step'] matches the device-side blended counter
+        # so state_dict()['@step'] matches the device-side blended counter.
+        # Adjust the innermost base Optimizer — a wrapper (e.g. LocalSGD)
+        # between us and it owns an unrelated _step_count of its own.
         self._calls += 1
         if self._calls % k != 0:
-            inner._step_count = max(0, inner._step_count - 1)
+            base = inner
+            while not isinstance(base, Optimizer) and hasattr(base, "_inner"):
+                base = base._inner
+            base._step_count = max(0, base._step_count - 1)
         for p, old in snap_p:
             p._value = jnp.where(apply_now, p._value, old)
         for name, snap in snap_acc.items():

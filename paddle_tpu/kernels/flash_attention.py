@@ -52,7 +52,7 @@ def _attn_reference(q, k, v, causal, scale):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k, kv_len):
+                scale, causal, block_q, block_k, offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -74,7 +74,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
 
     m_prev = m_ref[:]  # [block_q, 1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -95,11 +95,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                    l_ref, *, scale, causal, block_q, block_k, kv_len):
+                    l_ref, *, scale, causal, block_q, block_k, offset):
     """Forward that also writes L = m + log(l) for the Pallas backward."""
     _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                 scale=scale, causal=causal, block_q=block_q,
-                block_k=block_k, kv_len=kv_len)
+                block_k=block_k, offset=offset)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -111,7 +111,7 @@ def _fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_ref, *, scale, causal, block_q, block_k):
+                   acc_ref, *, scale, causal, block_q, block_k, offset):
     """dQ = sum_k dS @ K * scale, dS = P * (dO V^T - D)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -135,7 +135,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lse)  # masked entries: exp(NEG_INF - lse) = 0
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -150,7 +150,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, offset):
     """dV = P^T dO ; dK = dS^T Q * scale — grid over kv blocks, q inner."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -175,7 +175,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lse)  # [bq, bk]
     dv_acc[:] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
@@ -206,7 +206,7 @@ def _flash_fwd_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (B * H, Tq // bq, Tk // bk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=Tk)
+        offset=Tk - Tq)
     scratch = [
         pltpu.VMEM((bq, D), jnp.float32) if _HAS_PLTPU and not interpret
         else pltpu.VMEM((bq, D), jnp.float32),
@@ -250,7 +250,7 @@ def _flash_fwd_lse_bhtd(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (B * H, Tq // bq, Tk // bk)
     kernel = functools.partial(
         _fwd_kernel_lse, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        kv_len=Tk)
+        offset=Tk - Tq)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -298,7 +298,8 @@ def _flash_bwd_bhtd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     lse_l = jnp.broadcast_to(lse[..., None], (*lse.shape, NUM_LANES))
     delta_l = jnp.broadcast_to(delta[..., None], (*delta.shape, NUM_LANES))
 
-    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk)
+    common = dict(scale=scale, causal=causal, block_q=bq, block_k=bk,
+                  offset=Tk - Tq)
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     kv_spec_dq = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
     row_spec = pl.BlockSpec((1, bq, NUM_LANES), lambda b, i, j: (b, i, 0))

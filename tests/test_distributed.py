@@ -980,3 +980,44 @@ class TestGradientMergeEdgeCases:
             opt.step()
             opt.clear_grad()
         assert opt._inner._step_count == 2
+
+    def test_localsgd_plus_gradient_merge_strategy(self):
+        """Combined localsgd + gradient_merge: LocalSGD wraps outermost,
+        clear_grad forwards through both wrappers, and the k-step merge
+        matches a plain full-batch step at dp=1 (averaging is identity)."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer, LocalSGDOptimizer)
+
+        strategy = DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2, "begin_step": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(0)
+            net = nn.Linear(4, 4)
+            ref = nn.Linear(4, 4)
+            ref.set_state_dict(net.state_dict())
+            opt = fleet.distributed_optimizer(
+                AdamW(1e-2, parameters=net.parameters()), strategy=strategy)
+            assert isinstance(opt, LocalSGDOptimizer)
+            assert isinstance(opt._inner, GradientMergeOptimizer)
+            ref_opt = AdamW(1e-2, parameters=ref.parameters())
+            xs = [paddle.to_tensor(r(2, 4)) for _ in range(4)]
+            for x in xs:
+                net(x).sum().backward()
+                opt.step()
+                opt.clear_grad(set_to_zero=False)  # crashed pre-fix
+            for x0, x1 in [(xs[0], xs[1]), (xs[2], xs[3])]:
+                ((ref(x0).sum() + ref(x1).sum()) / 2.0).backward()
+                ref_opt.step()
+                ref_opt.clear_grad()
+            np.testing.assert_allclose(net.weight.numpy(),
+                                       ref.weight.numpy(), rtol=1e-5,
+                                       atol=1e-6)
+            base = opt._inner._inner
+            assert base._step_count == 2
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None

@@ -106,6 +106,29 @@ class TestFlashBackwardKernel:
         np.testing.assert_allclose(np.asarray(gk), np.asarray(gkr),
                                    atol=2e-4)
 
+    def test_causal_rectangular_bottom_right_aligned(self):
+        """Causal mask with Tq != Tk must use bottom-right alignment (row i
+        sees keys up to i + Tk - Tq), matching the fallback's tril(k=s-t).
+        Regression: the kernels used top-left alignment, so decode-style
+        shapes attended almost nothing on the Pallas path."""
+        from paddle_tpu.kernels.flash_attention import (
+            _attn_reference, flash_attention_bhtd)
+
+        q, k, v = r(1, 2, 64, 16), r(1, 2, 128, 16), r(1, 2, 128, 16)
+        out = flash_attention_bhtd(q, k, v, causal=True, block_q=32,
+                                   block_k=64)
+        ref = _attn_reference(q, k, v, True, 0.25)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4)
+        grads = jax.grad(lambda q_, k_, v_: flash_attention_bhtd(
+            q_, k_, v_, causal=True, block_q=32, block_k=64).sum(),
+            (0, 1, 2))(q, k, v)
+        grefs = jax.grad(lambda q_, k_, v_: _attn_reference(
+            q_, k_, v_, True, 0.25).sum(), (0, 1, 2))(q, k, v)
+        for a, b in zip(grads, grefs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
 
 class TestFusedRoPE:
     def test_matches_apply_rope(self):
