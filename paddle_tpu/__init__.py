@@ -126,3 +126,94 @@ def in_dynamic_mode():
 
 def is_grad_enabled_():  # kept for parity with some callers
     return is_grad_enabled()
+
+
+# --- migration/parity shims ------------------------------------------------
+from .core.place import (  # noqa: F401
+    CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, MLUPlace, NPUPlace,
+    XPUPlace, is_compiled_with_cinn, is_compiled_with_ipu,
+    is_compiled_with_mlu, is_compiled_with_npu, is_compiled_with_rocm,
+    is_compiled_with_xpu,
+)
+
+# paddle.dtype: the scalar-type class itself (reference exposes VarType)
+dtype = DType
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference: paddle.get_cudnn_version -> int|None)."""
+    return None
+
+
+def get_cuda_rng_state():
+    """Maps onto the framework RNG state — there is one generator tree, not
+    a CUDA-specific one (reference: python/paddle/framework/random.py)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference unhooks its C++ fatal-signal dumper
+    (paddle/fluid/platform/init.cc DisableSignalHandler); we install none."""
+    return None
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reader decorator grouping samples into lists of `batch_size`
+    (reference: python/paddle/batch.py)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level parameter factory (reference: python/paddle/tensor/creation.py
+    create_parameter).  In static mode delegates to the Program; eagerly
+    builds a Parameter initialized per `default_initializer` (default:
+    zeros for bias-like, Xavier-uniform otherwise, matching the reference)."""
+    from .static import graph as _g
+
+    if _g.in_static_mode():
+        return static.create_parameter(
+            shape, dtype, name=name, initializer=default_initializer,
+            is_bias=is_bias)
+    import jax.numpy as _jnp
+
+    from .core.dtype import to_np as _to_np
+    from .nn import initializer as _I
+
+    init = default_initializer
+    if init is None:
+        # same defaults as the static path (static/graph.py
+        # create_parameter), so behavior doesn't depend on the mode
+        init = _I.Constant(0.0) if is_bias else _I.XavierNormal()
+    p = Parameter(_jnp.zeros(tuple(int(s) for s in shape), _to_np(dtype)),
+                  name=name)
+    with no_grad():
+        init(p)
+    return p
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+def tanh_(x):
+    """In-place tanh, also exported at top level like the reference."""
+    return x.tanh_()

@@ -53,6 +53,44 @@ class CPUPlace(Place):
         super().__init__("cpu", device_id)
 
 
+class CUDAPlace(Place):
+    """Migration shim (reference: paddle/phi/common/place.h GPUPlace):
+    code written against CUDAPlace runs unmodified with the device id
+    mapping onto the accelerator (TPU) of the same index."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    """Pinned-host shim: host staging buffers are PJRT-managed on TPU."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str = "tpu", device_id: int = 0):
+        super().__init__(device_type, device_id)
+
+
+class XPUPlace(CUDAPlace):
+    pass
+
+
+class NPUPlace(CUDAPlace):
+    pass
+
+
+class MLUPlace(CUDAPlace):
+    pass
+
+
+class IPUPlace(CUDAPlace):
+    def __init__(self):
+        super().__init__(0)
+
+
 @functools.lru_cache(maxsize=None)
 def _accelerator_platform() -> str:
     """The platform name of the default (accelerator-preferred) backend."""
@@ -101,6 +139,31 @@ def is_compiled_with_cuda() -> bool:
 
 
 def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role and is always present
     return True
 
 

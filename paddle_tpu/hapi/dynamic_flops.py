@@ -1,0 +1,132 @@
+"""FLOPs counting for dygraph models (reference:
+python/paddle/hapi/dynamic_flops.py `flops`/`dynamic_flops`).
+
+Registers forward-post hooks on leaf layers, runs one forward pass on
+zero inputs, and sums per-layer multiply-add counts.  Layer types without
+a rule contribute 0 (matching the reference's warning-and-skip policy).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor, to_tensor
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _count_conv(layer, inputs, output):
+    # kernel multiply-adds per output element x output elements (+ bias)
+    w = layer.weight
+    kernel_ops = _prod(w.shape[1:])  # in_ch/groups * kh * kw
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    out_elems = _prod(output.shape)
+    return out_elems * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, inputs, output):
+    in_features = layer.weight.shape[0]
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return _prod(output.shape) * (in_features + bias_ops)
+
+
+def _count_norm(layer, inputs, output):
+    return 2 * _prod(inputs[0].shape)
+
+
+def _count_act(layer, inputs, output):
+    return _prod(output.shape)
+
+
+def _count_pool(layer, inputs, output):
+    return _prod(output.shape)
+
+
+def _count_embedding(layer, inputs, output):
+    return 0
+
+
+_RULES = {}
+
+
+def register_hook_rule(layer_cls, fn):
+    """Extension point matching the reference's custom_ops= argument."""
+    _RULES[layer_cls] = fn
+
+
+for _cls_name, _fn in [
+    ("Conv1D", _count_conv), ("Conv2D", _count_conv), ("Conv3D", _count_conv),
+    ("Conv1DTranspose", _count_conv), ("Conv2DTranspose", _count_conv),
+    ("Linear", _count_linear),
+    ("BatchNorm", _count_norm), ("BatchNorm1D", _count_norm),
+    ("BatchNorm2D", _count_norm), ("BatchNorm3D", _count_norm),
+    ("LayerNorm", _count_norm), ("GroupNorm", _count_norm),
+    ("InstanceNorm2D", _count_norm), ("SyncBatchNorm", _count_norm),
+    ("ReLU", _count_act), ("ReLU6", _count_act), ("GELU", _count_act),
+    ("Sigmoid", _count_act), ("Softmax", _count_act), ("Silu", _count_act),
+    ("Hardswish", _count_act), ("Hardsigmoid", _count_act),
+    ("LeakyReLU", _count_act), ("Tanh", _count_act), ("PReLU", _count_act),
+    ("AvgPool1D", _count_pool), ("AvgPool2D", _count_pool),
+    ("AvgPool3D", _count_pool), ("MaxPool1D", _count_pool),
+    ("MaxPool2D", _count_pool), ("MaxPool3D", _count_pool),
+    ("AdaptiveAvgPool1D", _count_pool), ("AdaptiveAvgPool2D", _count_pool),
+    ("AdaptiveAvgPool3D", _count_pool), ("AdaptiveMaxPool2D", _count_pool),
+    ("Embedding", _count_embedding),
+]:
+    _cls = getattr(nn, _cls_name, None)
+    if _cls is not None:
+        _RULES[_cls] = _fn
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total multiply-add count of one forward pass.
+
+    ``input_size``: shape of a single zero input, e.g. [1, 3, 224, 224].
+    ``custom_ops``: {LayerClass: fn(layer, inputs, output) -> int}.
+    Returns the FLOPs as an int (reference returns the same and prints a
+    per-layer table with print_detail=True).
+    """
+    rules = dict(_RULES)
+    if custom_ops:
+        rules.update(custom_ops)
+    counts = []
+    handles = []
+
+    def make_hook(rule, layer):
+        def hook(lyr, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            counts.append((type(lyr).__name__, int(rule(lyr, inputs, out))))
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        if len(list(sub.children())) > 0:
+            continue  # leaves only
+        rule = rules.get(type(sub))
+        if rule is None:
+            for klass, fn in rules.items():
+                if isinstance(sub, klass):
+                    rule = fn
+                    break
+        if rule is not None:
+            handles.append(sub.register_forward_post_hook(make_hook(rule, sub)))
+    training = net.training
+    net.eval()
+    try:
+        x = to_tensor(np.zeros(input_size, dtype=np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if training:
+            net.train()
+    total = sum(c for _, c in counts)
+    if print_detail:
+        for name, c in counts:
+            print(f"{name:>24}: {c:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

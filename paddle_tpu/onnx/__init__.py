@@ -1,12 +1,82 @@
-"""paddle.onnx (reference: python/paddle/onnx/export.py → paddle2onnx).
+"""paddle.onnx (reference: python/paddle/onnx/export.py -> paddle2onnx).
 
-ONNX export from StableHLO needs an external converter not present in this
-environment; jit.save's StableHLO artifact is the portable format.
+TPU-native design: the reference shells out to the external paddle2onnx
+converter over a static Program; here the model is traced to a jaxpr
+(the same static-shape tracing contract as jit.to_static) and converted
+in-tree to an ONNX ModelProto (converter.py), serialized with
+protoc-generated bindings (_pb.py).  Model parameters are embedded as
+initializers, so the .onnx file is self-contained and loads in
+onnxruntime/netron.  reference_runtime.py can execute the exported
+subset with numpy for verification without onnxruntime.
 """
+from __future__ import annotations
+
+import numpy as np
+
+from . import _pb, converter, reference_runtime  # noqa: F401
+from .reference_runtime import run_model  # noqa: F401
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX export unavailable (no paddle2onnx equivalent in-image); use "
-        "paddle_tpu.jit.save — the serialized StableHLO artifact is portable "
-        "across PJRT runtimes")
+def _example_array(spec):
+    from ..core.tensor import Tensor
+    from ..static import InputSpec
+
+    if isinstance(spec, Tensor):
+        return np.asarray(spec.numpy())
+    if isinstance(spec, InputSpec):
+        shape = [1 if (s is None or int(s) < 0) else int(s)
+                 for s in spec.shape]
+        from ..core.dtype import to_np
+
+        return np.zeros(shape, to_np(spec.dtype) if spec.dtype else
+                        np.float32)
+    if isinstance(spec, np.ndarray):
+        return spec
+    raise TypeError(f"input_spec entries must be InputSpec/Tensor, got "
+                    f"{type(spec)}")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a Layer (or callable) to `path + '.onnx'`.
+
+    Matches the reference signature (python/paddle/onnx/export.py): the
+    saved file is `path` with the `.onnx` suffix appended, input_spec
+    gives shapes/dtypes (unknown dims become 1 — the exporter is
+    static-shape like the rest of the XLA pipeline).
+    Returns the file path."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (shapes are "
+                         "static under tracing)")
+    examples = [_example_array(s) for s in input_spec]
+
+    def fn(*arrays):
+        outs = layer(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in outs)
+        return outs._value if isinstance(outs, Tensor) else outs
+
+    training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        closed = jax.make_jaxpr(fn)(*examples)
+    finally:
+        if training and hasattr(layer, "train"):
+            layer.train()
+
+    names = []
+    for i, s in enumerate(input_spec):
+        n = getattr(s, "name", None)
+        names.append(n if n else f"input_{i}")
+    conv = converter.Converter(opset=int(opset_version))
+    model = conv.convert(closed, names,
+                         graph_name=type(layer).__name__)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return out_path

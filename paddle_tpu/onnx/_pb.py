@@ -1,0 +1,50 @@
+"""protoc-generated bindings for onnx.proto, built on first use.
+
+Mirrors the repo's native-build pattern (core/native/build.py): the
+generated module is cached next to a hash of the .proto so schema edits
+regenerate automatically.  protoc is part of the base toolchain.
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sys
+
+_MOD = None
+
+
+def _cache_dir() -> str:
+    root = os.environ.get("PADDLE_TPU_CACHE",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "paddle_tpu"))
+    d = os.path.join(root, "onnx_pb")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def get() -> "module":
+    """The generated onnx_pb2 module (ModelProto, GraphProto, ...)."""
+    global _MOD
+    if _MOD is not None:
+        return _MOD
+    proto = os.path.join(os.path.dirname(__file__), "onnx.proto")
+    src = open(proto, "rb").read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    d = _cache_dir()
+    gen = os.path.join(d, f"onnx_pb2_{tag}.py")
+    if not os.path.exists(gen):
+        tmp = os.path.join(d, "_build")
+        os.makedirs(tmp, exist_ok=True)
+        subprocess.run(
+            ["protoc", f"--proto_path={os.path.dirname(proto)}",
+             f"--python_out={tmp}", os.path.basename(proto)],
+            check=True, capture_output=True)
+        os.replace(os.path.join(tmp, "onnx_pb2.py"), gen)
+    spec = importlib.util.spec_from_file_location("paddle_tpu_onnx_pb2", gen)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_onnx_pb2"] = mod
+    spec.loader.exec_module(mod)
+    _MOD = mod
+    return mod

@@ -1,0 +1,239 @@
+"""Dependency-free numpy evaluator for the ONNX subset this exporter emits.
+
+Serves two purposes: round-trip verification in tests (export -> parse ->
+execute -> compare against the live model) and a fallback runtime for
+environments without onnxruntime (the ONNX project ships an analogous
+reference evaluator).  Only the ops produced by converter.py are covered.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _pb
+
+_NP_DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16,
+             5: np.int16, 6: np.int32, 7: np.int64, 9: np.bool_,
+             10: np.float16, 11: np.float64, 12: np.uint32, 13: np.uint64}
+
+
+def _to_numpy(t):
+    if t.data_type == 16:  # bfloat16: widen via uint16 bit pattern
+        raw = np.frombuffer(t.raw_data, dtype=np.uint16)
+        f32 = (raw.astype(np.uint32) << 16).view(np.float32)
+        return f32.reshape(tuple(t.dims))
+    dt = _NP_DTYPE[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(tuple(t.dims))
+    if t.data_type == 1:
+        return np.asarray(t.float_data, dt).reshape(tuple(t.dims))
+    if t.data_type == 7:
+        return np.asarray(t.int64_data, dt).reshape(tuple(t.dims))
+    return np.asarray(t.int32_data, dt).reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    pb = _pb.get()
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == pb.AttributeProto.TENSOR:
+            out[a.name] = _to_numpy(a.t)
+    return out
+
+
+def _pool_patches(x, kernel, strides, pads, pad_value=0):
+    # x: [N, C, *spatial]; returns windows [N, C, *out_spatial, *kernel]
+    nsp = len(kernel)
+    pad_width = [(0, 0), (0, 0)] + [
+        (pads[i], pads[i + nsp]) for i in range(nsp)]
+    xp = np.pad(x, pad_width, constant_values=pad_value)
+    out_sp = [(xp.shape[2 + i] - kernel[i]) // strides[i] + 1
+              for i in range(nsp)]
+    windows = np.empty(list(x.shape[:2]) + out_sp + list(kernel), x.dtype)
+    for idx in np.ndindex(*out_sp):
+        slc = tuple(slice(idx[i] * strides[i], idx[i] * strides[i] + kernel[i])
+                    for i in range(nsp))
+        windows[(slice(None), slice(None)) + idx] = xp[(slice(None),
+                                                        slice(None)) + slc]
+    return windows, nsp
+
+
+def _conv(x, w, attrs):
+    strides = attrs.get("strides")
+    pads = attrs.get("pads")
+    dil = attrs.get("dilations")
+    group = attrs.get("group", 1)
+    kernel = list(w.shape[2:])
+    nsp = len(kernel)
+    # dilate kernel
+    if any(d != 1 for d in dil):
+        kd = [(k - 1) * d + 1 for k, d in zip(kernel, dil)]
+        wd = np.zeros(list(w.shape[:2]) + kd, w.dtype)
+        wd[(slice(None), slice(None))
+           + tuple(slice(None, None, d) for d in dil)] = w
+        w, kernel = wd, kd
+    windows, _ = _pool_patches(x, kernel, strides, pads)
+    # windows: [N, Cin, *out, *k]; w: [Cout, Cin/g, *k]
+    N = x.shape[0]
+    cout = w.shape[0]
+    cin_g = w.shape[1]
+    out_sp = windows.shape[2:2 + nsp]
+    win = windows.reshape(N, group, cin_g, int(np.prod(out_sp)),
+                          int(np.prod(kernel)))
+    wg = w.reshape(group, cout // group, cin_g, int(np.prod(kernel)))
+    out = np.einsum("ngcpk,gock->ngop", win, wg)
+    return out.reshape((N, cout) + tuple(out_sp))
+
+
+def run_model(model_bytes_or_proto, inputs):
+    """Execute a serialized ModelProto on numpy inputs (dict or list)."""
+    pb = _pb.get()
+    if isinstance(model_bytes_or_proto, (bytes, bytearray)):
+        model = pb.ModelProto()
+        model.ParseFromString(bytes(model_bytes_or_proto))
+    else:
+        model = model_bytes_or_proto
+    graph = model.graph
+    env = {t.name: _to_numpy(t) for t in graph.initializer}
+    input_names = [vi.name for vi in graph.input]
+    if isinstance(inputs, dict):
+        env.update({k: np.asarray(v) for k, v in inputs.items()})
+    else:
+        for name, v in zip(input_names, inputs):
+            env[name] = np.asarray(v)
+
+    for node in graph.node:
+        op = node.op_type
+        x = [env[n] for n in node.input]
+        a = _attrs(node)
+        if op == "Identity":
+            y = x[0]
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            fn = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                  "Div": np.divide, "Pow": np.power}[op]
+            if op == "Div" and np.issubdtype(x[0].dtype, np.integer):
+                y = x[0] // x[1]
+            else:
+                y = fn(x[0], x[1])
+        elif op == "Max":
+            y = np.maximum(x[0], x[1])
+        elif op == "Min":
+            y = np.minimum(x[0], x[1])
+        elif op == "Mod":
+            y = np.fmod(x[0], x[1]) if a.get("fmod") else np.mod(x[0], x[1])
+        elif op in ("Exp", "Log", "Tanh", "Sqrt", "Abs", "Neg", "Sign",
+                    "Floor", "Ceil", "Sin", "Cos", "Tan", "Asin", "Acos",
+                    "Atan", "Sinh", "Cosh", "Reciprocal", "Not"):
+            fn = {"Exp": np.exp, "Log": np.log, "Tanh": np.tanh,
+                  "Sqrt": np.sqrt, "Abs": np.abs, "Neg": np.negative,
+                  "Sign": np.sign, "Floor": np.floor, "Ceil": np.ceil,
+                  "Sin": np.sin, "Cos": np.cos, "Tan": np.tan,
+                  "Asin": np.arcsin, "Acos": np.arccos, "Atan": np.arctan,
+                  "Sinh": np.sinh, "Cosh": np.cosh,
+                  "Reciprocal": np.reciprocal,
+                  "Not": np.logical_not}[op]
+            y = fn(x[0])
+        elif op == "Round":
+            y = np.round(x[0])  # banker's rounding, matches ONNX
+        elif op == "Erf":
+            from math import erf
+            y = np.vectorize(erf, otypes=[x[0].dtype])(x[0])
+        elif op == "Sigmoid":
+            y = 1.0 / (1.0 + np.exp(-x[0].astype(np.float64)))
+            y = y.astype(x[0].dtype)
+        elif op in ("And", "Or", "Xor"):
+            fn = {"And": np.logical_and, "Or": np.logical_or,
+                  "Xor": np.logical_xor}[op]
+            y = fn(x[0], x[1])
+        elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                    "GreaterOrEqual"):
+            fn = {"Equal": np.equal, "Less": np.less,
+                  "LessOrEqual": np.less_equal, "Greater": np.greater,
+                  "GreaterOrEqual": np.greater_equal}[op]
+            y = fn(x[0], x[1])
+        elif op == "Where":
+            y = np.where(x[0], x[1], x[2])
+        elif op == "Cast":
+            y = x[0].astype(_NP_DTYPE[a["to"]])
+        elif op == "Reshape":
+            y = x[0].reshape(tuple(int(s) for s in x[1]))
+        elif op == "Expand":
+            y = np.broadcast_to(x[0], tuple(int(s) for s in x[1]))
+        elif op == "Transpose":
+            y = np.transpose(x[0], a["perm"])
+        elif op == "Concat":
+            y = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1].tolist(), x[2].tolist(),
+                                         x[3].tolist(), x[4].tolist())
+            slc = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                e = None if (st < 0 and e < -x[0].shape[ax]) else e
+                slc[ax] = slice(s, e, st)
+            y = x[0][tuple(slc)]
+        elif op == "Pad":
+            pads = x[1].tolist()
+            n = len(pads) // 2
+            cval = x[2].item() if len(x) > 2 else 0
+            y = np.pad(x[0], [(pads[i], pads[i + n]) for i in range(n)],
+                       constant_values=cval)
+        elif op == "ReduceSum":
+            axes = tuple(x[1].tolist()) if len(x) > 1 else None
+            y = np.sum(x[0], axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod}[op]
+            y = fn(x[0], axis=tuple(a["axes"]),
+                   keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ArgMax", "ArgMin"):
+            fn = np.argmax if op == "ArgMax" else np.argmin
+            y = fn(x[0], axis=a["axis"]).astype(np.int64)
+            if a.get("keepdims", 1):
+                y = np.expand_dims(y, a["axis"])
+        elif op == "CumSum":
+            y = x[0]
+            ax = int(x[1])
+            if a.get("reverse"):
+                y = np.flip(np.cumsum(np.flip(y, ax), axis=ax), ax)
+            else:
+                y = np.cumsum(y, axis=ax)
+            y = y.astype(x[0].dtype)
+        elif op == "Einsum":
+            y = np.einsum(a["equation"], *x)
+        elif op == "Gather":
+            y = np.take(x[0], x[1].astype(np.int64), axis=a.get("axis", 0))
+        elif op == "MaxPool":
+            neg = np.finfo(x[0].dtype).min \
+                if np.issubdtype(x[0].dtype, np.floating) \
+                else np.iinfo(x[0].dtype).min
+            win, nsp = _pool_patches(x[0], a["kernel_shape"], a["strides"],
+                                     a.get("pads", [0] * 2 * len(
+                                         a["kernel_shape"])),
+                                     pad_value=neg)  # ONNX pads with -inf
+            y = win.max(axis=tuple(range(-nsp, 0)))
+        elif op == "AveragePool":
+            win, nsp = _pool_patches(x[0], a["kernel_shape"], a["strides"],
+                                     a.get("pads", [0] * 2 * len(
+                                         a["kernel_shape"])))
+            y = win.mean(axis=tuple(range(-nsp, 0))).astype(x[0].dtype)
+        elif op == "Conv":
+            y = _conv(x[0], x[1], a)
+        elif op == "IsInf":
+            y = np.isinf(x[0])
+        elif op == "IsNaN":
+            y = np.isnan(x[0])
+        else:
+            raise NotImplementedError(f"reference runtime: op {op}")
+        for out_name in node.output:
+            env[out_name] = np.asarray(y)
+
+    return [env[vi.name] for vi in graph.output]

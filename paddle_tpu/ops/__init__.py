@@ -19,9 +19,10 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .random import (  # noqa: F401
-    bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
-    randn, randperm, seed, standard_normal, uniform, get_rng_state,
-    set_rng_state, shuffle,
+    bernoulli, bernoulli_, exponential_, multinomial, normal, normal_,
+    poisson, rand, randint, randint_like, randn, randperm, seed,
+    standard_normal, uniform, uniform_, get_rng_state, set_rng_state,
+    shuffle,
 )
 from .einsum import einsum  # noqa: F401
 
@@ -120,6 +121,7 @@ _METHODS = [
     "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "split", "chunk", "unbind",
     "tile", "expand", "expand_as", "broadcast_to", "flip", "roll", "rot90",
     "gather", "gather_nd", "take", "take_along_axis", "put_along_axis",
+    "reverse",
     "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
     "index_add", "index_fill", "masked_select", "masked_fill", "unique", "pad",
     "repeat_interleave", "as_complex", "as_real", "cast", "view", "view_as",
@@ -151,6 +153,8 @@ _INPLACE_ALIASES = {
     "abs_": math_mod.abs, "tanh_": math_mod.tanh, "reciprocal_": math_mod.reciprocal,
     "neg_": math_mod.neg, "cast_": manipulation.cast,
     "flatten_": manipulation.flatten, "transpose_": manipulation.transpose,
+    "lerp_": math_mod.lerp, "erfinv_": math_mod.erfinv,
+    "put_along_axis_": manipulation.put_along_axis,
     "fill_diagonal_": None,  # handled separately below
 }
 

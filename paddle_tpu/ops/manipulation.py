@@ -175,6 +175,11 @@ def flip(x, axis, name=None):
     return apply("flip", lambda v: jnp.flip(v, axis=tuple(axes)), _t(x))
 
 
+def reverse(x, axis, name=None):
+    """Alias of flip (reference: python/paddle/fluid/layers/nn.py reverse)."""
+    return flip(x, axis, name=name)
+
+
 def roll(x, shifts, axis=None, name=None):
     return apply("roll", lambda v: jnp.roll(v, shifts, axis=axis), _t(x))
 

@@ -119,6 +119,7 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     out = uniform(x.shape, x.dtype, min, max, seed)
     x._value = out._value
+    x._version += 1
     return x
 
 
@@ -137,6 +138,25 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 def normal_(x, mean=0.0, std=1.0, name=None):
     key = next_key()
     x._value = (jax.random.normal(key, tuple(x.shape), x._value.dtype) * std + mean)
+    x._version += 1
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential(λ) fill (reference: paddle.Tensor.exponential_,
+    python/paddle/tensor/random.py)."""
+    key = next_key()
+    x._value = jax.random.exponential(
+        key, tuple(x.shape), x._value.dtype) / lam
+    x._version += 1
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = next_key()
+    x._value = jax.random.bernoulli(
+        key, p, tuple(x.shape)).astype(x._value.dtype)
+    x._version += 1
     return x
 
 

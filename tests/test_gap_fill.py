@@ -288,3 +288,85 @@ class TestReviewRegressions:
         tids = {name: tid for tid, name, *_ in evs}
         assert tids["native_ev"] == tids["python_ev"] == \
             threading.get_native_id()
+
+
+class TestR2ApiShims:
+    """Round-2 surface fills: places, flops, batch, in-place long tail."""
+
+    def test_place_shims(self):
+        assert paddle.CUDAPlace(0).device_type == "tpu"
+        assert paddle.CUDAPinnedPlace().device_type == "cpu"
+        assert paddle.XPUPlace(0) == paddle.CUDAPlace(0)
+        assert not paddle.is_compiled_with_rocm()
+        assert not paddle.is_compiled_with_xpu()
+        assert paddle.is_compiled_with_cinn()
+        assert paddle.get_cudnn_version() is None
+
+    def test_batch_decorator(self):
+        r = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3]
+
+    def test_create_parameter(self):
+        p = paddle.create_parameter([4, 8], "float32")
+        assert isinstance(p, paddle.Parameter) and list(p.shape) == [4, 8]
+        b = paddle.create_parameter([8], "float32", is_bias=True)
+        np.testing.assert_array_equal(b.numpy(), np.zeros(8, np.float32))
+        # Initializer instances are applied via the standard protocol and
+        # draw from the framework RNG (reproducible under paddle.seed)
+        from paddle_tpu.nn import initializer as I
+
+        paddle.seed(7)
+        p1 = paddle.create_parameter([4, 8], "float32",
+                                     default_initializer=I.XavierUniform())
+        paddle.seed(7)
+        p2 = paddle.create_parameter([4, 8], "float32",
+                                     default_initializer=I.XavierUniform())
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+        assert float(np.abs(p1.numpy()).sum()) > 0
+
+    def test_flops_counts_conv_and_linear(self):
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                            nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+        f = paddle.flops(net, [1, 3, 8, 8])
+        # conv: 8*8*8 out elems * (3*3*3+1); linear: 10 * (512+1); relu: 512
+        assert f == 8 * 8 * 8 * 28 + 10 * 513 + 512
+
+    def test_inplace_long_tail(self):
+        t = paddle.zeros([4])
+        t.lerp_(paddle.ones([4]), 0.5)
+        np.testing.assert_allclose(t.numpy(), 0.5)
+        assert t._version >= 1
+        u = paddle.zeros([16])
+        u.uniform_()
+        assert u._version == 1 and float(np.abs(u.numpy()).sum()) > 0
+        e = paddle.zeros([16])
+        e.exponential_()
+        assert float(e.numpy().min()) >= 0
+        x = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+        x.erfinv_()
+        np.testing.assert_allclose(x.numpy()[0], 0.47693628, rtol=1e-4)
+
+    def test_reverse_matches_flip(self):
+        x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+        np.testing.assert_array_equal(paddle.reverse(x, [1]).numpy(),
+                                      x.numpy()[:, ::-1])
+        np.testing.assert_array_equal(x.reverse([0]).numpy(),
+                                      x.numpy()[::-1])
+
+    def test_put_along_axis_inplace(self):
+        x = paddle.zeros([2, 3])
+        idx = paddle.to_tensor(np.array([[0], [2]], np.int64))
+        x.put_along_axis_(idx, paddle.ones([2, 1]), 1)
+        expect = np.zeros((2, 3), np.float32)
+        expect[0, 0] = 1
+        expect[1, 2] = 1
+        np.testing.assert_array_equal(x.numpy(), expect)
+
+    def test_top_level_tanh_(self):
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        paddle.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh(0.5), rtol=1e-6)
