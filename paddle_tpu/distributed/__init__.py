@@ -28,6 +28,7 @@ from .moe import ExpertMLP, MoELayer  # noqa: F401
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
                        SharedLayerDesc, gpipe_spmd)
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .heter import ProcessGroupHeter  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from ..kernels.ring_attention import ring_attention  # noqa: F401
 from ..kernels.ulysses_attention import ulysses_attention  # noqa: F401

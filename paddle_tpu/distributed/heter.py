@@ -1,0 +1,143 @@
+"""Heterogeneous / cross-cluster collectives (reference:
+paddle/fluid/distributed/collective/ProcessGroupHeter.h:64 — NCCL inside a
+cluster + Gloo between clusters, used for GPU<->NPU/CPU mixed jobs).
+
+TPU-native design: the intra-cluster layer is whatever the normal
+collective path provides (XLA collectives over ICI inside a slice, or the
+eager cross-process mesh); the INTER-cluster layer rides the host network
+(DCN) through the TCPStore rendezvous, exactly where the reference places
+Gloo.  Each cluster elects rank 0 as its gateway: gateways all-reduce the
+cluster-partial via the store, then re-broadcast locally — the reference's
+hierarchical scheme (ProcessGroupHeter::AllReduce) with the store playing
+Gloo's role.
+
+The store protocol is round-versioned so repeated collectives reuse keys
+without clearing the store.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .collective import ReduceOp, all_reduce, broadcast
+
+
+class ProcessGroupHeter:
+    """Hierarchical collective group spanning clusters.
+
+    Args:
+        store: TCPStore shared by ALL clusters (rendezvous over DCN).
+        cluster_id: index of this process's cluster.
+        n_clusters: number of clusters in the job.
+        local_group: optional intra-cluster group (``new_group(...)``)
+            passed to the inner all_reduce/broadcast.
+        local_rank: this process's rank inside its cluster (rank 0 is the
+            cluster gateway that talks to the store).
+        gid: group id for bookkeeping.
+    """
+
+    def __init__(self, store, cluster_id: int, n_clusters: int,
+                 local_group=None, local_rank: int = 0,
+                 local_world_size: int = 1, gid: int = 0):
+        self.store = store
+        self.cluster_id = int(cluster_id)
+        self.n_clusters = int(n_clusters)
+        self.local_group = local_group
+        self.local_rank = int(local_rank)
+        self.local_world_size = max(1, int(local_world_size))
+        self.id = gid
+        self._round = 0
+
+    # -- helpers --
+    def _key(self, op_name: str, cluster: int) -> str:
+        return f"heter/{self.id}/{self._round}/{op_name}/{cluster}"
+
+    def _exchange(self, op_name: str, payload: np.ndarray) -> list:
+        """Gateway (local rank 0) publishes this cluster's array; every
+        rank may fetch all peers' arrays."""
+        if self.local_rank == 0:
+            self.store.set(self._key(op_name, self.cluster_id),
+                           payload.tobytes())
+        outs = []
+        for c in range(self.n_clusters):
+            raw = self.store.get(self._key(op_name, c), wait=True)
+            outs.append(np.frombuffer(raw, dtype=payload.dtype)
+                        .reshape(payload.shape))
+        return outs
+
+    # -- collectives --
+    def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM):
+        """Intra-cluster all_reduce, inter-cluster combine, local rebcast."""
+        # AVG must weight clusters by rank count: reduce local SUMs and
+        # divide by the global rank total at the end
+        local_op = ReduceOp.SUM if op == ReduceOp.AVG else op
+        all_reduce(tensor, op=local_op, group=self.local_group)
+        self._round += 1
+        if self.n_clusters <= 1:
+            if op == ReduceOp.AVG:
+                tensor.set_value(np.asarray(tensor.numpy())
+                                 / self.local_world_size)
+            return tensor
+        if self.local_rank == 0:
+            partial = np.asarray(tensor.numpy())
+            parts = self._exchange("allreduce", partial)
+            if op in (ReduceOp.SUM, ReduceOp.AVG):
+                total = np.sum(parts, axis=0)
+                if op == ReduceOp.AVG:
+                    counts = self._exchange(
+                        "allreduce_count",
+                        np.asarray([self.local_world_size], np.int64))
+                    total = total / int(np.sum(counts))
+            elif op == ReduceOp.MAX:
+                total = np.max(parts, axis=0)
+            elif op == ReduceOp.MIN:
+                total = np.min(parts, axis=0)
+            elif op == ReduceOp.PROD:
+                total = np.prod(parts, axis=0)
+            else:
+                raise ValueError(f"unsupported op {op}")
+            tensor.set_value(total.astype(partial.dtype))
+        # gateway result reaches the cluster's other ranks
+        broadcast(tensor, src=0, group=self.local_group)
+        return tensor
+
+    def all_gather(self, tensor: Tensor):
+        """Returns a list of per-cluster tensors (gateway view)."""
+        self._round += 1
+        payload = np.asarray(tensor.numpy())
+        parts = self._exchange("allgather", payload)
+        return [Tensor(p) for p in parts]
+
+    def broadcast(self, tensor: Tensor, src_cluster: int = 0):
+        self._round += 1
+        if self.local_rank == 0:
+            if self.cluster_id == src_cluster:
+                self.store.set(self._key("bcast", src_cluster),
+                               np.asarray(tensor.numpy()).tobytes())
+            raw = self.store.get(self._key("bcast", src_cluster), wait=True)
+            val = np.frombuffer(raw, dtype=np.asarray(
+                tensor.numpy()).dtype).reshape(tensor.shape)
+            tensor.set_value(val)
+        broadcast(tensor, src=0, group=self.local_group)
+        return tensor
+
+    def barrier(self):
+        """All clusters rendezvous: each GATEWAY increments once; every
+        rank polls until all clusters have arrived."""
+        self._round += 1
+        key = f"heter/{self.id}/{self._round}/barrier"
+        if self.local_rank == 0:
+            self.store.add(key, 1)
+        import time
+
+        for _ in range(3000):
+            if self.store.add(key, 0) >= self.n_clusters:
+                return
+            time.sleep(0.01)
+        raise TimeoutError("heter barrier timed out")
+
+    def rank(self):
+        return self.cluster_id
+
+    def size(self):
+        return self.n_clusters

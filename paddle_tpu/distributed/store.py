@@ -82,6 +82,12 @@ class TCPStore:
         buf = ctypes.create_string_buffer(1 << 20)
         n = self._lib.tcp_store_get(self._client, key.encode(), buf,
                                     len(buf))
+        if n > len(buf):
+            # value larger than the probe buffer (tcp_store_get reports the
+            # full length and copies a prefix): refetch with the right size
+            buf = ctypes.create_string_buffer(int(n))
+            n = self._lib.tcp_store_get(self._client, key.encode(), buf,
+                                        len(buf))
         if n == -1:
             raise KeyError(key)
         if n < 0:

@@ -154,4 +154,207 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     return out
 
 
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal (upper-triangle-masked) softmax (reference:
+    python/paddle/incubate/operators/softmax_mask_fuse_upper_triangle.py
+    over fused_softmax_mask_upper_triangle_op.cu).  On TPU the mask+softmax
+    fuses in XLA; flash attention covers the attention hot path."""
+    def _fn(v):
+        t, s = v.shape[-2], v.shape[-1]
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        return jax.nn.softmax(jnp.where(mask, v, -1e30), axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", _fn, _t(x))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None, seed=None):
+    """One-hop neighbor sampling (reference:
+    python/paddle/incubate/operators/graph_sample_neighbors.py).  Returns
+    (out_neighbors, out_count[, out_eids]) — neighbors of each input node,
+    at most `sample_size` each, concatenated in input order."""
+    import numpy as np
+
+    rowv = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colv = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes
+                       ).reshape(-1)
+    eidv = None
+    if eids is not None:
+        eidv = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids)
+    rng = np.random.RandomState(seed) if seed is not None \
+        else np.random.RandomState()
+    out_n, out_c, out_e = [], [], []
+    for dst in nodes:
+        lo, hi = int(colv[int(dst)]), int(colv[int(dst) + 1])
+        idx = np.arange(lo, hi)
+        if 0 < sample_size < len(idx):
+            idx = idx[rng.choice(len(idx), size=sample_size, replace=False)]
+        out_n.extend(int(v) for v in rowv[idx])
+        out_c.append(len(idx))
+        if eidv is not None:
+            out_e.extend(int(v) for v in eidv[idx])
+        elif return_eids:
+            out_e.extend(int(v) for v in idx)
+    outs = (to_tensor(np.asarray(out_n, np.int64)),
+            to_tensor(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return outs + (to_tensor(np.asarray(out_e, np.int64)),)
+    return outs
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Compact-id reindexing of a sampled subgraph (reference:
+    python/paddle/incubate/operators/graph_reindex.py).  Input nodes keep
+    ids [0, len(x)); unseen neighbors get fresh ids in first-seen order.
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    import numpy as np
+
+    xs = np.asarray(x.numpy() if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors.numpy()
+                    if isinstance(neighbors, Tensor) else neighbors
+                    ).reshape(-1)
+    cnt = np.asarray(count.numpy() if isinstance(count, Tensor) else count
+                     ).reshape(-1)
+    index = {}
+    order = []
+    for n in xs.tolist():
+        if n not in index:
+            index[n] = len(order)
+            order.append(n)
+    src = []
+    for n in nb.tolist():
+        if n not in index:
+            index[n] = len(order)
+            order.append(n)
+        src.append(index[n])
+    dst = []
+    for i, c in enumerate(cnt.tolist()):
+        dst.extend([index[int(xs[i])]] * int(c))
+    return (to_tensor(np.asarray(src, np.int64)),
+            to_tensor(np.asarray(dst, np.int64)),
+            to_tensor(np.asarray(order, np.int64)))
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference:
+    python/paddle/incubate/optimizer/lookahead.py): every k steps the slow
+    weights move alpha of the way toward the fast weights, and the fast
+    weights are reset to the slow weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    def __getattr__(self, name):
+        if name.startswith("inner_optimizer") or name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self.inner_optimizer, name)
+
+    def _params(self):
+        return [p for p, _, _ in self.inner_optimizer._collect_params_grads()]
+
+    def _snapshot_slow(self):
+        # slow weights initialize to the CURRENT params (before the fast
+        # update), matching the reference's slow-param accumulator init
+        for p in self._params():
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._value.copy()
+
+    def _lookahead_update(self):
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self._params():
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            # the param gets a COPY: the inner optimizer's jitted update
+            # donates the param buffer, which would delete `slow` too
+            p._value = slow.copy()
+
+    def step(self):
+        self._snapshot_slow()
+        self.inner_optimizer.step()
+        self._lookahead_update()
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **kw):
+        # the reference's minimize also applies the lookahead blend
+        self._snapshot_slow()
+        out = self.inner_optimizer.minimize(loss, *a, **kw)
+        self._lookahead_update()
+        return out
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference:
+    python/paddle/incubate/optimizer/modelaverage.py): accumulates sums of
+    params per step; apply() swaps in the average, restore() swaps back.
+    The reference's windowed accumulators (min/max_average_window) bound
+    the window; average_window_rate scales it with steps taken."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._parameters = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._parameters}
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        window = max(self.min_average_window,
+                     min(self.max_average_window,
+                         int(self.average_window_rate * (self._num + 1))
+                         or 1))
+        if self._num >= window:
+            # restart the window (reference folds old sums; decaying
+            # restart keeps the average tracking recent weights)
+            for p in self._parameters:
+                self._sum[id(p)] = self._sum[id(p)] / self._num
+            self._num = 1
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._num += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._parameters}
+        for p in self._parameters:
+            if self._num:
+                p._value = (self._sum[id(p)] / self._num).astype(
+                    p._value.dtype)
+        from contextlib import contextmanager
+
+        @contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameters:
+                p._value = self._backup[id(p)]
+            self._backup = None
+
+
 from . import autotune  # noqa: E402,F401

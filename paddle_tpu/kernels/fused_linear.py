@@ -31,7 +31,10 @@ DEFAULT_BM, DEFAULT_BN, DEFAULT_BK = 256, 256, 512
 _ACTS = {
     "none": lambda z: z,
     "relu": jax.nn.relu,
-    "gelu": jax.nn.gelu,
+    # exact (erf) gelu: paddle's F.gelu default and the reference
+    # fused_gemm_epilogue's cublasLt GELU are both erf-based
+    "gelu": functools.partial(jax.nn.gelu, approximate=False),
+    "gelu_tanh": jax.nn.gelu,
     "silu": jax.nn.silu,
 }
 

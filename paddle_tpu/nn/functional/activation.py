@@ -25,7 +25,10 @@ def relu6(x, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+    # distinct op types so graph passes can tell the variants apart
+    # (fuse_linear_act only fuses the exact-erf form)
+    op = "gelu_tanh" if approximate else "gelu"
+    return apply(op, lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
 
 
 def sigmoid(x, name=None):

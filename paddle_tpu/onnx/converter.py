@@ -254,11 +254,12 @@ def _gather(g, eqn, ins):
 
 class Converter:
     def __init__(self, opset: int = 13):
-        if opset < 13:
+        if not 13 <= opset <= 17:
             raise NotImplementedError(
-                f"ONNX export emits opset-13 op forms (ReduceSum/Slice with "
-                f"tensor inputs); opset_version={opset} would produce an "
-                f"invalid model — pass >= 13")
+                f"ONNX export emits opset 13-17 op forms (ReduceSum/Slice "
+                f"take tensor inputs; ReduceMax/Min/Prod still use the axes "
+                f"attribute, removed in opset 18); opset_version={opset} "
+                f"would produce an invalid model")
         self.pb = _pb.get()
         self.opset = opset
 

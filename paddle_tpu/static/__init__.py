@@ -31,6 +31,10 @@ from .graph import (  # noqa: F401
     save_inference_model,
     scope_guard,
 )
+from .passes import (  # noqa: F401
+    apply_build_strategy, apply_pass, get_pass, list_passes, register_pass,
+)
+from . import passes  # noqa: F401
 
 py_func = None  # not supported: host callbacks break XLA compilation
 

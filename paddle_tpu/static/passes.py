@@ -1,0 +1,189 @@
+"""Program-rewrite pass framework (reference:
+paddle/fluid/framework/ir/pass.h:53 Pass/PassRegistry, REGISTER_PASS:317,
+and the fusion passes under paddle/fluid/framework/ir/ — conv_bn_fuse,
+fc_fuse, etc.).
+
+TPU-native stance: XLA already performs elementwise/matmul fusion, so the
+pass framework's job here is the part XLA can't do — substituting op
+PATTERNS with hand-written Pallas kernels (the reference analog is its
+fusion passes swapping subgraphs for fused CUDA ops), plus generic
+cleanups (dead-op elimination).  Passes operate on the recorded Program
+(static/graph.py), the ProgramDesc analog.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """REGISTER_PASS analog: @register_pass("fuse_linear_act")."""
+
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_pass(name: str) -> Callable:
+    if name not in _REGISTRY:
+        raise KeyError(f"no pass named {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_passes() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def apply_pass(program, name: str, **kwargs) -> int:
+    """Apply one pass to every block; returns number of rewrites."""
+    fn = get_pass(name)
+    total = 0
+    for block in program.blocks:
+        total += fn(block, **kwargs) or 0
+    if total:
+        program._version += 1
+    return total
+
+
+def apply_build_strategy(program, passes=("fuse_linear_act",
+                                          "eliminate_dead_ops"),
+                         keep=()) -> int:
+    """BuildStrategy-style bundle.  ``keep`` names the program's fetch
+    targets; without it, eliminate_dead_ops cannot tell a fetch-producing
+    terminal op from dead code, so that pass is skipped."""
+    total = 0
+    for p in passes:
+        if p == "eliminate_dead_ops":
+            if keep:
+                total += apply_pass(program, p, keep=keep)
+            continue
+        total += apply_pass(program, p)
+    return total
+
+
+# --------------------------------------------------------------------------
+# analysis helpers
+# --------------------------------------------------------------------------
+
+def _consumers(block):
+    """var name -> list of (op, input_index) reading it."""
+    out = {}
+    for op in block.ops:
+        for i, (kind, ref) in enumerate(op.inputs):
+            if kind == "var":
+                out.setdefault(ref.name, []).append((op, i))
+    return out
+
+
+def _producer(block):
+    """var name -> op producing it."""
+    out = {}
+    for op in block.ops:
+        for o in op.outputs:
+            out[o.name] = op
+    return out
+
+
+# --------------------------------------------------------------------------
+# fuse_linear_act: linear -> {gelu,relu,silu} ==> one fused_linear op
+# --------------------------------------------------------------------------
+
+_ACT_OPS = {"gelu": "gelu", "relu": "relu", "silu": "silu", "swish": "silu"}
+
+
+def _fused_linear_fn(x, w, b, *, activation):
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from ..kernels.fused_linear import fused_linear
+
+        return fused_linear(x, w, b, activation=activation)
+    # off-TPU the Pallas interpreter would be slow; same math via XLA
+    z = x @ w
+    if b is not None:
+        z = z + b
+    fn = {"gelu": lambda v: jax.nn.gelu(v, approximate=False),
+          "relu": jax.nn.relu, "silu": jax.nn.silu}[activation]
+    return fn(z).astype(x.dtype)
+
+
+@register_pass("fuse_linear_act")
+def fuse_linear_act(block) -> int:
+    """Fuse `linear` + single-consumer activation into one op whose TPU
+    lowering is the Pallas matmul-epilogue kernel (kernels/fused_linear.py).
+    Reference analog: fc_fuse_pass + fused_gemm_epilogue."""
+    from .graph import OpDesc
+
+    consumers = _consumers(block)
+    rewrites = 0
+    new_ops = []
+    skip = set()
+    for idx, op in enumerate(block.ops):
+        if id(op) in skip:
+            continue
+        fused = None
+        if op.type == "linear" and not op.writeback and op.single:
+            out_name = op.outputs[0].name
+            users = consumers.get(out_name, [])
+            if len(users) == 1:
+                act_op, _ = users[0]
+                if act_op.type in _ACT_OPS and not act_op.writeback and \
+                        act_op.single and len(act_op.inputs) == 1:
+                    fused = (op, act_op, _ACT_OPS[act_op.type])
+        if fused is None:
+            new_ops.append(op)
+            continue
+        lin, act_op, act_name = fused
+        skip.add(id(act_op))
+        import functools
+
+        new_op = OpDesc(
+            type="fused_linear",
+            fn=functools.partial(_fused_linear_fn, activation=act_name),
+            attrs={},
+            inputs=list(lin.inputs),
+            treedef=None,  # flat convention: fn(x, w, b)
+            outputs=list(act_op.outputs),
+            single=True,
+        )
+        new_ops.append(new_op)
+        rewrites += 1
+    if rewrites:
+        # drop the skipped activation ops (they were folded)
+        block.ops[:] = [op for op in new_ops]
+    return rewrites
+
+
+# --------------------------------------------------------------------------
+# eliminate_dead_ops: remove ops no one reads (memory_optimize analog)
+# --------------------------------------------------------------------------
+
+@register_pass("eliminate_dead_ops")
+def eliminate_dead_ops(block, keep=()) -> int:
+    """Drop ops whose outputs are never consumed, not persistable, not
+    written back, and not in `keep` (fetch targets).  Runs to fixpoint."""
+    keep = set(keep)
+    removed_total = 0
+    while True:
+        consumers = _consumers(block)
+        removed = 0
+        kept_ops = []
+        for op in block.ops:
+            dead = (
+                not op.writeback
+                and op.type not in ("backward", "cond", "while")
+                and all(o.name not in keep
+                        and not getattr(o, "persistable", False)
+                        and not consumers.get(o.name)
+                        for o in op.outputs))
+            if dead:
+                removed += 1
+            else:
+                kept_ops.append(op)
+        block.ops[:] = kept_ops
+        removed_total += removed
+        if not removed:
+            return removed_total

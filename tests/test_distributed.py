@@ -1021,3 +1021,100 @@ class TestGradientMergeEdgeCases:
         finally:
             meshmod._GLOBAL_MESH = None
             meshmod._GLOBAL_HCG = None
+
+
+class TestProcessGroupHeter:
+    """Cross-cluster hierarchical collectives (reference:
+    ProcessGroupHeter.h:64 — NCCL intra + Gloo inter).  Two single-rank
+    'clusters' in one process share a TCPStore: the inter-cluster layer is
+    fully exercised; the intra layer is the world-1 identity."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_mesh(self):
+        # the intra-cluster layer consults the global mesh; a mesh left
+        # behind by another test must not leak into these world-1 runs
+        meshmod._GLOBAL_MESH = None
+        meshmod._GLOBAL_HCG = None
+        yield
+        meshmod._GLOBAL_MESH = None
+        meshmod._GLOBAL_HCG = None
+
+    def _store(self):
+        import socket
+
+        from paddle_tpu.distributed.store import TCPStore
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        return TCPStore("127.0.0.1", port, is_master=True)
+
+    def _run_clusters(self, fns):
+        """Run one callable per 'cluster' concurrently (each gateway blocks
+        in store.get until its peers publish, so they need threads)."""
+        import threading
+
+        errs = []
+
+        def wrap(fn):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+
+    def test_cross_cluster_all_reduce(self):
+        from paddle_tpu.distributed.heter import ProcessGroupHeter
+
+        store = self._store()
+        g0 = ProcessGroupHeter(store, cluster_id=0, n_clusters=2)
+        g1 = ProcessGroupHeter(store, cluster_id=1, n_clusters=2)
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+        self._run_clusters([lambda: g0.all_reduce(a),
+                            lambda: g1.all_reduce(b)])
+        np.testing.assert_allclose(a.numpy(), [11.0, 22.0])
+        np.testing.assert_allclose(b.numpy(), [11.0, 22.0])
+        assert g0.size() == 2 and g1.rank() == 1
+
+    def test_cross_cluster_max_and_gather(self):
+        from paddle_tpu.distributed.heter import ProcessGroupHeter
+        from paddle_tpu.distributed.collective import ReduceOp
+
+        store = self._store()
+        g0 = ProcessGroupHeter(store, cluster_id=0, n_clusters=2, gid=1)
+        g1 = ProcessGroupHeter(store, cluster_id=1, n_clusters=2, gid=1)
+        a = paddle.to_tensor(np.array([5.0, -1.0], np.float32))
+        b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        self._run_clusters([lambda: g0.all_reduce(a, op=ReduceOp.MAX),
+                            lambda: g1.all_reduce(b, op=ReduceOp.MAX)])
+        np.testing.assert_allclose(a.numpy(), [5.0, 4.0])
+        np.testing.assert_allclose(b.numpy(), [5.0, 4.0])
+        parts = [None, None]
+
+        def gather(i, g, v):
+            parts[i] = g.all_gather(paddle.to_tensor(
+                np.array([v], np.float32)))
+
+        self._run_clusters([lambda: gather(0, g0, 1.0),
+                            lambda: gather(1, g1, 2.0)])
+        assert [float(p.numpy()[0]) for p in parts[0]] == [1.0, 2.0]
+        assert [float(p.numpy()[0]) for p in parts[1]] == [1.0, 2.0]
+
+    def test_cross_cluster_broadcast(self):
+        from paddle_tpu.distributed.heter import ProcessGroupHeter
+
+        store = self._store()
+        g0 = ProcessGroupHeter(store, cluster_id=0, n_clusters=2, gid=2)
+        g1 = ProcessGroupHeter(store, cluster_id=1, n_clusters=2, gid=2)
+        src = paddle.to_tensor(np.array([7.0, 8.0], np.float32))
+        dst = paddle.to_tensor(np.array([0.0, 0.0], np.float32))
+        g0.broadcast(src, src_cluster=0)
+        g1.broadcast(dst, src_cluster=0)
+        np.testing.assert_allclose(dst.numpy(), [7.0, 8.0])

@@ -370,3 +370,99 @@ class TestR2ApiShims:
         x = paddle.to_tensor(np.array([0.5], np.float32))
         paddle.tanh_(x)
         np.testing.assert_allclose(x.numpy(), np.tanh(0.5), rtol=1e-6)
+
+
+class TestIncubateR2:
+    """Round-2 incubate fills (reference: python/paddle/incubate/__init__.py
+    __all__): graph_sample_neighbors/reindex, fused causal softmax,
+    LookAhead, ModelAverage."""
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        import paddle_tpu.incubate as inc
+
+        x = paddle.to_tensor(np.random.randn(2, 3, 4, 4).astype(np.float32))
+        o = inc.softmax_mask_fuse_upper_triangle(x).numpy()
+        assert np.allclose(o[..., 0, 1:], 0)
+        np.testing.assert_allclose(o.sum(-1), 1.0, rtol=1e-5)
+        # row i attends to columns <= i with plain softmax weights
+        ref = np.exp(x.numpy()[0, 0, 2, :3])
+        ref = ref / ref.sum()
+        np.testing.assert_allclose(o[0, 0, 2, :3], ref, rtol=1e-5)
+
+    def test_graph_sample_neighbors_and_reindex(self):
+        import paddle_tpu.incubate as inc
+
+        colptr = np.array([0, 2, 4, 5], np.int64)
+        row = np.array([1, 2, 0, 2, 0], np.int64)
+        nb, cnt = inc.graph_sample_neighbors(row, colptr, np.array([0, 1]),
+                                             sample_size=-1)
+        assert cnt.numpy().tolist() == [2, 2]
+        assert nb.numpy().tolist() == [1, 2, 0, 2]
+        nb2, cnt2, eids = inc.graph_sample_neighbors(
+            row, colptr, np.array([2]), sample_size=1, return_eids=True,
+            seed=0)
+        assert cnt2.numpy().tolist() == [1] and eids.numpy().tolist() == [4]
+        src, dst, nodes = inc.graph_reindex(np.array([0, 1]), nb, cnt)
+        assert nodes.numpy().tolist() == [0, 1, 2]
+        assert dst.numpy().tolist() == [0, 0, 1, 1]
+        assert src.numpy().tolist() == [1, 2, 0, 2]
+
+    def test_lookahead_slow_weights(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+        from paddle_tpu.optimizer import SGD
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        ref = nn.Linear(4, 4)
+        ref.set_state_dict(net.state_dict())
+        w_init = net.weight.numpy().copy()
+        opt = inc.LookAhead(SGD(0.1, parameters=net.parameters()),
+                            alpha=0.5, k=2)
+        ref_opt = SGD(0.1, parameters=ref.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for i in range(2):
+            net(x).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            ref(x).sum().backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+        # after k=2 fast steps: w = w_init + alpha * (fast - w_init)
+        expect = w_init + 0.5 * (ref.weight.numpy() - w_init)
+        np.testing.assert_allclose(net.weight.numpy(), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_model_average_apply_restore(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+
+        net = nn.Linear(3, 3)
+        ma = inc.ModelAverage(1.0, parameters=net.parameters(),
+                              min_average_window=1, max_average_window=100)
+        w0 = net.weight.numpy().copy()
+        ma.step()
+        net.weight.set_value(paddle.to_tensor(w0 + 1.0))
+        ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(net.weight.numpy(), w0 + 0.5,
+                                       rtol=1e-6)
+        np.testing.assert_allclose(net.weight.numpy(), w0 + 1.0, rtol=1e-6)
+
+    def test_lookahead_minimize_applies_blend(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+        from paddle_tpu.optimizer import SGD
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        w_init = net.weight.numpy().copy()
+        opt = inc.LookAhead(SGD(0.1, parameters=net.parameters()),
+                            alpha=0.5, k=1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = net(x).sum()
+        opt.minimize(loss)  # minimize runs backward + step itself
+        opt.clear_grad()
+        # k=1: every minimize blends halfway between init and fast weights
+        assert opt._steps == 1 and opt._slow
+        assert not np.allclose(net.weight.numpy(), w_init)

@@ -320,3 +320,108 @@ class TestStaticInferenceModel:
         xv = np.random.rand(8, 4, 2, 2).astype(np.float32)
         (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
         assert np.isfinite(o)
+
+
+class TestPasses:
+    """Program-rewrite pass framework (reference: ir/pass.h Pass/
+    PassRegistry + fusion passes): pattern-match -> Pallas-kernel
+    substitution and dead-op elimination on the recorded Program."""
+
+    def test_fuse_linear_act_rewrites_and_matches(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 16], "float32")
+                lin = nn.Linear(16, 32)
+                out = F.gelu(lin(x))
+            exe = static.Executor()
+            exe.run(startup)
+            xv = np.random.randn(4, 16).astype(np.float32)
+            ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+
+            n = static.apply_pass(main, "fuse_linear_act")
+            assert n == 1
+            types = [op.type for op in main.current_block().ops]
+            assert "fused_linear" in types
+            assert "gelu" not in types and "linear" not in types
+            got = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_fuse_skips_multi_consumer(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 8], "float32")
+                lin = nn.Linear(8, 8)
+                h = lin(x)
+                a = F.gelu(h)
+                b = h * 2.0  # second consumer: fusing would orphan this
+            assert static.apply_pass(main, "fuse_linear_act") == 0
+        finally:
+            paddle.disable_static()
+
+    def test_eliminate_dead_ops(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 4], "float32")
+                live = paddle.tanh(x)
+                dead = paddle.exp(x)          # never consumed
+                dead2 = paddle.sqrt(dead)     # consumer of dead only
+            n_before = len(main.current_block().ops)
+            removed = static.apply_pass(main, "eliminate_dead_ops",
+                                        keep=[live.name])
+            assert removed == 2
+            assert len(main.current_block().ops) == n_before - 2
+            exe = static.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                          fetch_list=[live])[0]
+            np.testing.assert_allclose(out, np.tanh(np.ones((2, 4))),
+                                       rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+    def test_registry(self):
+        from paddle_tpu import static
+
+        assert "fuse_linear_act" in static.list_passes()
+        with pytest.raises(KeyError):
+            static.get_pass("nonexistent_pass")
+
+    def test_build_strategy_preserves_outputs(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 4], "float32")
+                out = paddle.tanh(x)
+            # without keep: dead-op elimination skipped, program intact
+            static.apply_build_strategy(main)
+            assert len(main.current_block().ops) == 1
+            # with keep: output op survives by name
+            static.apply_build_strategy(main, keep=[out.name])
+            assert len(main.current_block().ops) == 1
+        finally:
+            paddle.disable_static()
