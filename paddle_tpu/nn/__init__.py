@@ -44,6 +44,13 @@ from .layer.rnn import (  # noqa: F401
     SimpleRNNCell,
 )
 
+from .layer.common import Softmax2D, PairwiseDistance  # noqa: F401
+from .layer.pooling import MaxUnPool1D, MaxUnPool2D, MaxUnPool3D  # noqa: F401
+from .layer.loss import HSigmoidLoss  # noqa: F401
+from .layer.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .utils import spectral_norm  # noqa: F401
+from .layer import loss  # noqa: F401  (paddle.nn.loss submodule)
+
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 )

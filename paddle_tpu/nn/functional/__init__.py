@@ -6,3 +6,9 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .extras import (  # noqa: F401
+    affine_grid, class_center_sample, diag_embed, dice_loss, gather_tree,
+    hsigmoid_loss, margin_cross_entropy, max_unpool1d, max_unpool2d,
+    max_unpool3d, npair_loss, sparse_attention, tanh_, temporal_shift,
+    zeropad2d,
+)

@@ -1,0 +1,435 @@
+"""Long-tail functional ops (reference: python/paddle/nn/functional/
+vision.py, loss.py, extension.py — affine_grid, temporal_shift,
+max_unpool, dice/npair losses, hsigmoid, margin softmax, gather_tree,
+sparse_attention).  All are XLA lowerings; the reference implements each
+as a CUDA/CPU kernel pair.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# --------------------------------------------------------------------------
+# vision
+# --------------------------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from batched 2x3 affine matrices (reference:
+    nn/functional/vision.py affine_grid -> affine_grid op)."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(out_shape.numpy())]
+    N, _, H, W = [int(s) for s in out_shape]
+
+    def _fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        # [N, H, W, 2] = base @ theta^T per batch
+        return jnp.einsum("hwk,nck->nhwc", base, th.astype(jnp.float32)
+                          ).astype(th.dtype)
+
+    return apply("affine_grid", _fn, _t(theta))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal channel shift (reference: nn/functional/extension.py
+    temporal_shift -> temporal_shift op): first `shift_ratio` of channels
+    reads the NEXT segment, the second reads the PREVIOUS, rest copies."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"bad data_format {data_format}")
+
+    def _fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        r = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate([r[:, 1:, :c1], jnp.zeros_like(r[:, :1, :c1])],
+                              axis=1)
+        bwd = jnp.concatenate([jnp.zeros_like(r[:, :1, c1:c2]),
+                               r[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, bwd, r[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply("temporal_shift", _fn, _t(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """[left, right, top, bottom] zero padding (reference: common.py
+    zeropad2d)."""
+    l, r, t, b = [int(p) for p in padding]
+
+    def _fn(v):
+        if data_format == "NCHW":
+            cfg = ((0, 0), (0, 0), (t, b), (l, r))
+        else:
+            cfg = ((0, 0), (t, b), (l, r), (0, 0))
+        return jnp.pad(v, cfg)
+
+    return apply("zeropad2d", _fn, _t(x))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference: tensor/creation.py
+    diag_embed op)."""
+
+    def _fn(v):
+        n = v.shape[-1] + abs(int(offset))
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        # diagonal planes currently in the last two axes; move them
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dst, src in order:
+            perm.insert(dst, src)
+        return jnp.transpose(out, perm)
+
+    return apply("diag_embed", _fn, _t(input))
+
+
+# --------------------------------------------------------------------------
+# max_unpool
+# --------------------------------------------------------------------------
+
+def _unpool_out_size(in_sp, kernel, stride, padding, output_size, n):
+    if output_size is not None:
+        sp = [int(s) for s in output_size]
+        return sp[-n:]
+    return [(in_sp[i] - 1) * stride[i] - 2 * padding[i] + kernel[i]
+            for i in range(n)]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference:
+    nn/functional/pooling.py max_unpool2d -> unpool op).  `indices` are
+    flat input-spatial positions as produced by our max_pool2d."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d: NCHW only")
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    p = _tuplize(padding, 2)
+
+    def _fn(v, idx):
+        N, C, H, W = v.shape
+        Ho, Wo = _unpool_out_size((H, W), k, s, p, output_size, 2)
+        flat = jnp.zeros((N, C, Ho * Wo), v.dtype)
+        vi = v.reshape(N, C, H * W)
+        ii = idx.reshape(N, C, H * W)
+        b = jnp.arange(N)[:, None, None]
+        c = jnp.arange(C)[None, :, None]
+        flat = flat.at[b, c, ii].set(vi)
+        return flat.reshape(N, C, Ho, Wo)
+
+    return apply("max_unpool2d", _fn, _t(x), _t(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d: NCL only")
+    xx = _t(x)
+    ii = _t(indices)
+    from ...ops.manipulation import unsqueeze, squeeze
+
+    k = _tuplize(kernel_size, 1)[0]
+    s = _tuplize(stride if stride is not None else kernel_size, 1)[0]
+    p = _tuplize(padding, 1)[0]
+    osz = [1, int(output_size[-1])] if output_size is not None else None
+    out = max_unpool2d(unsqueeze(xx, 2), unsqueeze(ii, 2), (1, k), (1, s),
+                       (0, p), output_size=osz)
+    return squeeze(out, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Indices are flat D*H*W positions (matching the 2d convention)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d: NCDHW only")
+    k = _tuplize(kernel_size, 3)
+    s = _tuplize(stride if stride is not None else kernel_size, 3)
+    p = _tuplize(padding, 3)
+
+    def _fn(v, idx):
+        N, C, D, H, W = v.shape
+        Do, Ho, Wo = _unpool_out_size((D, H, W), k, s, p, output_size, 3)
+        flat = jnp.zeros((N, C, Do * Ho * Wo), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1)
+        b = jnp.arange(N)[:, None, None]
+        c = jnp.arange(C)[None, :, None]
+        flat = flat.at[b, c, ii].set(vi)
+        return flat.reshape(N, C, Do, Ho, Wo)
+
+    return apply("max_unpool3d", _fn, _t(x), _t(indices))
+
+
+# --------------------------------------------------------------------------
+# losses
+# --------------------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|A.B| / (|A|+|B|) over the last dim's class probs (reference:
+    nn/functional/loss.py dice_loss)."""
+
+    def _fn(x, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y1, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", _fn, _t(input), _t(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (Sohn 2016) (reference: nn/functional/loss.py
+    npair_loss): cross-entropy over anchor-positive similarities + L2."""
+
+    def _fn(a, p, y):
+        a32 = a.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        reg = jnp.mean(jnp.sum(a32 * a32, -1)) + jnp.mean(
+            jnp.sum(p32 * p32, -1))
+        sim = a32 @ p32.T  # [B, B]
+        ymat = (y[:, None] == y[None, :]).astype(jnp.float32)
+        ymat = ymat / jnp.sum(ymat, -1, keepdims=True)
+        ce = jnp.mean(jnp.sum(
+            -ymat * jax.nn.log_softmax(sim, -1), axis=-1))
+        return ce + l2_reg * reg * 0.25
+
+    return apply("npair_loss", _fn, _t(anchor), _t(positive), _t(labels))
+
+
+def _default_huffman_paths(num_classes):
+    """Complete-binary-tree path tables (heap layout: internal nodes
+    0..num_classes-2, leaf for class c at heap id num_classes-1+c).
+    Returns (path_table, path_code) padded with -1, shape [C, D]."""
+    depth = max(1, math.ceil(math.log2(max(2, num_classes))))
+    table = -np.ones((num_classes, depth + 1), np.int64)
+    code = -np.ones((num_classes, depth + 1), np.int64)
+    for cls in range(num_classes):
+        node = num_classes - 1 + cls  # heap id of leaf
+        path = []
+        while node != 0:
+            parent = (node - 1) // 2
+            path.append((parent, node == 2 * parent + 2))
+            node = parent
+        for i, (nid, bit) in enumerate(reversed(path)):
+            table[cls, i] = nid
+            code[cls, i] = int(bit)
+    return table, code
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py
+    hsigmoid_loss -> hierarchical_sigmoid op).  Default tree = complete
+    binary tree over classes; custom trees via path_table/path_code
+    ([batch or C, D], -1-padded)."""
+    if path_table is None:
+        tbl, code = _default_huffman_paths(int(num_classes))
+        tbl_t, code_t = to_tensor(tbl), to_tensor(code)
+        per_class = True
+    else:
+        tbl_t, code_t = _t(path_table), _t(path_code)
+        per_class = False
+
+    args = [_t(input), _t(label), _t(weight), tbl_t, code_t]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_t(bias))
+
+    def _fn(x, y, w, tbl, code, *rest):
+        b = rest[0] if rest else None
+        if per_class:
+            tpath = tbl[y]       # [B, D]
+            tcode = code[y]
+        else:
+            tpath = tbl
+            tcode = code
+        mask = (tpath >= 0).astype(jnp.float32)
+        safe = jnp.maximum(tpath, 0)
+        wsel = w[safe]           # [B, D, F]
+        logits = jnp.einsum("bf,bdf->bd", x.astype(jnp.float32),
+                            wsel.astype(jnp.float32))
+        if b is not None:
+            logits = logits + b.reshape(-1)[safe]
+        # code bit 1 -> right child -> sigmoid(logit); bit 0 -> 1-sigmoid
+        sign = jnp.where(tcode > 0, 1.0, -1.0)
+        logp = jax.nn.log_sigmoid(sign * logits) * mask
+        return -jnp.sum(logp, axis=-1, keepdims=True)
+
+    return apply("hsigmoid_loss", _fn, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference: nn/functional/loss.py
+    margin_cross_entropy -> margin_cross_entropy op): target logit
+    cos(m1*theta + m2) - m3, all scaled by s.  `group` accepts a
+    model-parallel group for sharded classes; under GSPMD the sharded
+    matmul + softmax compile to the same collectives, so only the math
+    lives here."""
+
+    def _fn(lg, y):
+        lg32 = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(lg32)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y.reshape(-1), lg.shape[-1],
+                                dtype=jnp.float32)
+        adj = jnp.where(onehot > 0, target, lg32) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        return (loss, sm)
+
+    loss, sm = apply("margin_cross_entropy", _fn, _t(logits), _t(label))
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: positives plus random negatives (reference:
+    nn/functional/common.py class_center_sample op, PartialFC).  Host-side
+    sampling (eager; the result feeds a sharded lm-head matmul)."""
+    lab = np.asarray(_t(label).numpy()).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.choice(neg_pool, num_samples - len(pos),
+                                 replace=False)
+        sampled = np.concatenate([pos, extra])
+    sampled = np.sort(sampled)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return to_tensor(remap[lab]), to_tensor(sampled.astype(np.int64))
+
+
+# --------------------------------------------------------------------------
+# sequence / decoding
+# --------------------------------------------------------------------------
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference: nn/functional/extension.py
+    gather_tree -> gather_tree op): ids/parents [T, B, beam] -> full
+    sequences following parent pointers from the last step."""
+
+    def _fn(idv, par):
+        T = idv.shape[0]
+
+        def body(carry, t):
+            beam_idx = carry  # [B, beam] which source beam each final
+            step_ids = jnp.take_along_axis(idv[t], beam_idx, axis=-1)
+            next_idx = jnp.take_along_axis(par[t], beam_idx, axis=-1)
+            return next_idx, step_ids
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]),
+                                idv.shape[1:]).astype(par.dtype)
+        _, rev = jax.lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+        return rev[::-1]
+
+    return apply("gather_tree", _fn, _t(ids), _t(parents))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR sparsity pattern (reference:
+    nn/functional/sparse_attention.py -> sparse_attention CUDA op).
+
+    TPU-native: a CSR-driven *mask* over the dense flash path — XLA fuses
+    the mask; the pattern is static per compile, which is the same
+    contract as the reference (fixed CSR per layer)."""
+
+    def _fn(q, k, v, off, cols, *masks):
+        B, H, M, D = q.shape
+        N = k.shape[2]
+        nnz = cols.shape[-1]
+        j = jnp.arange(nnz)
+
+        def one_mask(o, c):
+            # row id of each nnz via searchsorted over the offset vector
+            rows = jnp.searchsorted(o, j, side="right") - 1
+            return jnp.zeros((M, N), bool).at[rows, c].set(True)
+
+        # per-(batch, head) CSR patterns
+        mask = jax.vmap(one_mask)(off.reshape(B * H, -1),
+                                  cols.reshape(B * H, -1)).reshape(B, H, M, N)
+        scores = jnp.einsum("bhmd,bhnd->bhmn", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(D)
+        scores = jnp.where(mask, scores, -1e30)
+        mi = 0
+        if key_padding_mask is not None:
+            kpm = masks[mi]
+            mi += 1
+            if kpm.dtype == jnp.bool_:
+                scores = jnp.where(kpm[:, None, None, :], scores, -1e30)
+            else:  # float mask: 0 keeps, nonzero-negative masks (additive)
+                scores = scores + kpm[:, None, None, :].astype(jnp.float32)
+        if attn_mask is not None:
+            am = masks[mi]
+            if am.dtype == jnp.bool_:
+                scores = jnp.where(am, scores, -1e30)
+            else:
+                scores = scores + am.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhmn,bhnd->bhmd", probs, v)
+
+    args = [_t(query), _t(key), _t(value), _t(sparse_csr_offset),
+            _t(sparse_csr_columns)]
+    if key_padding_mask is not None:
+        args.append(_t(key_padding_mask))
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    return apply("sparse_attention", _fn, *args)
+
+
+def tanh_(x, name=None):
+    """In-place tanh (parity alias; reference exports it from functional)."""
+    return x.tanh_()

@@ -124,6 +124,18 @@ def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    if return_mask and df == "NCW":
+        # indices come from the 2d path on an unsqueezed height dim; the
+        # flat h*W+w index collapses to the 1d position when h == 0
+        from ...ops.manipulation import squeeze, unsqueeze
+
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is not None else k
+        s = s if isinstance(s, int) else s[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        out, idx = _max_pool(unsqueeze(_t(x), 2), (1, k), (1, s), (0, p),
+                             ceil_mode, "NCHW", 2, True)
+        return squeeze(out, 2), squeeze(idx, 2)
     return _max_pool(x, kernel_size, stride, padding, ceil_mode, df, 1,
                      return_mask)
 

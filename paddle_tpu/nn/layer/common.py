@@ -261,3 +261,32 @@ class Fold(Layer):
 
 class Linear_(Linear):
     pass
+
+
+class Softmax2D(Layer):
+    """Channel softmax for NCHW inputs (reference: nn/layer/activation.py
+    Softmax2D — softmax over C for each spatial location)."""
+
+    def forward(self, x):
+        from ..functional.activation import softmax
+
+        if x.ndim != 4 and x.ndim != 3:
+            raise ValueError("Softmax2D expects 3D/4D input")
+        return softmax(x, axis=-3)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between x and y (reference: nn/layer/distance.py
+    PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops.linalg import norm
+
+        return norm(x - y + self.epsilon, p=self.p, axis=-1,
+                    keepdim=self.keepdim)

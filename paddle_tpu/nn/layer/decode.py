@@ -1,0 +1,127 @@
+"""Seq2seq decoding API (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder over an RNN cell + dynamic_decode driver; the static
+path compiles to a While op, the dygraph path is a host loop).
+
+TPU-native: the host loop is retained for eager use (the reference's
+dygraph behavior); steps are compiled by XLA per shape, and the final
+backtrace reuses functional.gather_tree.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor, to_tensor
+from ..functional.extras import gather_tree
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Beam search over a step cell (reference: nn/decode.py
+    BeamSearchDecoder: _expand_to_beam_size/tile_beam_merge_with_batch,
+    step -> topk over beam*vocab with parent pointers)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (repeat each batch row beam times)."""
+        def _fn(v):
+            return jnp.repeat(v, beam_size, axis=0)
+
+        return apply("tile_beam_merge_with_batch", _fn,
+                     x if isinstance(x, Tensor) else to_tensor(x))
+
+    # -- decoder protocol --
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda v: jnp.repeat(_val(v), self.beam_size, axis=0),
+            initial_cell_states)
+        some = jax.tree_util.tree_leaves(states)[0]
+        B = some.shape[0] // self.beam_size
+        ids = jnp.full((B, self.beam_size), self.start_token, jnp.int64)
+        # beam 0 active, others dead (-inf) so step 1 expands one beam
+        scores = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1)), (B, 1))
+        finished = jnp.zeros((B, self.beam_size), bool)
+        return ids, (states, scores, finished), finished
+
+    def step(self, time, inputs, states):
+        cell_states, scores, finished = states
+        B, beam = inputs.shape
+        flat_ids = inputs.reshape(B * beam)
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(Tensor(flat_ids))
+            emb = _val(emb)
+        else:
+            emb = flat_ids
+        cell_out, next_states = self.cell(Tensor(emb), cell_states)
+        out = _val(self.output_fn(cell_out) if self.output_fn else cell_out)
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        V = logp.shape[-1]
+        logp = logp.reshape(B, beam, V)
+        # a finished beam may only continue with end_token at zero cost,
+        # freezing its score (reference locks finished beams the same way)
+        end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], end_only, logp)
+        total = scores[..., None] + logp                  # [B, beam, V]
+        flat = total.reshape(B, beam * V)
+        top_scores, top_idx = jax.lax.top_k(flat, beam)   # [B, beam]
+        parents = (top_idx // V).astype(jnp.int64)
+        tokens = (top_idx % V).astype(jnp.int64)
+        # gather cell states along the chosen parent beams
+        b_idx = (jnp.arange(B)[:, None] * beam + parents).reshape(-1)
+        next_states = jax.tree_util.tree_map(
+            lambda v: _val(v)[b_idx], next_states)
+        parent_finished = jnp.take_along_axis(finished, parents, axis=-1)
+        next_finished = parent_finished | (tokens == self.end_token)
+        return ((tokens, parents, top_scores),
+                (next_states, top_scores, next_finished), tokens,
+                next_finished)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a decoder until every beam finishes or max_step_num
+    (reference: nn/decode.py dynamic_decode).  Returns (ids, scores) with
+    ids backtraced via gather_tree, [B, beam, T] batch-major by default."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    scores = None
+    t = 0
+    fin_acc = finished
+    lengths = jnp.zeros(fin_acc.shape, jnp.int64)
+    while True:
+        (tokens, parents, scores), states, inputs, finished = decoder.step(
+            t, inputs if not isinstance(inputs, Tensor) else _val(inputs),
+            states)
+        step_ids.append(tokens)
+        step_parents.append(parents)
+        lengths = jnp.where(fin_acc, lengths, lengths + 1)
+        fin_acc = fin_acc | finished
+        t += 1
+        if bool(jnp.all(fin_acc)) or (max_step_num is not None
+                                      and t >= max_step_num):
+            break
+    ids = jnp.stack(step_ids)          # [T, B, beam]
+    parents = jnp.stack(step_parents)
+    traced = _val(gather_tree(Tensor(ids), Tensor(parents)))  # [T, B, beam]
+    if not output_time_major:
+        traced = jnp.transpose(traced, (1, 2, 0))  # [B, beam, T]
+    out = (Tensor(traced), Tensor(scores))
+    if return_length:
+        return out + (Tensor(lengths),)
+    return out

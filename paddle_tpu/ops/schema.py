@@ -1,0 +1,88 @@
+"""Single-source op schema (reference: the api.yaml codegen pattern —
+python/paddle/utils/code_gen/api.yaml + api_gen.py generate the typed C++
+API, kernel dispatch, and eager forward functions from one declaration).
+
+TPU-native inversion: kernels are XLA lowerings, so there is nothing to
+codegen at build time — instead ONE yaml (`op_schema.yaml`) is the
+authoritative registry of the public op surface, and code *validates
+against* it:
+
+- `get_op_info(name)` / `all_ops()` expose the registry at runtime
+  (KernelFactory-style introspection).
+- tests/test_op_schema.py is the API-freeze gate (reference:
+  tools/check_api_compatible.py): an op vanishing, changing its
+  signature, or appearing without a schema entry fails CI.
+
+Regenerate after intentional surface changes with:
+    python tools/gen_op_schema.py
+(the diff then documents the API change for review, which is exactly how
+the reference treats api.yaml edits).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+import os
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+    name: str
+    module: str               # submodule within paddle_tpu.ops
+    signature: str            # canonical "(x, y, name=None)" string
+    is_method: bool           # exposed as a Tensor method
+    inplace_variant: Optional[str]  # e.g. "add_" for "add"
+
+
+_SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "op_schema.yaml")
+
+
+@functools.lru_cache(maxsize=1)
+def _load() -> Dict[str, OpSpec]:
+    import yaml
+
+    with open(_SCHEMA_PATH) as f:
+        raw = yaml.safe_load(f)
+    out = {}
+    for entry in raw["ops"]:
+        spec = OpSpec(
+            name=entry["op"],
+            module=entry["module"],
+            signature=entry["signature"],
+            is_method=bool(entry.get("method", False)),
+            inplace_variant=entry.get("inplace"),
+        )
+        out[spec.name] = spec
+    return out
+
+
+def all_ops() -> List[str]:
+    return sorted(_load())
+
+
+def get_op_info(name: str) -> OpSpec:
+    try:
+        return _load()[name]
+    except KeyError:
+        raise KeyError(f"no op schema entry for {name!r}") from None
+
+
+def current_signature(fn) -> str:
+    """Canonical signature string used by both generator and gate."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return "(...)"
+    parts = []
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            parts.append(f"*{p.name}")
+        elif p.kind == inspect.Parameter.VAR_KEYWORD:
+            parts.append(f"**{p.name}")
+        elif p.default is inspect.Parameter.empty:
+            parts.append(p.name)
+        else:
+            parts.append(f"{p.name}={p.default!r}")
+    return "(" + ", ".join(parts) + ")"

@@ -60,6 +60,9 @@ def apply_build_strategy(program, passes=("fuse_linear_act",
             if keep:
                 total += apply_pass(program, p, keep=keep)
             continue
+        if p == "fuse_linear_act":
+            total += apply_pass(program, p, keep=keep)
+            continue
         total += apply_pass(program, p)
     return total
 
@@ -111,12 +114,14 @@ def _fused_linear_fn(x, w, b, *, activation):
 
 
 @register_pass("fuse_linear_act")
-def fuse_linear_act(block) -> int:
+def fuse_linear_act(block, keep=()) -> int:
     """Fuse `linear` + single-consumer activation into one op whose TPU
     lowering is the Pallas matmul-epilogue kernel (kernels/fused_linear.py).
-    Reference analog: fc_fuse_pass + fused_gemm_epilogue."""
+    Reference analog: fc_fuse_pass + fused_gemm_epilogue.  `keep` names
+    fetch targets — a pre-activation that will be fetched must survive."""
     from .graph import OpDesc
 
+    keep = set(keep)
     consumers = _consumers(block)
     rewrites = 0
     new_ops = []
@@ -128,7 +133,7 @@ def fuse_linear_act(block) -> int:
         if op.type == "linear" and not op.writeback and op.single:
             out_name = op.outputs[0].name
             users = consumers.get(out_name, [])
-            if len(users) == 1:
+            if len(users) == 1 and out_name not in keep:
                 act_op, _ = users[0]
                 if act_op.type in _ACT_OPS and not act_op.writeback and \
                         act_op.single and len(act_op.inputs) == 1:

@@ -353,3 +353,251 @@ class TestInitializers:
                     init.KaimingUniform):
             lin = nn.Linear(64, 64, weight_attr=nn.ParamAttr(initializer=cls()))
             assert np.isfinite(lin.weight.numpy()).all()
+
+
+class TestNNExtrasR2:
+    """Round-2 nn long tail (reference: nn/functional/{vision,loss,
+    extension}.py, nn/decode.py): unpool, affine_grid, hsigmoid, margin
+    softmax, gather_tree, beam search."""
+
+    def test_max_unpool2d_roundtrip(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype(np.float32))
+        p, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+        u = F.max_unpool2d(p, idx, 2, 2)
+        assert u.shape == [2, 3, 8, 8]
+        np.testing.assert_allclose(
+            np.sort(u.numpy()[u.numpy() != 0]),
+            np.sort(p.numpy().ravel()), rtol=1e-6)
+        # layer wrappers
+        layer = nn.MaxUnPool2D(2, 2)
+        np.testing.assert_array_equal(layer(p, idx).numpy(), u.numpy())
+
+    def test_max_unpool1d(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 8).astype(np.float32))
+        p, idx = F.max_pool1d(x, 2, 2, return_mask=True)
+        u = F.max_unpool1d(p, idx, 2, 2)
+        assert u.shape == [2, 3, 8]
+
+    def test_affine_grid_identity(self):
+        theta = paddle.to_tensor(np.tile(
+            np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+        g = F.affine_grid(theta, [2, 3, 4, 5], align_corners=True)
+        assert g.shape == [2, 4, 5, 2]
+        np.testing.assert_allclose(g.numpy()[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g.numpy()[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_diag_embed_and_zeropad(self):
+        d = F.diag_embed(paddle.to_tensor(np.array([1., 2.], np.float32)),
+                         offset=1)
+        assert d.shape == [3, 3] and d.numpy()[0, 1] == 1
+        z = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32)),
+                        [1, 0, 2, 0])
+        assert z.shape == [1, 1, 4, 3]
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.zeros((4, 4, 1, 1), np.float32)
+        x[:, :, 0, 0] = np.arange(16).reshape(4, 4)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        # channel 0 reads the NEXT segment: batch row 0 sees row 1's value
+        assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+        # last segment's forward-shift pads with zero
+        assert out[1, 0, 0, 0] == 0
+        # untouched channels copy through
+        np.testing.assert_array_equal(out[:, 2:], x[:, 2:])
+
+    def test_dice_and_npair_losses(self):
+        pr = paddle.to_tensor(np.array([[[0.9, 0.1], [0.2, 0.8]]],
+                                       np.float32))
+        lb = paddle.to_tensor(np.array([[[0], [1]]], np.int64))
+        assert 0 <= float(F.dice_loss(pr, lb).numpy()) < 1
+        a = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        a.stop_gradient = False
+        p = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        loss = F.npair_loss(a, p, y)
+        g = paddle.grad(loss, a)[0]
+        assert g.shape == a.shape
+
+    def test_hsigmoid_loss_decreases_under_training(self):
+        paddle.seed(0)
+        hs = nn.HSigmoidLoss(8, 6)
+        x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 6, (16,)).astype(np.int64))
+        from paddle_tpu.optimizer import Adam
+
+        opt = Adam(5e-2, parameters=hs.parameters())
+        losses = []
+        for _ in range(25):
+            loss = hs(x, y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_margin_cross_entropy_margins_increase_loss(self):
+        paddle.seed(0)
+        lg = paddle.to_tensor(
+            ((np.random.rand(8, 10) - 0.5) * 1.8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 10, (8,)).astype(np.int64))
+        plain = F.margin_cross_entropy(lg, y, margin1=1.0, margin2=0.0,
+                                       margin3=0.0, scale=10.0)
+        arc = F.margin_cross_entropy(lg, y, margin1=1.0, margin2=0.5,
+                                     margin3=0.0, scale=10.0)
+        assert float(arc.numpy()) > float(plain.numpy())
+        # m2=0, m1=1, m3=0 reduces to plain scaled CE
+        onehot = np.eye(10, dtype=np.float32)[y.numpy()]
+        s = lg.numpy() * 10.0
+        ref = -(onehot * (s - np.log(np.exp(s).sum(-1, keepdims=True)))
+                ).sum(-1).mean()
+        np.testing.assert_allclose(float(plain.numpy()), ref, rtol=1e-4)
+
+    def test_gather_tree_backtrace(self):
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2]], [[3, 4]], [[5, 6]]], np.int64))
+        par = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]], [[1, 0]]], np.int64))
+        out = F.gather_tree(ids, par).numpy()
+        # beam 0 at final step came via parents 1 then 1
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+
+    def test_sparse_attention_full_pattern_matches_dense(self):
+        B, H, M, D = 1, 2, 4, 8
+        q, k, v = [paddle.to_tensor(
+            np.random.randn(B, H, M, D).astype(np.float32))
+            for _ in range(3)]
+        off = paddle.to_tensor(np.tile(
+            np.arange(0, M * M + 1, M, dtype=np.int64), (B, H, 1)))
+        cols = paddle.to_tensor(np.tile(
+            np.tile(np.arange(M, dtype=np.int64), M), (B, H, 1)))
+        got = F.sparse_attention(q, k, v, off, cols).numpy()
+        import jax
+
+        ref = np.asarray(jax.nn.softmax(
+            q.numpy() @ k.numpy().transpose(0, 1, 3, 2) / np.sqrt(D),
+            -1) @ v.numpy())
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_sparse_attention_banded_masks_out(self):
+        B, H, M, D = 1, 1, 4, 4
+        q, k, v = [paddle.to_tensor(
+            np.random.randn(B, H, M, D).astype(np.float32))
+            for _ in range(3)]
+        # diagonal-only pattern -> output rows equal v rows
+        off = paddle.to_tensor(np.arange(M + 1, dtype=np.int64)[None, None])
+        cols = paddle.to_tensor(np.arange(M, dtype=np.int64)[None, None])
+        got = F.sparse_attention(q, k, v, off, cols).numpy()
+        np.testing.assert_allclose(got, v.numpy(), atol=1e-6)
+
+    def test_beam_search_decodes_argmax_chain(self):
+        V = 6
+        trans = np.full((V, V), -10.0, np.float32)
+        for a, b in zip([2, 3, 4], [3, 4, 1]):
+            trans[a, b] = 5.0
+        trans[1, 1] = 5.0
+
+        class ToyCell:
+            def __call__(self, ids, states):
+                import jax.numpy as jnp
+
+                raw = ids._value if hasattr(ids, "_value") else ids
+                return paddle.to_tensor(jnp.asarray(trans)[raw]), states
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=2, end_token=1,
+                                   beam_size=2)
+        ids, scores = nn.dynamic_decode(
+            dec, inits={"h": paddle.to_tensor(np.zeros((2, 1), np.float32))},
+            max_step_num=6)
+        assert ids.numpy()[0, 0].tolist()[:3] == [3, 4, 1]
+        assert scores.shape == [2, 2]
+
+    def test_softmax2d_and_pairwise_distance(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 4, 4).astype(np.float32))
+        s = nn.Softmax2D()(x).numpy()
+        np.testing.assert_allclose(s.sum(1), 1.0, rtol=1e-5)
+        a = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32))
+        b = paddle.to_tensor(np.array([[0.0, 0.0]], np.float32))
+        d = nn.PairwiseDistance()(a, b)
+        np.testing.assert_allclose(d.numpy(), [1.0], rtol=1e-4)
+
+    def test_class_center_sample(self):
+        y = paddle.to_tensor(np.array([1, 5, 7], np.int64))
+        remapped, sampled = F.class_center_sample(y, 10, 5)
+        sc = sampled.numpy().tolist()
+        assert len(sc) == 5 and {1, 5, 7}.issubset(set(sc))
+        for orig, rm in zip([1, 5, 7], remapped.numpy().tolist()):
+            assert sc[rm] == orig
+
+    def test_sparse_attention_per_head_patterns(self):
+        B, H, M, D = 1, 2, 4, 4
+        q, k, v = [paddle.to_tensor(
+            np.random.randn(B, H, M, D).astype(np.float32))
+            for _ in range(3)]
+        # head 0: diagonal-only (columns duplicated M times per row so both
+        # heads share nnz — valid CSR); head 1: full attention
+        cols0 = np.repeat(np.arange(M), M)       # row i: col i x M
+        offs = paddle.to_tensor(np.stack([np.arange(M + 1) * M,
+                                          np.arange(M + 1) * M]
+                                         )[None].astype(np.int64))
+        colsj = paddle.to_tensor(np.stack([cols0, np.tile(np.arange(M), M)]
+                                          )[None].astype(np.int64))
+        got = F.sparse_attention(q, k, v, offs, colsj).numpy()
+        import jax
+
+        # head 1 must equal dense attention
+        ref1 = np.asarray(jax.nn.softmax(
+            q.numpy()[:, 1] @ k.numpy()[:, 1].transpose(0, 2, 1)
+            / np.sqrt(D), -1) @ v.numpy()[:, 1])
+        np.testing.assert_allclose(got[:, 1], ref1, atol=1e-5)
+        # head 0 is diagonal-only -> rows equal v rows
+        np.testing.assert_allclose(got[:, 0], v.numpy()[:, 0], atol=1e-5)
+
+    def test_sparse_attention_key_padding_mask(self):
+        B, H, M, D = 1, 1, 4, 4
+        q, k, v = [paddle.to_tensor(
+            np.random.randn(B, H, M, D).astype(np.float32))
+            for _ in range(3)]
+        off = paddle.to_tensor(
+            (np.arange(0, M * M + 1, M))[None, None].astype(np.int64))
+        cols = paddle.to_tensor(
+            np.tile(np.arange(M), M)[None, None].astype(np.int64))
+        kpm = paddle.to_tensor(np.array([[True, True, False, False]]))
+        got = F.sparse_attention(q, k, v, off, cols,
+                                 key_padding_mask=kpm).numpy()
+        import jax
+
+        s = q.numpy() @ k.numpy().transpose(0, 1, 3, 2) / np.sqrt(D)
+        s[..., 2:] = -1e30
+        ref = np.asarray(jax.nn.softmax(s, -1) @ v.numpy())
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_beam_search_finished_beam_score_frozen(self):
+        """A completed hypothesis must keep its score (end-token self-loop
+        at zero cost), not decay out of the beam."""
+        V = 5
+        # token 1 = end. From start (2): token 1 scores high at step 0 for
+        # beam A; token 3 then 4 gives a slightly lower-scoring longer path
+        trans = np.full((V, V), -8.0, np.float32)
+        trans[2, 1] = 2.0    # immediate finish, total 2.0 (after softmax~)
+        trans[2, 3] = 1.9
+        trans[3, 4] = 1.9
+        trans[4, 1] = 1.9
+        trans[1, 1] = -8.0   # end continuation is BAD in the cell's view:
+        # only the decoder's finished-beam lock keeps the hypothesis alive
+
+        class ToyCell:
+            def __call__(self, ids, states):
+                import jax.numpy as jnp
+
+                raw = ids._value if hasattr(ids, "_value") else ids
+                return paddle.to_tensor(jnp.asarray(trans)[raw]), states
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=2, end_token=1,
+                                   beam_size=2)
+        ids, scores = nn.dynamic_decode(
+            dec, inits={"h": paddle.to_tensor(np.zeros((1, 1), np.float32))},
+            max_step_num=5)
+        out = ids.numpy()[0]
+        # the immediately-finished beam survives as pure end tokens
+        assert (out == 1).all(axis=-1).any(), out

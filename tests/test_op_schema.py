@@ -1,0 +1,119 @@
+"""API-freeze gate over the single-source op schema (reference:
+tools/check_api_compatible.py + the api.yaml single-source pattern,
+SURVEY.md §2.1#5).
+
+Failing here means the public op surface drifted from
+paddle_tpu/ops/op_schema.yaml.  If the change is intentional, regenerate
+the schema (python tools/gen_op_schema.py) and commit the diff — that
+diff is the reviewable API-change record.
+"""
+import inspect
+
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.schema import all_ops, current_signature, get_op_info
+
+
+def _live_surface():
+    seen = {}
+    submods = {"creation": ops.creation, "math": ops.math_mod,
+               "manipulation": ops.manipulation, "logic": ops.logic,
+               "linalg": ops.linalg, "search": ops.search,
+               "stat": ops.stat, "random": ops.random}
+    import paddle_tpu.ops.einsum as einsum_mod
+
+    submods["einsum"] = einsum_mod
+    for sub, mod in submods.items():
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if getattr(fn, "__module__", "").startswith("paddle_tpu.ops"):
+                seen.setdefault(name, (sub, fn))
+    return seen
+
+
+class TestOpSchemaGate:
+    def test_every_declared_op_exists_with_signature(self):
+        live = _live_surface()
+        missing, changed = [], []
+        for name in all_ops():
+            spec = get_op_info(name)
+            if name not in live:
+                missing.append(name)
+                continue
+            _, fn = live[name]
+            if current_signature(fn) != spec.signature:
+                changed.append(
+                    (name, spec.signature, current_signature(fn)))
+        assert not missing, f"ops removed without schema update: {missing}"
+        assert not changed, (
+            "op signatures drifted from schema (regenerate via "
+            f"tools/gen_op_schema.py if intentional): {changed}")
+
+    def test_no_undeclared_public_ops(self):
+        live = _live_surface()
+        declared = set(all_ops())
+        undeclared = sorted(n for n in live if n not in declared)
+        assert not undeclared, (
+            f"new public ops missing schema entries (run "
+            f"tools/gen_op_schema.py): {undeclared}")
+
+    def test_method_flag_matches_tensor(self):
+        for name in all_ops():
+            spec = get_op_info(name)
+            if spec.is_method:
+                assert hasattr(Tensor, name), (
+                    f"schema says {name} is a Tensor method; it is not")
+
+    def test_inplace_variants_exist(self):
+        for name in all_ops():
+            spec = get_op_info(name)
+            if spec.inplace_variant:
+                assert hasattr(Tensor, spec.inplace_variant), (
+                    f"{name}: declared in-place variant "
+                    f"{spec.inplace_variant} missing from Tensor")
+
+    def test_registry_lookup(self):
+        info = get_op_info("matmul")
+        assert info.module == "math" and info.is_method
+        with pytest.raises(KeyError):
+            get_op_info("not_a_real_op")
+        assert len(all_ops()) >= 300
+
+
+class TestBenchGate:
+    """Perf-regression gate tool (reference:
+    tools/check_op_benchmark_result.py semantics)."""
+
+    def _write(self, tmp_path, name, payload):
+        p = tmp_path / name
+        p.write_text(__import__("json").dumps(payload))
+        return str(p)
+
+    def test_pass_fail_and_missing(self, tmp_path):
+        import sys
+
+        sys.path.insert(0, "tools")
+        try:
+            from check_bench_result import main
+        finally:
+            sys.path.pop(0)
+        ok = self._write(tmp_path, "a.json",
+                         {"parsed": {"value": 100.0}})
+        faster = self._write(tmp_path, "b.json",
+                             {"parsed": {"value": 104.0}})
+        slower = self._write(tmp_path, "c.json",
+                             {"parsed": {"value": 90.0}})
+        errored = self._write(tmp_path, "d.json",
+                              {"parsed": None, "tail": "boom"})
+        assert main([ok, faster]) == 0
+        assert main([ok, slower]) == 3
+        assert main([ok, slower, "--threshold", "0.2"]) == 0
+        assert main([ok, errored]) == 4
+        assert main([errored, ok]) == 0  # no baseline: initial measurement

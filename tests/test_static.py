@@ -425,3 +425,27 @@ class TestPasses:
             assert len(main.current_block().ops) == 1
         finally:
             paddle.disable_static()
+
+    def test_fuse_respects_fetch_keep(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 8], "float32")
+                lin = nn.Linear(8, 8)
+                h = lin(x)          # pre-activation, fetched below
+                out = F.gelu(h)
+            assert static.apply_pass(main, "fuse_linear_act",
+                                     keep=[h.name]) == 0
+            exe = static.Executor()
+            exe.run(startup)
+            res = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                          fetch_list=[h, out])
+            assert len(res) == 2
+        finally:
+            paddle.disable_static()

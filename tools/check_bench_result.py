@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Perf-regression gate (reference: tools/check_op_benchmark_result.py:106
+compare_benchmark_result — PR-vs-develop op benchmark diffing).
+
+Compares two bench JSON artifacts (the driver's BENCH_r{N}.json format or
+bench.py's raw line) and fails when throughput regresses beyond the
+threshold:
+
+    python tools/check_bench_result.py BENCH_r01.json BENCH_r02.json \
+        --threshold 0.05
+
+Exit codes: 0 ok / 3 regression / 4 missing-or-errored artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_value(path: str):
+    with open(path) as f:
+        data = json.load(f)
+    # driver format wraps the bench line under "parsed"; accept both
+    node = data.get("parsed") if isinstance(data, dict) and "parsed" in data \
+        else data
+    if not isinstance(node, dict) or node.get("value") is None:
+        return None, (node or {}).get("error") or data.get("tail", "")[-200:]
+    return float(node["value"]), None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="max allowed fractional slowdown (default 5%)")
+    args = ap.parse_args(argv)
+
+    base, base_err = load_value(args.baseline)
+    cand, cand_err = load_value(args.candidate)
+    if cand is None:
+        print(f"FAIL: candidate bench produced no number ({cand_err})")
+        return 4
+    if base is None:
+        # nothing to compare against: candidate having a number is a pass
+        print(f"OK: candidate={cand:.1f}; baseline had no number "
+              f"({base_err}) — treating as initial measurement")
+        return 0
+    ratio = cand / base
+    if ratio < 1.0 - args.threshold:
+        print(f"FAIL: {cand:.1f} vs baseline {base:.1f} "
+              f"({(1 - ratio) * 100:.1f}% slower > {args.threshold * 100:.0f}% "
+              f"threshold)")
+        return 3
+    print(f"OK: {cand:.1f} vs baseline {base:.1f} ({(ratio - 1) * 100:+.1f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

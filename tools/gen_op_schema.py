@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Regenerate paddle_tpu/ops/op_schema.yaml from the live op surface.
+
+Run after an INTENTIONAL API change; the yaml diff is the reviewable
+record of the change (reference workflow: editing api.yaml).
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import paddle_tpu  # noqa: F401 — triggers monkey_patch
+    import paddle_tpu.ops as ops
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.ops.schema import current_signature
+
+    submodules = ["creation", "math", "manipulation", "logic", "linalg",
+                  "search", "stat", "random", "einsum"]
+    seen = {}
+    for sub in submodules:
+        mod = getattr(ops, sub if sub != "math" else "math_mod", None) or \
+            __import__(f"paddle_tpu.ops.{sub}", fromlist=[sub])
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if getattr(fn, "__module__", "").startswith("paddle_tpu.ops"):
+                if name not in seen:
+                    seen[name] = (sub, fn)
+    inplace = {n[:-1]: n for n in ops._INPLACE_ALIASES if n.endswith("_")
+               and n[:-1] in seen}
+    lines = ["# AUTO-GENERATED single-source op schema — regenerate with",
+             "#   python tools/gen_op_schema.py",
+             "# This file is the API-freeze baseline (tests/test_op_schema.py).",
+             "ops:"]
+    for name in sorted(seen):
+        sub, fn = seen[name]
+        sig = current_signature(fn)
+        lines.append(f"- op: {name}")
+        lines.append(f"  module: {sub}")
+        lines.append(f"  signature: \"{sig}\"")
+        if hasattr(Tensor, name):
+            lines.append("  method: true")
+        if name in inplace:
+            lines.append(f"  inplace: {inplace[name]}")
+    path = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu",
+                        "ops", "op_schema.yaml")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {len(seen)} ops to {os.path.normpath(path)}")
+
+
+if __name__ == "__main__":
+    main()
