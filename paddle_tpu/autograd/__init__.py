@@ -168,3 +168,148 @@ class PyLayer:
 
 class LegacyPyLayer(PyLayer):
     pass
+
+
+# ---------------------------------------------------------------------------
+# functional transforms (reference: python/paddle/autograd/functional.py —
+# vjp/jvp/Jacobian/Hessian built on double grad; here they ride jax's
+# transforms directly, the TPU-native substrate the tape already lowers to)
+# ---------------------------------------------------------------------------
+
+def _wrap_fn(func):
+    """Lift a Tensor->Tensor function to raw-array land for jax AD."""
+    import jax
+
+    def raw(*arrays):
+        args = [Tensor(a) for a in arrays]
+        out = func(*args)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return raw
+
+
+def _vals(xs):
+    if isinstance(xs, (list, tuple)):
+        return [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+    return [xs._value if isinstance(xs, Tensor) else jnp.asarray(xs)]
+
+
+def _rewrap(vs):
+    if isinstance(vs, (list, tuple)):
+        out = tuple(Tensor(v) for v in vs)
+        return out if len(out) != 1 else out[0]
+    return Tensor(vs)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) — reference autograd/functional.py vjp."""
+    import jax
+
+    vals = _vals(xs)
+    out, pullback = jax.vjp(_wrap_fn(func), *vals)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        cot = tuple(_vals(v)) if isinstance(v, (list, tuple)) else _vals(v)[0]
+    grads = pullback(cot)
+    return _rewrap(out), _rewrap(grads)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — forward-mode directional derivative."""
+    import jax
+
+    vals = _vals(xs)
+    tangents = _vals(v) if v is not None else [jnp.ones_like(a)
+                                               for a in vals]
+    out, tangent_out = jax.jvp(_wrap_fn(func), tuple(vals), tuple(tangents))
+    return _rewrap(out), _rewrap(tangent_out)
+
+
+class Jacobian:
+    """Dense Jacobian matrix (reference: autograd/functional.py Jacobian):
+    rows = flattened outputs, columns = flattened inputs concatenated in
+    order (the reference's matrix-view semantics for multi-input xs)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import math as _math
+
+        import jax
+
+        vals = _vals(xs)
+        single_in = not isinstance(xs, (list, tuple))
+        jac = jax.jacrev(_wrap_fn(func),
+                         argnums=tuple(range(len(vals))))(*vals)
+        if single_in:
+            # natural out_shape + in_shape view
+            self._jac = jnp.asarray(jac[0] if isinstance(jac, tuple)
+                                    else jac)
+        else:
+            # flatten outputs to rows, concat flattened inputs as columns
+            blocks = []
+            for v, j in zip(vals, jac):
+                j = jnp.asarray(j)
+                out_size = _math.prod(j.shape[:j.ndim - v.ndim]) or 1
+                blocks.append(j.reshape(out_size, v.size))
+            self._jac = jnp.concatenate(blocks, axis=-1)
+        self.is_batched = is_batched
+
+    @property
+    def shape(self):
+        return jnp.shape(self._jac)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._jac)[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac)
+
+
+class Hessian(Jacobian):
+    """Hessian of a scalar-output function (reference: functional.Hessian):
+    a [total_in, total_in] block matrix over the flattened inputs."""
+
+    def __init__(self, func, xs, is_batched=False):
+        import jax
+
+        vals = _vals(xs)
+        single_in = not isinstance(xs, (list, tuple))
+        hes = jax.hessian(_wrap_fn(func),
+                          argnums=tuple(range(len(vals))))(*vals)
+        if single_in and len(vals) == 1:
+            self._jac = jnp.asarray(hes[0][0]) if isinstance(hes, tuple) \
+                else jnp.asarray(hes)
+        else:
+            sizes = [v.size for v in vals]
+            rows = []
+            for i in range(len(vals)):
+                row = [jnp.asarray(hes[i][j]).reshape(sizes[i], sizes[j])
+                       for j in range(len(vals))]
+                rows.append(jnp.concatenate(row, axis=-1))
+            self._jac = jnp.concatenate(rows, axis=0)
+        self.is_batched = is_batched
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Dense Jacobian Tensor(s) (reference dygraph autograd.jacobian)."""
+    return Tensor(jnp.asarray(Jacobian(func, xs)._jac))
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    return Tensor(jnp.asarray(Hessian(func, xs)._jac))
+
+
+def no_grad_(func=None):
+    """Decorator/context parity alias for no_grad (reference exports the
+    decorator form as autograd.no_grad_)."""
+    return no_grad(func) if func is not None else no_grad()
+
+
+from . import backward_mode  # noqa: E402,F401

@@ -1,0 +1,11 @@
+"""paddle.autograd.backward_mode (reference:
+python/paddle/autograd/backward_mode.py — the multi-tensor backward
+entry).  The engine is the tape in core/tape.py."""
+from __future__ import annotations
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    from . import backward as _backward
+
+    return _backward(tensors, grad_tensors=grad_tensors,
+                     retain_graph=retain_graph)

@@ -9,9 +9,21 @@ from __future__ import annotations
 import jax
 
 from ..core.place import (  # noqa: F401
-    CPUPlace, Place, TPUPlace, device_count, get_device, set_device,
-    is_compiled_with_cuda, is_compiled_with_tpu,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, MLUPlace,
+    NPUPlace, Place, TPUPlace, XPUPlace, device_count, get_device,
+    is_compiled_with_cinn, is_compiled_with_cuda, is_compiled_with_ipu,
+    is_compiled_with_mlu, is_compiled_with_npu, is_compiled_with_rocm,
+    is_compiled_with_tpu, is_compiled_with_xpu, set_device,
 )
+from ..distributed.env import ParallelEnv  # noqa: F401
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_cudnn_version():
+    return None
 
 
 def get_all_device_type():
@@ -198,3 +210,39 @@ class cuda:
     @staticmethod
     def max_memory_reserved(device=None):
         return max_memory_reserved(device)
+
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream()
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import jax as _jax
+
+        d = _device_obj(device)
+        stats = _mem_stats(device) or {}
+
+        class _Props:
+            name = d.device_kind
+            major, minor = 0, 0
+            total_memory = stats.get("bytes_limit", 0)
+            multi_processor_count = 1
+
+            def __repr__(self):
+                return (f"_CudaDeviceProperties(name='{self.name}', "
+                        f"total_memory={self.total_memory})")
+
+        return _Props()
+
+    @staticmethod
+    def get_device_name(device=None):
+        return _device_obj(device).device_kind
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)

@@ -13,8 +13,9 @@ from .mesh import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
-    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send,
-    wait, ReduceOp, Group,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, irecv, isend,
+    new_group, recv, reduce, reduce_scatter, scatter, send, split,
+    wait, Group, ParallelMode, ReduceOp,
 )
 from .parallel import init_parallel_env  # noqa: F401
 from . import fleet  # noqa: F401

@@ -356,3 +356,92 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 def stream_wait(*a, **k):
     return None
+
+
+class ParallelMode:
+    """Parallelism kind enum (reference:
+    python/paddle/distributed/parallel.py ParallelMode)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel linear/embedding in one call (reference:
+    distributed/collective.py split — builds the partitioned weight and
+    the collective).  TPU-native: delegates to the GSPMD parallel layers
+    (parallel_layers.py), whose shardings compile to the same collectives
+    the reference inserts by hand."""
+    from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"split supports linear/embedding, got {operation}")
+    has_bias = bias_attr is not False
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=has_bias,
+                                  input_is_parallel=False)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=has_bias,
+                                     gather_output=gather_out)
+    else:
+        raise ValueError("axis must be 0 (row) or 1 (column)")
+    return layer(x)
+
+
+# host-side barrier family over the TCPStore (reference: gloo_* in
+# python/paddle/distributed/parallel.py — CPU-only barriers via gloo;
+# the store is our gloo-position component)
+_GLOO_STORE = None
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    global _GLOO_STORE
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    _GLOO_STORE = TCPStore(host, int(port), is_master=(rank_id == 0))
+    _GLOO_STORE.add("gloo/init", 1)
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if _GLOO_STORE.add("gloo/init", 0) >= rank_num:
+            return
+        time.sleep(0.01)
+    raise TimeoutError("gloo_init_parallel_env rendezvous timed out")
+
+
+_gloo_barrier_round = [0]
+
+
+def gloo_barrier():
+    if _GLOO_STORE is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_barrier_round[0] += 1
+    key = f"gloo/barrier/{_gloo_barrier_round[0]}"
+    world = _GLOO_STORE.add("gloo/init", 0)
+    _GLOO_STORE.add(key, 1)
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if _GLOO_STORE.add(key, 0) >= world:
+            return
+        time.sleep(0.01)
+    raise TimeoutError("gloo_barrier timed out")
+
+
+def gloo_release():
+    global _GLOO_STORE
+    _GLOO_STORE = None

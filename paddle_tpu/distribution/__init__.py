@@ -581,3 +581,37 @@ __all__ += ["TransformedDistribution", "Independent", "Transform",
             "ExpTransform", "IndependentTransform", "PowerTransform",
             "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
             "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    python/paddle/distribution/exponential_family.py): subclasses expose
+    natural parameters + log-normalizer; entropy falls out via the
+    Bregman identity H = A(eta) - <eta, grad A(eta)> - E[log h(x)]."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+        import jax.numpy as jnp
+
+        nat = [jnp.asarray(p._value if hasattr(p, "_value") else p)
+               for p in self._natural_parameters]
+        # elementwise over the batch: grad of the SUMMED log-normalizer
+        # gives per-element partials because A is applied elementwise
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return Tensor(jnp.asarray(ent))

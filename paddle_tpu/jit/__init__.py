@@ -225,6 +225,10 @@ class StaticFunction:
         self._optimizers = opts
 
     def __call__(self, *args, **kwargs):
+        # global dy2static switch (ProgramTranslator.enable(False) runs
+        # the original python function eagerly, reference semantics)
+        if not ProgramTranslator._enabled:
+            return self._fn(*args, **kwargs)
         if self._layers is None:
             self._discover(args, kwargs)
         state = _State(self._layers, self._optimizers)
@@ -487,3 +491,80 @@ def load(path, **configs):
         blob = pickle.load(f)
     exported = jax.export.deserialize(blob["stablehlo"])
     return LoadedFunction(exported, blob["state"])
+
+
+# ---------------------------------------------------------------------------
+# reference-compat surface (python/paddle/fluid/dygraph/jit.py,
+# dygraph_to_static/program_translator.py)
+# ---------------------------------------------------------------------------
+
+declarative = to_static  # the reference's older decorator name
+
+
+class ProgramTranslator:
+    """Singleton toggling dy2static globally (reference:
+    program_translator.py ProgramTranslator.get_instance().enable(False)).
+    Here 'static conversion' is whole-step XLA compilation: disabling it
+    makes to_static-wrapped functions run eagerly."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        ProgramTranslator._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return ProgramTranslator._enabled
+
+
+def enable_to_static(flag: bool):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Dy2static logging verbosity (reference: logging_utils.set_verbosity).
+    Maps onto the jit logger level."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+    return level
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity shim: the reference prints transformed AST at this level;
+    we have no AST transform stage (tracing does the conversion), so this
+    records the setting only."""
+    set_verbosity(1 if level else 0)
+    return level
+
+
+class TracedLayer:
+    """Trace-and-replay wrapper (reference: fluid/dygraph/jit.py
+    TracedLayer over program_desc_tracing): trace builds the compiled
+    callable; save_inference_model exports it."""
+
+    def __init__(self, layer, static_fn, example_args):
+        self._layer = layer
+        self._fn = static_fn
+        self._example_args = example_args
+
+    @staticmethod
+    def trace(layer, inputs):
+        fn = to_static(lambda *a: layer(*a))
+        outs = fn(*inputs)
+        return outs, TracedLayer(layer, fn, inputs)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path, input_spec=list(self._example_args))
+        return path

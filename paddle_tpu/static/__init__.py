@@ -35,6 +35,48 @@ from .passes import (  # noqa: F401
     apply_build_strategy, apply_pass, get_pass, list_passes, register_pass,
 )
 from . import passes  # noqa: F401
+from .extras import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, ExponentialMovingAverage,
+    IpuCompiledProgram, IpuStrategy, ParallelExecutor, Print,
+    WeightNormParamAttr, cpu_places, cuda_pinned_places, cuda_places,
+    create_global_var, deserialize_persistables, deserialize_program,
+    ipu_shard_guard, load_from_file, load_program_state, load_vars,
+    mlu_places, normalize_program, npu_places, save_to_file, save_vars,
+    serialize_persistables, serialize_program, set_program_state,
+    xpu_places,
+)
+from ..ops.math import accuracy  # noqa: F401
+from ..metric import Auc as _Auc
+
+_auc_accumulators = {}
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """AUC with cross-batch accumulation (reference: static.auc over the
+    auc op — returns (global_auc, batch_auc, states)).  The reference
+    materializes the confusion-matrix state as program variables; here a
+    per-config accumulator plays that role and is returned as `states`."""
+    import numpy as np
+
+    from ..core.tensor import to_tensor
+
+    pred = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    key = (curve, num_thresholds)
+    acc = _auc_accumulators.get(key)
+    if acc is None:
+        acc = _auc_accumulators[key] = _Auc(curve=curve,
+                                            num_thresholds=num_thresholds)
+    acc.update(pred, lab)
+    batch = _Auc(curve=curve, num_thresholds=num_thresholds)
+    batch.update(pred, lab)
+    return (to_tensor(np.asarray(acc.accumulate(), np.float32)),
+            to_tensor(np.asarray(batch.accumulate(), np.float32)),
+            [acc])
+
+
+from .. import amp  # noqa: E402,F401  (paddle.static.amp parity alias)
 
 py_func = None  # not supported: host callbacks break XLA compilation
 

@@ -1,0 +1,374 @@
+"""Static-mode surface fills (reference: python/paddle/static/__init__.py
+exports — strategies, EMA, program serialization, place lists, var
+save/load).  Strategy objects are accepted-and-recorded shims: their
+knobs configure executors/SSA passes in the reference, all of which XLA
+owns here; they are kept so reference training scripts run unchanged.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+class BuildStrategy:
+    """Reference: framework/details/build_strategy.h — graph-build knobs
+    (fusion toggles, reduce strategy).  XLA performs the fusions; the
+    object records settings for compatibility."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.enable_auto_fusion = False
+        self.fuse_relu_depthwise_conv = False
+        self.sync_batch_norm = False
+        self.memory_optimize = None
+        self.enable_inplace = True
+        self.build_cinn_pass = False
+
+    def __repr__(self):
+        return f"BuildStrategy({self.__dict__})"
+
+
+class ExecutionStrategy:
+    """Reference: ExecutionStrategy (num_threads, num_iteration_per_run)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = True
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor facade (reference:
+    framework/details ParallelExecutor; SURVEY declares it superseded by
+    SPMD compilation).  Wraps the ordinary Executor: under GSPMD one
+    compiled program spans all devices, which is this class's contract."""
+
+    def __init__(self, use_cuda=None, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .graph import Executor, default_main_program
+
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+        self._loss_name = loss_name
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters for evaluation (reference:
+    python/paddle/static/__init__.py ExponentialMovingAverage over
+    fluid/optimizer.py): update() folds current params into the shadow
+    with bias correction; apply()/restore() swap them in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    _tracked = []
+
+    def track(self, parameters):
+        """Eager-mode registration (dygraph path of the reference API)."""
+        self._tracked = list(parameters)
+        return self
+
+    def update(self):
+        import jax.numpy as jnp
+
+        self._step += 1
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._tracked:
+            prev = self._shadow.get(id(p))
+            cur = p._value.astype(jnp.float32)
+            self._shadow[id(p)] = cur if prev is None else \
+                d * prev + (1.0 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        from contextlib import contextmanager
+
+        self._backup = {id(p): p._value for p in self._tracked}
+        for p in self._tracked:
+            if id(p) in self._shadow:
+                p._value = self._shadow[id(p)].astype(p._value.dtype)
+
+        @contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._tracked:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}
+
+
+# ---------------------------------------------------------------------------
+# program/persistable serialization (reference: static/io.py
+# serialize_program:SerializeProgram etc.)
+# ---------------------------------------------------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    from .graph import default_main_program, save_inference_model
+
+    import io as _io
+    import tempfile
+    import os
+
+    program = program or default_main_program()
+    with tempfile.TemporaryDirectory() as d:
+        save_inference_model(os.path.join(d, "m"), feed_vars, fetch_vars,
+                             program=program)
+        with open(os.path.join(d, "m.pdmodel"), "rb") as f:
+            return f.read()
+
+
+def deserialize_program(data):
+    import pickle as _p
+
+    return _p.loads(data)
+
+
+def _persistables(program):
+    """All live parameter tensors a program depends on: startup-action
+    vars (static.create_parameter) plus Layer parameters captured as
+    'const' op inputs (nn layers called under program_guard)."""
+    seen = {}
+    for t, _init in program._startup_actions:
+        seen.setdefault(id(t), t)
+    for block in program.blocks:
+        for op in block.ops:
+            for kind, ref in op.inputs:
+                if kind == "const" and getattr(ref, "persistable", False):
+                    seen.setdefault(id(ref), ref)
+    out = {}
+    for i, t in enumerate(seen.values()):
+        out[t.name or f"param_{i}"] = t
+    return out
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    from .graph import default_main_program
+
+    program = program or default_main_program()
+    state = {name: np.asarray(t._value)
+             for name, t in _persistables(program).items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    import jax.numpy as jnp
+
+    for name, t in _persistables(program).items():
+        if name in state:
+            t._value = jnp.asarray(state[name])
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed->fetch slice (reference: static/io.py
+    normalize_program).  Our Program already records exactly the traced
+    slice; dead ops are removed via the pass framework."""
+    from .passes import apply_pass
+
+    names = [v.name for v in (fetch_vars if isinstance(fetch_vars, list)
+                              else [fetch_vars])]
+    apply_pass(program, "eliminate_dead_ops", keep=names)
+    return program
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .graph import default_main_program
+
+    import os
+
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    state = {}
+    for name, t in _persistables(program).items():
+        if predicate is not None and not predicate(t):
+            continue
+        state[name] = np.asarray(t._value)
+    out = os.path.join(dirname, filename or "vars.pkl")
+    with open(out, "wb") as f:
+        pickle.dump(state, f)
+    return out
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .graph import default_main_program
+
+    import os
+    import jax.numpy as jnp
+
+    program = main_program or default_main_program()
+    with open(os.path.join(dirname, filename or "vars.pkl"), "rb") as f:
+        state = pickle.load(f)
+    for name, t in _persistables(program).items():
+        if name in state:
+            t._value = jnp.asarray(state[name])
+
+
+def load_program_state(model_path, var_list=None):
+    import os
+
+    path = model_path if model_path.endswith(".pkl") else \
+        os.path.join(model_path, "vars.pkl")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    import jax.numpy as jnp
+
+    for name, t in _persistables(program).items():
+        if name in state:
+            t._value = jnp.asarray(state[name])
+
+
+# ---------------------------------------------------------------------------
+# places + misc
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips fill the CUDA position)."""
+    import jax
+
+    from ..core.place import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def cuda_pinned_places(device_count=None):
+    from ..core.place import CUDAPinnedPlace
+
+    return [CUDAPinnedPlace() for _ in range(device_count or 1)]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable filled variable (reference: layers/tensor.py
+    create_global_var)."""
+    from .graph import create_parameter
+    from ..nn import initializer as I
+
+    return create_parameter(shape, dtype, name=name,
+                            initializer=I.Constant(float(value)),
+                            trainable=False)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference: layers/control_flow.py Print) via
+    jax.debug.print so it fires inside compiled programs too."""
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor, to_tensor
+
+    msg = message or ""
+
+    def _fn(v):
+        import jax
+
+        jax.debug.print(msg + " {x}", x=v)
+        return v
+
+    return apply("print", _fn,
+                 input if isinstance(input, Tensor) else to_tensor(input))
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (reference:
+    python/paddle/static/__init__.py WeightNormParamAttr).  Consumed by
+    nn.utils.weight_norm at layer-construction time."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class IpuStrategy:  # pragma: no cover - non-TPU hardware shim
+    def __init__(self):
+        raise NotImplementedError("IPU is not a target of this framework")
+
+
+class IpuCompiledProgram:  # pragma: no cover
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a target of this framework")
+
+
+def ipu_shard_guard(*a, **k):  # pragma: no cover
+    raise NotImplementedError("IPU is not a target of this framework")

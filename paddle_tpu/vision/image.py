@@ -1,0 +1,29 @@
+"""Image IO (reference: python/paddle/vision/image.py — backend selection
+plus image_load over cv2/PIL).  numpy/PIL-backed here; the framework's
+device path never decodes images (host-side work feeding the loader)."""
+from __future__ import annotations
+
+import numpy as np
+
+_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    global _BACKEND
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _BACKEND = backend
+
+
+def get_image_backend():
+    return _BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image file; returns a PIL.Image ('pil') or HWC ndarray."""
+    from PIL import Image
+
+    img = Image.open(path)
+    if (backend or _BACKEND) == "pil":
+        return img
+    return np.asarray(img)

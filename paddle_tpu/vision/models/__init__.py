@@ -1,7 +1,8 @@
 """paddle.vision.models (reference: python/paddle/vision/models/__init__.py)."""
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-    resnext50_32x4d, resnext101_32x4d, wide_resnet50_2, wide_resnet101_2,
+    resnext50_32x4d, resnext101_32x4d, resnext50_64x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
 )
 from .simple import (  # noqa: F401
     AlexNet, LeNet, SqueezeNet, VGG, alexnet, squeezenet1_0, squeezenet1_1,
@@ -14,5 +15,6 @@ from .mobilenet import (  # noqa: F401
 from .extra import (  # noqa: F401
     DenseNet, GoogLeNet, InceptionV3, ShuffleNetV2, densenet121, densenet161,
     densenet169, densenet201, googlenet, inception_v3, shufflenet_v2_x0_5,
-    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_0, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0, shufflenet_v2_swish, densenet264,
 )

@@ -35,7 +35,8 @@ class DenseNet(nn.Layer):
                  num_classes=1000, with_pool=True):
         super().__init__()
         cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-                169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
         block_config = cfgs[layers]
         num_init = 2 * growth_rate
         if layers == 161:
@@ -227,7 +228,9 @@ class ShuffleNetV2(nn.Layer):
     def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
         super().__init__()
         stage_repeats = [4, 8, 4]
-        channels = {0.5: [24, 48, 96, 192, 1024],
+        channels = {0.25: [24, 24, 48, 96, 512],
+                    0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024],
                     1.0: [24, 116, 232, 464, 1024],
                     1.5: [24, 176, 352, 704, 1024],
                     2.0: [24, 244, 488, 976, 2048]}[scale]
@@ -268,3 +271,27 @@ def shufflenet_v2_x1_0(pretrained=False, **kwargs):
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
     return ShuffleNetV2(0.5, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

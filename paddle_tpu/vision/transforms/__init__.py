@@ -276,8 +276,8 @@ class HueTransform(BaseTransform):
         self.value = value
 
     def _apply_image(self, img):
-        # lightweight approximation: channel roll mix
-        return _as_hwc(img)
+        factor = random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
 
 
 class ColorJitter(BaseTransform):
@@ -322,3 +322,97 @@ class RandomRotation(BaseTransform):
         angle = random.uniform(*self.degrees)
         arr = _as_hwc(img)
         return ndimage.rotate(arr, angle, reshape=False, order=1)
+
+
+# ---------------------------------------------------------------------------
+# functional API (reference: python/paddle/vision/transforms/functional.py)
+# ---------------------------------------------------------------------------
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Pad an HWC image (functional form of the Pad transform)."""
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    left, top, right, bottom = p
+    arr = _as_hwc(img)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((top, bottom), (left, right), (0, 0)), mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Positive angle = counter-clockwise (matching RandomRotation and the
+    PIL convention the reference wraps)."""
+    from scipy import ndimage
+
+    arr = _as_hwc(img)
+    order = 0 if interpolation == "nearest" else 1
+    return ndimage.rotate(arr, angle, axes=(0, 1), reshape=expand,
+                          order=order, cval=fill).astype(
+                              np.asarray(img).dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def _img_ceiling(img):
+    return 1.0 if np.issubdtype(np.asarray(img).dtype, np.floating) else 255
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _as_hwc(img).astype(np.float32) * brightness_factor
+    return np.clip(arr, 0, _img_ceiling(img)).astype(np.asarray(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_hwc(img).astype(np.float32)
+    # contrast pivots on the grayscale mean (reference semantics)
+    gray = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+            ) if arr.shape[2] == 3 else arr[..., 0]
+    mean = gray.mean()
+    out = (arr - mean) * contrast_factor + mean
+    return np.clip(out, 0, _img_ceiling(img)).astype(np.asarray(img).dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via HSV round trip.
+
+    uint8 inputs are treated as [0, 255]; float inputs as [0, 1] (no
+    quantization on the way out)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    raw = _as_hwc(img)
+    is_float = np.issubdtype(np.asarray(raw).dtype, np.floating)
+    arr = raw.astype(np.float32) / (1.0 if is_float else 255.0)
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    diff = maxc - minc
+    s = np.where(maxc > 0, diff / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.where(diff == 0, 1.0, diff)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(diff == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    pch = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, pch, pch, t, v])
+    g2 = np.choose(i, [t, v, v, q, pch, pch])
+    b2 = np.choose(i, [pch, pch, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if is_float:
+        return np.clip(out, 0.0, 1.0).astype(np.asarray(img).dtype)
+    return np.clip(np.round(out * 255.0), 0, 255).astype(
+        np.asarray(img).dtype)

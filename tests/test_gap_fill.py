@@ -466,3 +466,209 @@ class TestIncubateR2:
         # k=1: every minimize blends halfway between init and fast weights
         assert opt._steps == 1 and opt._slow
         assert not np.allclose(net.weight.numpy(), w_init)
+
+
+class TestNamespaceFillsR2:
+    """Round-2 namespace completion: vision top-level, device.cuda,
+    autograd functional, static extras, distributed split/ParallelMode,
+    jit compat (reference export lists of each package)."""
+
+    def test_vision_top_level(self):
+        import paddle_tpu.vision as V
+
+        for n in ("LeNet", "MNIST", "Compose", "ColorJitter", "adjust_hue",
+                  "resnext50_64x4d", "shufflenet_v2_x2_0", "densenet264",
+                  "image_load", "to_grayscale", "rotate"):
+            assert hasattr(V, n), n
+        img = (np.random.rand(6, 6, 3) * 255).astype("uint8")
+        assert (V.adjust_hue(img, 0.0) == img).all()
+        # float images stay continuous in [0, 1] — no 255 scaling/rounding
+        fimg = np.random.rand(6, 6, 3).astype(np.float32) * 0.8
+        fout = V.adjust_hue(fimg, 0.1)
+        assert fout.dtype == np.float32 and fout.max() <= 1.0
+        assert np.abs(np.sort(fout.max(-1).ravel())
+                      - np.sort(fimg.max(-1).ravel())).max() < 1e-5
+        np.testing.assert_allclose(V.adjust_hue(fimg, 0.0), fimg,
+                                   atol=1e-6)
+        # rotate matches RandomRotation's direction (counter-clockwise)
+        marker = np.zeros((5, 5, 3), np.uint8)
+        marker[0, 4] = 255
+        ccw = V.rotate(marker, 90)
+        assert ccw[0, 0].max() == 255  # top-right -> top-left
+        assert V.adjust_brightness(img, 2.0).max() >= img.max()
+        g = V.to_grayscale(img)
+        assert g.shape == (6, 6, 1)
+        r = V.rotate(img, 90)
+        assert r.shape == img.shape
+        assert V.pad(img, 1).shape == (8, 8, 3)
+
+    def test_vision_model_variants_forward(self):
+        import paddle_tpu.vision as V
+
+        x = paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype(np.float32))
+        m = V.shufflenet_v2_x0_25(num_classes=4)
+        assert m(x).shape == [1, 4]
+
+    def test_device_cuda_namespace(self):
+        import paddle_tpu.device as dev
+
+        assert dev.get_cudnn_version() is None
+        assert isinstance(dev.cuda.get_device_name(), str)
+        assert dev.cuda.get_device_capability() == (0, 0)
+        props = dev.cuda.get_device_properties()
+        assert hasattr(props, "total_memory")
+        with dev.cuda.stream_guard(dev.cuda.current_stream()):
+            pass
+        assert dev.get_all_custom_device_type() == []
+
+    def test_autograd_functional(self):
+        from paddle_tpu import autograd as ag
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        f = lambda t: (t * t).sum()
+        _, g = ag.vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+        _, t = ag.jvp(f, x, paddle.to_tensor(
+            np.array([1.0, 0.0], np.float32)))
+        assert float(t.numpy()) == 2.0
+        J = ag.Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]))
+        np.testing.assert_allclose(J[0, 0].numpy(), 2.0)
+        H = ag.Hessian(f, x)
+        np.testing.assert_allclose(H.numpy(), np.eye(2) * 2)
+        np.testing.assert_allclose(
+            ag.jacobian(lambda t: t * 3.0, x).numpy(), np.eye(2) * 3)
+        np.testing.assert_allclose(
+            ag.hessian(f, x).numpy(), np.eye(2) * 2)
+        # multi-input (different sizes): flattened block forms
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J2 = ag.Jacobian(lambda u, v: (u * u).sum() + (v ** 3).sum(),
+                         [a, b])
+        assert J2.shape == (1, 5)
+        np.testing.assert_allclose(J2.numpy(),
+                                   [[2, 4, 3, 12, 27]], rtol=1e-5)
+        H2 = ag.Hessian(lambda u, v: (u * u).sum() + (v ** 3).sum(),
+                        [a, b])
+        assert H2.shape == (5, 5)
+        np.testing.assert_allclose(np.diag(H2.numpy()),
+                                   [2, 2, 6, 12, 18], rtol=1e-5)
+
+    def test_static_ema(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import static
+
+        net = nn.Linear(3, 3)
+        ema = static.ExponentialMovingAverage(0.5).track(net.parameters())
+        w0 = net.weight.numpy().copy()
+        ema.update()
+        net.weight.set_value(paddle.to_tensor(w0 + 1.0))
+        ema.update()
+        with ema.apply():
+            applied = net.weight.numpy().copy()
+        np.testing.assert_allclose(net.weight.numpy(), w0 + 1.0)
+        # shadow is between w0 and w0+1
+        assert (applied >= w0 - 1e-6).all() and \
+            (applied <= w0 + 1.0 + 1e-6).all()
+
+    def test_static_places_and_strategies(self):
+        from paddle_tpu import static
+
+        assert static.cpu_places(3)[2].device_id == 2
+        assert len(static.cuda_places([0])) == 1
+        bs = static.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        es = static.ExecutionStrategy()
+        es.num_threads = 4
+        assert static.WeightNormParamAttr(dim=0).dim == 0
+
+    def test_static_program_state_roundtrip(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 4], "float32")
+                lin = nn.Linear(4, 3)
+                out = lin(x)
+            exe = static.Executor()
+            exe.run(startup)
+            static.save_vars(exe, str(tmp_path), main)
+            state = static.load_program_state(str(tmp_path))
+            assert len(state) >= 2  # weight + bias
+            static.set_program_state(main, state)
+            data = static.serialize_persistables([x], [out], main)
+            static.deserialize_persistables(main, data)
+        finally:
+            paddle.disable_static()
+
+    def test_distributed_parallel_mode_and_gloo_names(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        assert callable(dist.gloo_init_parallel_env)
+        assert callable(dist.split)
+
+    def test_jit_compat(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import jit
+
+        assert jit.declarative is jit.to_static
+        net = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out, traced = jit.TracedLayer.trace(net, [x])
+        np.testing.assert_allclose(traced(x).numpy(), net(x).numpy(),
+                                   rtol=1e-6)
+        pt = jit.ProgramTranslator.get_instance()
+        calls = []
+
+        @jit.to_static
+        def fn(v):
+            calls.append(1)  # python side effect visible only eagerly
+            return v * 2
+
+        fn(x)
+        n_compiled = len(calls)
+        pt.enable(False)
+        try:
+            fn(x)
+            fn(x)
+            assert len(calls) == n_compiled + 2  # ran eagerly every call
+        finally:
+            pt.enable(True)
+
+    def test_distribution_exponential_family(self):
+        import jax.numpy as jnp
+
+        import paddle_tpu.distribution as D
+
+        class NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.asarray(loc)
+                self.scale = jnp.asarray(scale)
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * np.log(2 * np.pi)
+
+        ent = float(NormalEF(0.0, 2.0).entropy().numpy())
+        np.testing.assert_allclose(ent, 0.5 * np.log(2 * np.pi * np.e * 4),
+                                   rtol=1e-5)
+        # batched parameters: per-element entropies, correct shape
+        bent = NormalEF(np.zeros(3, np.float32),
+                        np.array([1.0, 2.0, 3.0], np.float32)
+                        ).entropy().numpy()
+        want = 0.5 * np.log(2 * np.pi * np.e
+                            * np.array([1.0, 4.0, 9.0]))
+        np.testing.assert_allclose(bent, want, rtol=1e-5)
