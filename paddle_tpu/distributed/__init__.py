@@ -30,6 +30,8 @@ from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
                        SharedLayerDesc, gpipe_spmd)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .heter import ProcessGroupHeter  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import global_gather, global_scatter  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from ..kernels.ring_attention import ring_attention  # noqa: F401
 from ..kernels.ulysses_attention import ulysses_attention  # noqa: F401

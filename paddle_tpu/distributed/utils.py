@@ -1,0 +1,103 @@
+"""paddle.distributed.utils (reference:
+python/paddle/distributed/utils.py — global_scatter:57 / global_gather:179
+over the global_scatter/global_gather collective ops used by MoE token
+routing).
+
+TPU-native shape: the reference ops move ragged per-expert token counts
+with an MPI-style alltoallv.  XLA wants static shapes, so the routing
+contract here is capacity-padded (the GShard formulation the MoE layer
+uses — distributed/moe.py): tokens are laid out [world * n_local_expert,
+capacity, d] and a single all_to_all over the expert-parallel axis swaps
+the expert dim across ranks.  local_count/global_count are accepted for
+API parity; when they are concrete they are sanity-checked against the
+row count (the padded layout itself carries the routing, so ragged
+counts have no effect beyond that check).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor, to_tensor
+from .collective import _axis_in_scope, _group_axis
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _resolve_axis(group):
+    """The mesh axis to route over: the group's axis if given, else the
+    first in-scope candidate — conventionally "ep" (expert parallel),
+    falling back to the global mesh's single axis or "world"."""
+    candidates = []
+    if group is not None:
+        candidates.append(group.axis_name)
+    else:
+        candidates.extend(["ep", "expert"])
+        candidates.append(_group_axis(None))
+        candidates.append("world")
+    for ax in candidates:
+        if ax is not None and _axis_in_scope(ax):
+            return ax
+    return None
+
+
+def _check_counts(x, counts, name):
+    if counts is None:
+        return
+    import numpy as np
+
+    vals = counts.numpy() if hasattr(counts, "numpy") else counts
+    try:
+        total = int(np.sum(np.asarray(vals)))
+    except Exception:  # traced counts: nothing to check statically
+        return
+    if total != int(x.shape[0]):
+        raise ValueError(
+            f"{name}: counts sum to {total} but x has {x.shape[0]} rows — "
+            f"this API routes by the capacity-padded layout; pad each "
+            f"expert chunk to capacity")
+
+
+def _routed_all_to_all(op_name, x, group):
+    """Shared scatter/gather body: they are the same involution over the
+    expert-parallel axis, differing only in direction-of-meaning."""
+    ax = _resolve_axis(group)
+    xt = _t(x)
+    if ax is None:
+        # single-rank world: routing is the identity (all experts local)
+        return xt
+
+    def _fn(v):
+        return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    return apply(op_name, _fn, xt)
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   use_calc_stream=True):
+    """Distribute capacity-padded expert batches to their owning ranks.
+
+    x: [n_expert_global * capacity, d] (rank-local tokens grouped by
+    destination expert, capacity-padded).  Returns the tokens this rank's
+    experts receive from every rank: same shape, expert-major."""
+    _check_counts(x, local_count, "global_scatter")
+    return _routed_all_to_all("global_scatter", x, group)
+
+
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: return expert outputs to the ranks that
+    own the corresponding tokens."""
+    _check_counts(x, local_count, "global_gather")
+    return _routed_all_to_all("global_gather", x, group)
+
+
+def get_cluster_from_args(args, selected_gpus=None):  # pragma: no cover
+    """Launcher helper parity (reference utils.get_cluster_from_args);
+    endpoint planning lives in distributed.launch here."""
+    raise NotImplementedError(
+        "use paddle_tpu.distributed.launch (python -m "
+        "paddle_tpu.distributed.launch) for process planning")
