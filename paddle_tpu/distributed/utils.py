@@ -48,14 +48,15 @@ def _check_counts(x, counts, name):
         return
     import numpy as np
 
-    vals = counts.numpy() if hasattr(counts, "numpy") else counts
     try:
+        vals = counts.numpy() if hasattr(counts, "numpy") else counts
         total = int(np.sum(np.asarray(vals)))
     except Exception:  # traced counts: nothing to check statically
         return
-    if total != int(x.shape[0]):
+    rows = int(x.shape[0])
+    if total != rows:
         raise ValueError(
-            f"{name}: counts sum to {total} but x has {x.shape[0]} rows — "
+            f"{name}: counts sum to {total} but x has {rows} rows — "
             f"this API routes by the capacity-padded layout; pad each "
             f"expert chunk to capacity")
 
@@ -83,16 +84,19 @@ def global_scatter(x, local_count=None, global_count=None, group=None,
     x: [n_expert_global * capacity, d] (rank-local tokens grouped by
     destination expert, capacity-padded).  Returns the tokens this rank's
     experts receive from every rank: same shape, expert-major."""
-    _check_counts(x, local_count, "global_scatter")
-    return _routed_all_to_all("global_scatter", x, group)
+    xt = _t(x)
+    _check_counts(xt, local_count, "global_scatter")
+    return _routed_all_to_all("global_scatter", xt, group)
 
 
 def global_gather(x, local_count=None, global_count=None, group=None,
                   use_calc_stream=True):
     """Inverse of global_scatter: return expert outputs to the ranks that
-    own the corresponding tokens."""
-    _check_counts(x, local_count, "global_gather")
-    return _routed_all_to_all("global_gather", x, group)
+    own the corresponding tokens.  x here holds the tokens this rank
+    RECEIVED, so global_count (not local_count) describes its rows."""
+    xt = _t(x)
+    _check_counts(xt, global_count, "global_gather")
+    return _routed_all_to_all("global_gather", xt, group)
 
 
 def get_cluster_from_args(args, selected_gpus=None):  # pragma: no cover

@@ -25,7 +25,7 @@ __all__ = [
     "ConcatDataset", "ChainDataset", "Subset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader",
-    "get_worker_info", "default_collate_fn",
+    "DeviceLoader", "get_worker_info", "default_collate_fn",
 ]
 
 
@@ -524,3 +524,65 @@ class DataLoader:
                     w.terminate()
             if arena is not None:
                 arena.destroy()
+
+
+class DeviceLoader:
+    """Device-prefetching wrapper: the host->HBM infeed half of the
+    reference's double-buffered reader (buffered_reader.cc keeps N batches
+    resident on device ahead of compute).  Wrap any iterable of batches;
+    each batch is jax.device_put'd (optionally with a sharding) while the
+    previous one is being consumed, so transfers overlap the step.
+
+        for x, y in DeviceLoader(loader, buffer_size=2):
+            loss = train_step(x, y)
+    """
+
+    def __init__(self, loader, buffer_size=2, sharding=None, device=None):
+        self.loader = loader
+        self.buffer_size = max(1, int(buffer_size))
+        self.sharding = sharding
+        self.device = device
+
+    def _place(self, batch):
+        import jax
+
+        from ..core.tensor import Tensor
+
+        target = self.sharding or self.device
+
+        def put(v):
+            raw = v._value if isinstance(v, Tensor) else v
+            arr = jax.device_put(raw, target) if target is not None \
+                else jax.device_put(raw)
+            return Tensor(arr)
+
+        if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+            return type(batch)(*map(put, batch))  # namedtuple
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(put(v) for v in batch)
+        if isinstance(batch, dict):
+            return {k: put(v) for k, v in batch.items()}
+        return put(batch)
+
+    def __iter__(self):
+        from collections import deque
+
+        buf = deque()
+        it = iter(self.loader)
+        try:
+            for _ in range(self.buffer_size):
+                buf.append(self._place(next(it)))
+        except StopIteration:
+            pass
+        while buf:
+            out = buf.popleft()
+            try:
+                # enqueue the next transfer BEFORE yielding: device_put is
+                # async, so it overlaps the consumer's compute
+                buf.append(self._place(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+    def __len__(self):
+        return len(self.loader)

@@ -73,6 +73,55 @@ class Imdb(_LocalFileDataset):
                     self.samples.append((text, np.asarray(label, np.int64)))
 
 
+class Imikolov(_LocalFileDataset):
+    """N-gram windows over the PTB-style imikolov corpus (reference:
+    python/paddle/text/datasets/imikolov.py).  data_file: a text file of
+    whitespace-tokenized sentences; yields (context..., target) id tuples
+    over a min-frequency vocabulary like the reference."""
+
+    name = "imikolov (simple-examples text file)"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kwargs):
+        if str(data_type).upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type must be NGRAM or SEQ, got "
+                             f"{data_type!r}")
+        self.data_type = data_type
+        self.window_size = int(window_size)
+        self.min_word_freq = int(min_word_freq)
+        super().__init__(data_file=data_file, mode=mode, **kwargs)
+
+    def _load(self):
+        from collections import Counter
+
+        lines = []
+        with open(self.data_file, "r", encoding="utf-8",
+                  errors="ignore") as f:
+            for line in f:
+                toks = line.strip().split()
+                if toks:
+                    lines.append(toks)
+        freq = Counter(w for toks in lines for w in toks)
+        vocab = {"<unk>": 0, "<s>": 1, "<e>": 2}
+        for w, c in sorted(freq.items()):
+            if c >= self.min_word_freq and w not in vocab:
+                vocab[w] = len(vocab)
+        self.word_idx = vocab
+        unk = vocab["<unk>"]
+        self.samples = []
+        for toks in lines:
+            ids = [vocab["<s>"]] + [vocab.get(w, unk) for w in toks]                 + [vocab["<e>"]]
+            if self.data_type.upper() == "NGRAM":
+                n = self.window_size
+                for i in range(len(ids) - n + 1):
+                    self.samples.append(tuple(
+                        np.asarray(v, np.int64) for v in ids[i:i + n]))
+            else:  # SEQ: (input, shifted-target) pairs
+                self.samples.append(
+                    (np.asarray(ids[:-1], np.int64),
+                     np.asarray(ids[1:], np.int64)))
+
+
 class WMT14(_LocalFileDataset):
     name = "wmt14"
 

@@ -310,3 +310,18 @@ class TestDistModel:
         sh2 = dm2.last_input_shardings[0]
         assert sh2 is None or sh2.is_fully_replicated or \
             len(sh2.device_set) == 1
+
+
+class TestImikolov:
+    def test_ngram_and_seq(self, tmp_path):
+        import paddle_tpu.text as t
+
+        p = tmp_path / "corpus.txt"
+        p.write_text("the cat sat on the mat\n" * 60)
+        ds = t.Imikolov(str(p), window_size=3, min_word_freq=10)
+        assert len(ds) > 0 and len(ds[0]) == 3
+        seq = t.Imikolov(str(p), data_type="SEQ", min_word_freq=10)
+        x, y = seq[0]
+        np.testing.assert_array_equal(x[1:], y[:-1])
+        # rare words collapse to <unk>
+        assert "<unk>" in ds.word_idx
