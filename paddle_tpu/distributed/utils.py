@@ -61,11 +61,11 @@ def _check_counts(x, counts, name):
             f"expert chunk to capacity")
 
 
-def _routed_all_to_all(op_name, x, group):
+def _routed_all_to_all(op_name, xt, group):
     """Shared scatter/gather body: they are the same involution over the
-    expert-parallel axis, differing only in direction-of-meaning."""
+    expert-parallel axis, differing only in direction-of-meaning.
+    Callers pass an already-converted Tensor."""
     ax = _resolve_axis(group)
-    xt = _t(x)
     if ax is None:
         # single-rank world: routing is the identity (all experts local)
         return xt

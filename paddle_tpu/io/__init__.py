@@ -585,4 +585,8 @@ class DeviceLoader:
             yield out
 
     def __len__(self):
-        return len(self.loader)
+        try:
+            return len(self.loader)
+        except TypeError:
+            raise TypeError(
+                "DeviceLoader wraps a len-less iterable; iterate instead")

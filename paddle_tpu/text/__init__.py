@@ -11,7 +11,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "WMT14", "WMT16", "Conll05st", "Movielens",
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16", "Conll05st",
+           "Movielens",
            "BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
            "ViterbiDecoder", "viterbi_decode"]
 

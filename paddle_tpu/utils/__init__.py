@@ -46,3 +46,96 @@ class deprecated:
 
     def __call__(self, fn):
         return fn
+
+
+def require_version(min_version, max_version=None):
+    """Assert the installed framework version is in range (reference:
+    python/paddle/utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def key(v):
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))  # zero-pad: 0.1==0.1.0
+
+    cur = key(__version__)
+    if key(min_version) > cur:
+        raise Exception(
+            f"version {min_version} required, installed {__version__}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"version <= {max_version} required, installed {__version__}")
+    return True
+
+
+class unique_name:
+    """Name generator namespace (reference:
+    python/paddle/utils/unique_name.py generate/guard/switch)."""
+
+    _counters = {}
+    _prefix = []
+
+    @classmethod
+    def generate(cls, key):
+        full = "/".join(cls._prefix + [key]) if cls._prefix else key
+        n = cls._counters.get(full, 0)
+        cls._counters[full] = n + 1
+        return f"{full}_{n}"
+
+    @classmethod
+    def switch(cls, new_generator=None):
+        """Swap the counter state; pass a previously returned state to
+        restore it (reference switch/restore idiom)."""
+        old = (dict(cls._counters), list(cls._prefix))
+        if new_generator is None:
+            cls._counters = {}
+            cls._prefix = []
+        else:
+            counters, prefix = new_generator
+            cls._counters = dict(counters)
+            cls._prefix = list(prefix)
+        return old
+
+    @classmethod
+    def guard(cls, new_generator=None):
+        from contextlib import contextmanager
+
+        @contextmanager
+        def ctx():
+            saved = dict(cls._counters)
+            prefix_saved = list(cls._prefix)
+            if new_generator:
+                cls._prefix.append(str(new_generator).rstrip("_"))
+            cls._counters = {}
+            try:
+                yield
+            finally:
+                cls._counters = saved
+                cls._prefix = prefix_saved
+
+        return ctx()
+
+
+class download:
+    """paddle.utils.download (reference: python/paddle/utils/download.py).
+    No network egress in this environment: resolution is cache-only —
+    get_weights_path_from_url returns the cached file when present and
+    raises with instructions otherwise."""
+
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        import os
+
+        cache = os.environ.get(
+            "PADDLE_TPU_WEIGHTS_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "weights"))
+        fname = url.split("/")[-1]
+        path = os.path.join(cache, fname)
+        if os.path.exists(path):
+            return path
+        raise RuntimeError(
+            f"no network egress: place {fname} under {cache} (from {url})")
+
+
+from . import dlpack  # noqa: E402,F401
+from .dlpack import from_dlpack, to_dlpack  # noqa: E402,F401

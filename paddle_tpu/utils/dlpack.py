@@ -13,9 +13,28 @@ def to_dlpack(x: Tensor):
         else x._value.__dlpack__()
 
 
+class _CapsuleHolder:
+    """Adapter for RAW PyCapsules (torch.utils.dlpack.to_dlpack returns
+    one): newer jax/numpy only accept objects with __dlpack__/
+    __dlpack_device__.  A capsule carries no device info, so this assumes
+    host memory (kDLCPU) — raw-capsule handoff between frameworks is a
+    host-side path; device arrays come in as __dlpack__-bearing objects."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU, device 0
+
+
 def from_dlpack(capsule) -> Tensor:
     if hasattr(capsule, "__dlpack__"):
         arr = jnp.from_dlpack(capsule)
-    else:
-        arr = jax.dlpack.from_dlpack(capsule)
+    else:  # raw PyCapsule
+        import numpy as np
+
+        arr = jnp.asarray(np.from_dlpack(_CapsuleHolder(capsule)))
     return Tensor(arr)

@@ -325,3 +325,59 @@ class TestImikolov:
         np.testing.assert_array_equal(x[1:], y[:-1])
         # rare words collapse to <unk>
         assert "<unk>" in ds.word_idx
+
+
+class TestUtilsFills:
+    """paddle.utils parity (reference: python/paddle/utils/__init__.py):
+    unique_name, require_version, dlpack interop, cache-only download."""
+
+    def test_unique_name(self):
+        import paddle_tpu.utils as u
+
+        a = u.unique_name.generate("w")
+        b = u.unique_name.generate("w")
+        assert a != b and a.startswith("w_")
+        with u.unique_name.guard("blk"):
+            c = u.unique_name.generate("w")
+            assert c.startswith("blk/w")
+        d = u.unique_name.generate("w")
+        assert d != a and d != b
+        # switch/restore idiom: restoring old state avoids collisions
+        old = u.unique_name.switch()
+        fresh = u.unique_name.generate("w")
+        assert fresh == "w_0"
+        u.unique_name.switch(old)
+        e = u.unique_name.generate("w")
+        assert e not in (a, b, d)
+
+    def test_require_version(self):
+        import paddle_tpu.utils as u
+
+        assert u.require_version("0.0.1")
+        with pytest.raises(Exception):
+            u.require_version("99.0")
+        # zero-padded comparison: 0.1 == 0.1.0
+        assert u.require_version("0.0.1", max_version="0.1")
+
+    def test_dlpack_torch_roundtrip(self):
+        import torch
+
+        import paddle_tpu.utils as u
+
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        tt = torch.utils.dlpack.from_dlpack(u.to_dlpack(t))
+        assert float(tt.sum()) == 15.0
+        back = u.from_dlpack(torch.utils.dlpack.to_dlpack(torch.ones(2, 2)))
+        assert float(back.sum().numpy()) == 4.0
+        back2 = u.from_dlpack(torch.full((3,), 2.0))
+        assert float(back2.sum().numpy()) == 6.0
+
+    def test_download_cache_only(self, tmp_path, monkeypatch):
+        import paddle_tpu.utils as u
+
+        monkeypatch.setenv("PADDLE_TPU_WEIGHTS_CACHE", str(tmp_path))
+        with pytest.raises(RuntimeError, match="no network egress"):
+            u.download.get_weights_path_from_url("http://x/y/model.pdparams")
+        (tmp_path / "model.pdparams").write_bytes(b"123")
+        p = u.download.get_weights_path_from_url("http://x/y/model.pdparams")
+        assert p.endswith("model.pdparams")
