@@ -5,3 +5,5 @@ from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, BertModel)
 from .gpt_moe import MoEConfig, MoEForCausalLM  # noqa: F401
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
+from . import generation  # noqa: F401
+from .generation import generate  # noqa: F401

@@ -1,0 +1,152 @@
+"""Autoregressive decoding over KV caches (reference capability:
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu decode path +
+the sampling ops top_k_op/top_p_sampling; the high-level loop lives in
+PaddleNLP's GenerationMixin, whose API this mirrors).
+
+Works with any causal LM exposing the cache contract
+``model(input_ids, caches=..., position_offset=...) -> (logits, caches)``
+with per-layer (k, v) tuples that grow by concat (models/llama.py).
+The token loop runs on host (one compiled step per shape, like eager
+serving); each step's math is jit-compiled by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+def _empty_caches(model, batch):
+    cfg = model.config
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    kv_heads = getattr(cfg, "num_key_value_heads", None) \
+        or cfg.num_attention_heads
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    empty = jnp.zeros((batch, 0, kv_heads, head_dim), dtype)
+    return [(Tensor(empty), Tensor(empty))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+def _select_token(logits, *, do_sample, temperature, top_k, top_p, key):
+    """logits: [B, V] fp32 -> token ids [B]."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if temperature and temperature != 1.0:
+        logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p (keep the first token
+        # crossing the threshold)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _gather_caches(caches, idx):
+    return [(Tensor(c[0]._value[idx]), Tensor(c[1]._value[idx]))
+            for c in caches]
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
+             eos_token_id=None, seed=None):
+    """Decode continuations for a batch of prompts.
+
+    Returns [B, T_prompt + T_new] token ids (beam search returns the best
+    beam per batch element).  Greedy by default; ``do_sample`` enables
+    temperature/top-k/top-p sampling; ``num_beams > 1`` switches to beam
+    search with length-agnostic log-prob scores."""
+    from ..core.dispatch import no_grad_ctx
+    from ..ops import random as rnd
+
+    ids = np.asarray(input_ids.numpy() if hasattr(input_ids, "numpy")
+                     else input_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    B, T0 = ids.shape
+    with no_grad_ctx():
+        if num_beams > 1:
+            return _beam_generate(model, ids, max_new_tokens, num_beams,
+                                  eos_token_id)
+        # seed=None draws from the framework RNG stream (paddle.seed)
+        key = rnd.next_key() if seed is None else jax.random.PRNGKey(seed)
+        caches = _empty_caches(model, B)
+        logits, caches = model(to_tensor(ids.astype(np.int32)),
+                               caches=caches, position_offset=0)
+        out = [ids]
+        finished = np.zeros((B,), bool)
+        for step in range(max_new_tokens):
+            last = logits._value[:, -1].astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            tok = _select_token(last, do_sample=do_sample,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p, key=sub)
+            tok_np = np.asarray(tok)
+            if eos_token_id is not None:
+                tok_np = np.where(finished, eos_token_id, tok_np)
+                finished |= tok_np == eos_token_id
+            out.append(tok_np[:, None])
+            if eos_token_id is not None and finished.all():
+                break
+            cur = to_tensor(tok_np[:, None].astype(np.int32))
+            logits, caches = model(cur, caches=caches,
+                                   position_offset=T0 + step)
+        return to_tensor(np.concatenate(out, axis=1))
+
+
+def _beam_generate(model, ids, max_new_tokens, beams, eos_token_id):
+    B, T0 = ids.shape
+    BV = B * beams
+    # prefill once per prompt, then replicate caches across beams
+    caches = _empty_caches(model, B)
+    logits, caches = model(to_tensor(ids.astype(np.int32)), caches=caches,
+                           position_offset=0)
+    rep = jnp.repeat(jnp.arange(B), beams)
+    caches = _gather_caches(caches, rep)
+    last = jnp.repeat(logits._value[:, -1].astype(jnp.float32), beams,
+                      axis=0)                      # [B*beams, V]
+    scores = jnp.tile(jnp.asarray([0.0] + [-1e9] * (beams - 1)), (B,))
+    tokens_acc = []     # list of [B*beams] arrays
+    parents_acc = []
+    finished = jnp.zeros((BV,), bool)
+    V = last.shape[-1]
+    end_only = None
+    if eos_token_id is not None:
+        end_only = jnp.full((V,), -1e9).at[eos_token_id].set(0.0)
+    for step in range(max_new_tokens):
+        logp = jax.nn.log_softmax(last, axis=-1)
+        if end_only is not None:
+            logp = jnp.where(finished[:, None], end_only, logp)
+        total = (scores[:, None] + logp).reshape(B, beams * V)
+        top_scores, top_idx = jax.lax.top_k(total, beams)   # [B, beams]
+        parents = (top_idx // V + jnp.arange(B)[:, None] * beams).reshape(-1)
+        toks = (top_idx % V).reshape(-1)
+        scores = top_scores.reshape(-1)
+        caches = _gather_caches(caches, parents)
+        if eos_token_id is not None:
+            finished = finished[parents] | (toks == eos_token_id)
+        tokens_acc.append(np.asarray(toks))
+        parents_acc.append(np.asarray(parents))
+        if eos_token_id is not None and bool(finished.all()):
+            break
+        cur = to_tensor(np.asarray(toks)[:, None].astype(np.int32))
+        logits, caches = model(cur, caches=caches,
+                               position_offset=T0 + step)
+        last = logits._value[:, -1].astype(jnp.float32)
+    # backtrace best beam (beam 0 holds the max score after top_k)
+    T = len(tokens_acc)
+    seq = np.zeros((BV, T), np.int64)
+    cursor = np.arange(BV)
+    for t in range(T - 1, -1, -1):
+        seq[:, t] = tokens_acc[t][cursor]
+        cursor = parents_acc[t][cursor]
+    best = seq.reshape(B, beams, T)[:, 0]
+    return to_tensor(np.concatenate([ids, best], axis=1))

@@ -382,44 +382,30 @@ class LlamaForCausalLM(nn.Layer):
 
     # --------------------------------------------------------- generation
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k: Optional[int] = None):
-        """Greedy/temperature decode with KV cache (eager loop)."""
-        from .. import ops
-        from ..core.dispatch import no_grad_ctx
-        from ..ops import random as rnd
+                 top_k: Optional[int] = None, top_p: float = 1.0,
+                 do_sample: Optional[bool] = None, num_beams: int = 1,
+                 eos_token_id: Optional[int] = None, seed=None):
+        """Decode with the KV cache (models/generation.py): greedy,
+        temperature/top-k/top-p sampling, or beam search.
 
+        Back-compat: temperature==0.0 means greedy (the old contract);
+        otherwise sampling is on unless do_sample=False."""
+        from ..core.dispatch import no_grad_ctx
+        from .generation import generate as _generate
+
+        if temperature == 0.0:
+            # the documented greedy contract wins over do_sample=True
+            do_sample = False
+            temperature = 1.0
+        if do_sample is None:
+            do_sample = True
+        if do_sample and num_beams > 1:
+            raise ValueError(
+                "sampling + beam search is not supported; pass "
+                "do_sample=False (or temperature=0.0) with num_beams>1")
         with no_grad_ctx():
-            B, T = input_ids.shape
-            caches = [(Tensor(jnp.zeros(
-                (B, 0, self.config.num_key_value_heads,
-                 self.config.hidden_size // self.config.num_attention_heads),
-                self.model.embed_tokens.weight._value.dtype)),) * 2
-                for _ in range(self.config.num_hidden_layers)]
-            caches = [tuple(c) for c in caches]
-            logits, caches = self.forward(input_ids, caches=caches,
-                                          position_offset=0)
-            out_tokens = [input_ids]
-            cur = T
-            last = logits[:, -1]
-            for _ in range(max_new_tokens):
-                if temperature == 0.0:
-                    nxt = ops.argmax(last, axis=-1).astype("int32")
-                else:
-                    scaled = last / temperature
-                    if top_k:
-                        vals, _ = ops.topk(scaled, top_k, axis=-1)
-                        kth = vals[:, -1:]
-                        scaled = ops.where(scaled < kth,
-                                           ops.full_like(scaled, -1e30),
-                                           scaled)
-                    key = rnd.next_key()
-                    nxt = Tensor(jax.random.categorical(
-                        key, scaled._value.astype(jnp.float32)).astype(
-                            jnp.int32))
-                nxt = nxt.reshape([B, 1])
-                out_tokens.append(nxt)
-                logits, caches = self.forward(nxt, caches=caches,
-                                              position_offset=cur)
-                last = logits[:, -1]
-                cur += 1
-            return ops.concat(out_tokens, axis=1)
+            return _generate(
+                self, input_ids, max_new_tokens=max_new_tokens,
+                do_sample=do_sample, temperature=temperature,
+                top_k=top_k or 0, top_p=top_p, num_beams=num_beams,
+                eos_token_id=eos_token_id, seed=seed)

@@ -132,6 +132,15 @@ class download:
         fname = url.split("/")[-1]
         path = os.path.join(cache, fname)
         if os.path.exists(path):
+            if md5sum is not None:
+                import hashlib
+
+                with open(path, "rb") as f:
+                    digest = hashlib.md5(f.read()).hexdigest()
+                if digest != md5sum:
+                    raise RuntimeError(
+                        f"cached {fname} md5 {digest} != expected "
+                        f"{md5sum}; delete {path} and re-stage it")
             return path
         raise RuntimeError(
             f"no network egress: place {fname} under {cache} (from {url})")
