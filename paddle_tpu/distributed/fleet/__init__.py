@@ -21,6 +21,8 @@ from ..mesh import (CommunicateTopology, HybridCommunicateGroup, fleet_mesh,
 from .distributed_strategy import DistributedStrategy
 from .meta_optimizers import DGCMomentum, LocalSGDOptimizer  # noqa: F401
 from . import elastic  # noqa: F401
+from . import metrics  # noqa: F401
+from . import utils  # noqa: F401
 
 _FLEET = None
 

@@ -1,0 +1,79 @@
+"""fleet.metrics (reference:
+python/paddle/distributed/fleet/metrics/metric.py — global metric
+reduction over a gloo/NCCL allreduce: sum/max/min/auc/mae/rmse/acc).
+
+TPU-native: the reduction rides the normal collective path (XLA over the
+mesh inside shard_map; identity in a single-controller world, where the
+global view already includes every shard).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+from ..collective import ReduceOp, all_reduce
+
+
+def _reduce(value, op):
+    t = value if isinstance(value, Tensor) else to_tensor(
+        np.asarray(value, np.float64).astype(np.float32))
+    all_reduce(t, op=op)
+    return t
+
+
+def sum(metric):  # noqa: A001 - reference uses the builtin-shadowing name
+    return _reduce(metric, ReduceOp.SUM)
+
+
+def max(metric):  # noqa: A001
+    return _reduce(metric, ReduceOp.MAX)
+
+
+def min(metric):  # noqa: A001
+    return _reduce(metric, ReduceOp.MIN)
+
+
+def mean(metric):
+    return _reduce(metric, ReduceOp.AVG)
+
+
+def acc(correct, total):
+    """Global accuracy: sum(correct) / sum(total) across ranks."""
+    c = _reduce(correct, ReduceOp.SUM)
+    t = _reduce(total, ReduceOp.SUM)
+    return to_tensor(np.asarray(c.numpy(), np.float64)
+                     / np.maximum(np.asarray(t.numpy(), np.float64), 1))
+
+
+def mae(abserr, total_ins_num):
+    """Global mean absolute error from per-rank absolute-error sums."""
+    e = _reduce(abserr, ReduceOp.SUM)
+    n = _reduce(total_ins_num, ReduceOp.SUM)
+    return to_tensor(np.asarray(e.numpy(), np.float64)
+                     / np.maximum(np.asarray(n.numpy(), np.float64), 1))
+
+
+def rmse(sqrerr, total_ins_num):
+    e = _reduce(sqrerr, ReduceOp.SUM)
+    n = _reduce(total_ins_num, ReduceOp.SUM)
+    return to_tensor(np.sqrt(np.asarray(e.numpy(), np.float64)
+                             / np.maximum(np.asarray(n.numpy(), np.float64),
+                                          1)))
+
+
+def auc(stat_pos, stat_neg):
+    """Global AUC from per-rank positive/negative threshold histograms
+    (the reference's confusion-matrix formulation)."""
+    pos = np.asarray(_reduce(stat_pos, ReduceOp.SUM).numpy(), np.float64)
+    neg = np.asarray(_reduce(stat_neg, ReduceOp.SUM).numpy(), np.float64)
+    # walk thresholds high->low accumulating tp/fp area
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return to_tensor(np.asarray(0.5, np.float64))
+    return to_tensor(np.asarray(area / (tp * fp), np.float64))

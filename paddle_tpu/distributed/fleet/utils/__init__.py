@@ -1,0 +1,65 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/ —
+recompute, LocalFS/HDFSClient file helpers used by checkpoint paths)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+from ...recompute import recompute, recompute_sequential  # noqa: F401
+
+
+class LocalFS:
+    """Local filesystem client (reference: fleet/utils/fs.py LocalFS) —
+    the subset the checkpoint paths use."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+
+class HDFSClient:  # pragma: no cover - no HDFS in a TPU pod's image
+    """Parity stub: HDFS is a PS-era dependency (SURVEY declares the PS
+    stack out of scope); checkpointing uses orbax/GCS-style paths."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(
+            "HDFS is not available; use LocalFS or a mounted filesystem")

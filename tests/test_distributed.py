@@ -1184,3 +1184,44 @@ class TestGlobalScatterGather:
         x = paddle.to_tensor(np.random.randn(6, 4).astype(np.float32))
         np.testing.assert_array_equal(global_scatter(x).numpy(), x.numpy())
         np.testing.assert_array_equal(global_gather(x).numpy(), x.numpy())
+
+
+class TestFleetMetrics:
+    """Global metric reduction (reference: fleet/metrics/metric.py):
+    world-1 identity semantics + AUC from threshold histograms."""
+
+    def test_scalar_reductions_world1(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+
+        assert float(M.sum(3.0).numpy()) == 3.0
+        assert float(M.max(np.array([2.0], np.float32)).numpy()) == 2.0
+        np.testing.assert_allclose(float(M.acc(8.0, 10.0).numpy()), 0.8)
+        np.testing.assert_allclose(float(M.mae(5.0, 10.0).numpy()), 0.5)
+        np.testing.assert_allclose(float(M.rmse(40.0, 10.0).numpy()), 2.0)
+
+    def test_auc_from_histograms(self):
+        from paddle_tpu.distributed.fleet import metrics as M
+
+        # perfect separation: positives at high thresholds only
+        pos = np.array([0.0, 0.0, 0.0, 10.0])
+        neg = np.array([10.0, 0.0, 0.0, 0.0])
+        assert float(M.auc(pos, neg).numpy()) == 1.0
+        # random: uniform histograms
+        pos = np.ones(4) * 5
+        neg = np.ones(4) * 5
+        np.testing.assert_allclose(float(M.auc(pos, neg).numpy()), 0.5)
+        # degenerate: no positives
+        assert float(M.auc(np.zeros(4), neg).numpy()) == 0.5
+
+    def test_fleet_utils_localfs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        from paddle_tpu.distributed.fleet import utils as fu
+
+        assert callable(fu.recompute)
+        fs = LocalFS()
+        fs.mkdirs(str(tmp_path / "sub"))
+        fs.touch(str(tmp_path / "a.txt"))
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ["sub"] and files == ["a.txt"]
+        fs.mv(str(tmp_path / "a.txt"), str(tmp_path / "b.txt"))
+        assert fs.is_file(str(tmp_path / "b.txt"))
