@@ -36,6 +36,8 @@ from .store import TCPStore  # noqa: F401
 from ..kernels.ring_attention import ring_attention  # noqa: F401
 from ..kernels.ulysses_attention import ulysses_attention  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import planner  # noqa: F401
+from .planner import CostModel, Planner  # noqa: F401
 from . import launch  # noqa: F401
 
 

@@ -64,6 +64,7 @@ class Engine:
         self.metrics = metrics or []
         self.strategy = strategy or Strategy()
         self._step_fn = None
+        self._plan = None
 
     def _build(self):
         from .. import jit
@@ -87,6 +88,14 @@ class Engine:
         self._eval_fn = jit.to_static(eval_step)
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        # full-auto mode: run the planner (reference Engine._plan ->
+        # planner.py search) before compiling the step
+        if self.strategy.auto_mode == "full" and self.model is not None:
+            mesh = get_mesh()
+            if mesh is not None:
+                from .planner import Planner
+
+                self._plan = Planner(mesh).apply(self.model)
         self._build()
 
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,

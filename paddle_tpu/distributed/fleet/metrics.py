@@ -14,9 +14,21 @@ from ...core.tensor import Tensor, to_tensor
 from ..collective import ReduceOp, all_reduce
 
 
-def _reduce(value, op):
-    t = value if isinstance(value, Tensor) else to_tensor(
-        np.asarray(value, np.float64).astype(np.float32))
+def _reduce(value, op, force_float=False):
+    """Reduce a COPY — the caller's running counter must not be
+    overwritten with the global value (all_reduce works in place)."""
+    from ..env import get_world_size
+
+    arr = np.asarray(value.numpy() if hasattr(value, "numpy") else value,
+                     np.float64)
+    # integral counters reduce as integers: float32 loses exactness above
+    # 2^24, which real instance counts exceed (int32 on device is exact
+    # to 2^31)
+    integral = not force_float and bool(np.all(arr == np.floor(arr))) \
+        and bool(np.all(np.abs(arr) < 2 ** 31))
+    if get_world_size() <= 1:
+        return to_tensor(arr.astype(np.int64) if integral else arr)
+    t = to_tensor(arr.astype(np.int64 if integral else np.float32))
     all_reduce(t, op=op)
     return t
 
@@ -34,7 +46,8 @@ def min(metric):  # noqa: A001
 
 
 def mean(metric):
-    return _reduce(metric, ReduceOp.AVG)
+    # AVG divides — integer reduction would truncate
+    return _reduce(metric, ReduceOp.AVG, force_float=True)
 
 
 def acc(correct, total):

@@ -14,6 +14,8 @@ class LocalFS:
 
     def ls_dir(self, path):
         dirs, files = [], []
+        if not os.path.exists(path):
+            return dirs, files  # reference LocalFS returns empty lists
         for name in sorted(os.listdir(path)):
             (dirs if os.path.isdir(os.path.join(path, name))
              else files).append(name)

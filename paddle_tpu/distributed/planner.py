@@ -1,0 +1,175 @@
+"""Auto-parallel planner + cost model (reference:
+python/paddle/distributed/auto_parallel/planner.py + cost_model.py —
+search over per-tensor dims_mappings scored by a comm/memory cost model,
+driven from Engine._plan).
+
+TPU-native shape: candidates are GSPMD PartitionSpecs over the live mesh
+axes instead of dims_mappings over process meshes, and the "reshard"
+penalties of the reference become collective-bytes estimates (XLA inserts
+the actual collectives).  The planner walks a Layer tree:
+
+- per-parameter candidates: replicated, or split along any divisible dim
+  over the model-parallel axis;
+- alpha-beta cost: gradient-sync bytes (allreduce for replicated params,
+  reduce-scatter fraction for sharded), activation collective bytes
+  implied by the split (column-split -> allgather of the output,
+  row-split -> allreduce of the output), and an HBM-pressure term that
+  pushes large params to shard once the per-device budget is exceeded;
+- Megatron pairing: consecutive Linear weights alternate column/row so
+  the intermediate activation stays sharded and the pair needs ONE
+  collective (the mp_layers pattern the manual API encodes by hand).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Candidate:
+    spec: tuple                 # PartitionSpec entries (None | axis name)
+    comm_bytes: float           # per-step collective traffic
+    mem_bytes: float            # per-device parameter memory
+
+    def cost(self, mem_pressure):
+        # alpha-beta: latency folded into a constant per collective;
+        # memory converts to cost only under pressure
+        return self.comm_bytes + mem_pressure * self.mem_bytes
+
+
+class CostModel:
+    """Per-candidate cost estimates (reference cost_model.py estimates
+    op runtime + transfer time on a cluster description; here bandwidth
+    ratios are all that matter for ranking, so bytes ARE the units)."""
+
+    LATENCY_BYTES = 128 * 1024  # alpha term per extra collective
+
+    def __init__(self, mesh, batch_tokens=4096):
+        self.mesh = mesh
+        self.batch_tokens = batch_tokens
+
+    def candidates(self, shape, dtype_size, axis="mp") -> List[Candidate]:
+        deg = self.mesh.shape.get(axis, 1)
+        n = int(np.prod(shape)) * dtype_size
+        out_features = shape[-1] if shape else 1
+        out: List[Candidate] = []
+        # replicated: dp grad allreduce moves ~2x param bytes; full copy
+        out.append(Candidate(spec=(None,) * len(shape),
+                             comm_bytes=2.0 * n, mem_bytes=float(n)))
+        if deg > 1:
+            for dim, size in enumerate(shape):
+                if size % deg:
+                    continue
+                spec = [None] * len(shape)
+                spec[dim] = axis
+                # sharded grads sync with a reduce-scatter (1/deg bytes);
+                # the activation collective depends on which matmul side
+                # the split cuts:
+                #   column split (last dim)  -> allgather the sharded
+                #       output: ~tokens * out/deg * (deg-1) bytes moved
+                #   row split (other dims)   -> allreduce the FULL-width
+                #       partial output: ~2 * tokens * out bytes
+                if len(shape) >= 2 and dim == len(shape) - 1:
+                    act = self.batch_tokens * (size // deg) * (deg - 1) \
+                        * dtype_size
+                elif len(shape) >= 2:
+                    act = 2.0 * self.batch_tokens * out_features * dtype_size
+                else:
+                    act = 0.0  # 1-D params ride their layer's collective
+                # alpha term: each extra collective costs fixed latency
+                # (bytes-equivalent), so tiny params prefer replication
+                out.append(Candidate(spec=tuple(spec),
+                                     comm_bytes=2.0 * n / deg + act
+                                     + self.LATENCY_BYTES,
+                                     mem_bytes=float(n) / deg))
+        return out
+
+
+class Planner:
+    """Pick a PartitionSpec per parameter (reference planner.py searches
+    dims_mapping assignments; the search here is greedy per-tensor with
+    the Megatron column/row pairing applied to Linear chains)."""
+
+    def __init__(self, mesh, mp_axis="mp", hbm_budget_bytes=None,
+                 batch_tokens=4096):
+        self.mesh = mesh
+        self.mp_axis = mp_axis
+        self.cost_model = CostModel(mesh, batch_tokens)
+        self.hbm_budget = hbm_budget_bytes
+
+    def _mem_pressure(self, total_param_bytes):
+        if not self.hbm_budget:
+            return 0.0
+        over = total_param_bytes / self.hbm_budget
+        return 0.0 if over <= 1.0 else 10.0 * (over - 1.0)
+
+    def plan(self, model) -> Dict[str, tuple]:
+        """name -> PartitionSpec entries for every parameter."""
+        from ..nn.layer.common import Embedding, Linear
+
+        params = list(model.named_parameters())
+
+        def itemsize(p):
+            try:
+                return int(np.dtype(str(p._value.dtype)).itemsize)
+            except TypeError:
+                return 2 if "bfloat16" in str(p._value.dtype) else 4
+
+        total = sum(int(np.prod(p.shape)) * itemsize(p) for _, p in params)
+        pressure = self._mem_pressure(total)
+        deg = self.mesh.shape.get(self.mp_axis, 1)
+
+        plan: Dict[str, tuple] = {}
+        # walk layers so Linear chains can alternate column/row
+        linear_parity = 0
+        for lname, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, Linear) and deg > 1:
+                w = layer.weight  # [in, out]
+                prefix = f"{lname}." if lname else ""
+                col = (None, self.mp_axis)
+                row = (self.mp_axis, None)
+                ok_col = w.shape[1] % deg == 0
+                ok_row = w.shape[0] % deg == 0
+                if ok_col and (linear_parity == 0 or not ok_row):
+                    plan[f"{prefix}weight"] = col
+                    if getattr(layer, "bias", None) is not None:
+                        plan[f"{prefix}bias"] = (self.mp_axis,)
+                    linear_parity = 1
+                elif ok_row:
+                    plan[f"{prefix}weight"] = row
+                    if getattr(layer, "bias", None) is not None:
+                        plan[f"{prefix}bias"] = (None,)
+                    linear_parity = 0
+            elif isinstance(layer, Embedding) and deg > 1:
+                w = layer.weight  # [vocab, dim]
+                prefix = f"{lname}." if lname else ""
+                if w.shape[0] % deg == 0:
+                    plan[f"{prefix}weight"] = (self.mp_axis, None)
+
+        # everything else: cheapest candidate by the cost model
+        for name, p in params:
+            if name in plan:
+                continue
+            cands = self.cost_model.candidates(
+                tuple(int(s) for s in p.shape), itemsize(p),
+                axis=self.mp_axis)
+            best = min(cands, key=lambda c: c.cost(pressure))
+            plan[name] = best.spec
+        return plan
+
+    def apply(self, model, plan: Optional[Dict[str, tuple]] = None):
+        """Annotate parameters with the planned shardings (GSPMD does the
+        partitioning; reference partitioner.py rewrites the program)."""
+        from jax.sharding import PartitionSpec
+
+        from .sharding import mark_sharding
+
+        plan = plan or self.plan(model)
+        for name, p in model.named_parameters():
+            spec = plan.get(name)
+            if spec is None:
+                continue
+            mark_sharding(p, PartitionSpec(*spec))
+        return plan
