@@ -39,6 +39,7 @@ from . import auto_parallel  # noqa: F401
 from . import planner  # noqa: F401
 from .planner import CostModel, Planner  # noqa: F401
 from . import launch  # noqa: F401
+from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
 
 
 def is_initialized():

@@ -69,6 +69,16 @@ class Engine:
     def _build(self):
         from .. import jit
 
+        # full-auto planning fires on ANY build path (fit/evaluate call
+        # _build on demand without prepare(), like the reference engine)
+        if self.strategy.auto_mode == "full" and self.model is not None \
+                and self._plan is None:
+            mesh = get_mesh()
+            if mesh is not None:
+                from .planner import Planner
+
+                self._plan = Planner(mesh).apply(self.model)
+
         model, loss_fn, optimizer = self.model, self.loss, self.optimizer
 
         def train_step(x, y):
@@ -88,15 +98,7 @@ class Engine:
         self._eval_fn = jit.to_static(eval_step)
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
-        # full-auto mode: run the planner (reference Engine._plan ->
-        # planner.py search) before compiling the step
-        if self.strategy.auto_mode == "full" and self.model is not None:
-            mesh = get_mesh()
-            if mesh is not None:
-                from .planner import Planner
-
-                self._plan = Planner(mesh).apply(self.model)
-        self._build()
+        self._build()  # planning happens inside _build (any entry path)
 
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             valid_data=None, collate_fn=None, verbose=1):

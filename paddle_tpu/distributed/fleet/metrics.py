@@ -19,13 +19,19 @@ def _reduce(value, op, force_float=False):
     overwritten with the global value (all_reduce works in place)."""
     from ..env import get_world_size
 
-    arr = np.asarray(value.numpy() if hasattr(value, "numpy") else value,
-                     np.float64)
-    # integral counters reduce as integers: float32 loses exactness above
-    # 2^24, which real instance counts exceed (int32 on device is exact
-    # to 2^31)
-    integral = not force_float and bool(np.all(arr == np.floor(arr))) \
-        and bool(np.all(np.abs(arr) < 2 ** 31))
+    if isinstance(value, Tensor):
+        # device (possibly traced) values reduce as-is — this is the
+        # shard_map/jit path where all_reduce lowers to psum; copy so the
+        # caller's tensor is not rebound to the global value
+        t = Tensor(value._value)
+        all_reduce(t, op=op)
+        return t
+    arr = np.asarray(value, np.float64)
+    # host-side integral counters reduce as integers: float32 loses
+    # exactness above 2^24, which real instance counts exceed.  The
+    # choice keys on the INPUT dtype (rank-invariant), never the values.
+    in_dtype = np.asarray(value).dtype
+    integral = not force_float and np.issubdtype(in_dtype, np.integer)
     if get_world_size() <= 1:
         return to_tensor(arr.astype(np.int64) if integral else arr)
     t = to_tensor(arr.astype(np.int64 if integral else np.float32))

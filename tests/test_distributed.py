@@ -1353,11 +1353,105 @@ class TestAutoParallelPlanner:
         out = M.sum(counter)
         assert out is not counter
         np.testing.assert_allclose(counter.numpy(), [5.0])
-        # large integer counters keep exactness at world 1 (float64 path)
-        big = float(M.sum(20_000_001.0).numpy())
-        assert big == 20_000_001.0
+        # INTEGER-dtype counters keep exactness (int reduction; the
+        # dtype choice is rank-invariant — keyed on input dtype)
+        big = int(M.sum(np.int64(20_000_001)).numpy())
+        assert big == 20_000_001
+        # Tensor inputs pass through on-device (the traced/psum path)
+        t_in = paddle.to_tensor(np.array([2.5], np.float32))
+        t_out = M.sum(t_in)
+        assert t_out is not t_in
+        np.testing.assert_allclose(t_out.numpy(), [2.5])
 
     def test_localfs_missing_dir(self, tmp_path):
         from paddle_tpu.distributed.fleet.utils import LocalFS
 
         assert LocalFS().ls_dir(str(tmp_path / "nope")) == ([], [])
+
+
+class TestFleetExecutor:
+    """Async multi-program driver (reference: fleet_executor/ Carrier +
+    Interceptor streaming InterceptorMessages between TaskNodes)."""
+
+    def test_two_stage_streaming_pipeline(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        stage1 = jax.jit(lambda x: x * 2.0)
+        stage2 = jax.jit(lambda x: x + 1.0)
+        a = TaskNode(stage1, name="s1")
+        b = TaskNode(stage2, name="s2")
+        a.add_downstream_task(b)
+        exe = FleetExecutor([a, b])
+        feeds = [jnp.full((4,), float(i)) for i in range(6)]
+        outs = exe.run(feeds)
+        assert len(outs) == 6
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(np.asarray(o), i * 2.0 + 1.0)
+
+    def test_fan_in_join(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        left = TaskNode(lambda x: x + 1.0, name="left")
+        right = TaskNode(lambda x: x * 3.0, name="right")
+        join = TaskNode(lambda a, b: a + b, name="join")
+        left.add_downstream_task(join)
+        right.add_downstream_task(join)
+        exe = FleetExecutor([left, right, join])
+        feeds = [{"left": jnp.asarray(float(i)),
+                  "right": jnp.asarray(float(i))} for i in range(4)]
+        outs = exe.run(feeds)
+        np.testing.assert_allclose([float(o) for o in outs],
+                                   [(i + 1.0) + 3.0 * i for i in range(4)])
+
+    def test_error_propagates(self):
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        def boom(x):
+            raise RuntimeError("interceptor failure")
+
+        a = TaskNode(lambda x: x, name="a")
+        b = TaskNode(boom, name="b")
+        a.add_downstream_task(b)
+        exe = FleetExecutor([a, b])
+        with pytest.raises(RuntimeError, match="interceptor failure"):
+            exe.run([1.0, 2.0])
+
+    def test_error_with_many_feeds_does_not_deadlock(self):
+        """Regression: a dead stage must keep draining its input so
+        upstream puts (and the feed loop) never block forever."""
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        def boom(x):
+            raise RuntimeError("dead stage")
+
+        a = TaskNode(lambda x: x, name="a", buffer_size=1)
+        b = TaskNode(boom, name="b", buffer_size=1)
+        a.add_downstream_task(b)
+        exe = FleetExecutor([a, b])
+        with pytest.raises(RuntimeError, match="dead stage"):
+            exe.run([float(i) for i in range(50)], timeout=30.0)
+
+    def test_backpressure_bounded_queues(self):
+        import time
+
+        from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+        seen = []
+
+        def slow_consumer(x):
+            time.sleep(0.01)
+            seen.append(float(x))
+            return x
+
+        fast = TaskNode(lambda x: x, name="fast", buffer_size=1)
+        slow = TaskNode(slow_consumer, name="slow", buffer_size=1)
+        fast.add_downstream_task(slow)
+        exe = FleetExecutor([fast, slow])
+        outs = exe.run([float(i) for i in range(10)])
+        assert seen == [float(i) for i in range(10)]
+        assert len(outs) == 10
