@@ -18,15 +18,32 @@ import numpy as np
 from ..core.tensor import Tensor, to_tensor
 
 
-def _empty_caches(model, batch):
+def _cache_dims(model):
+    """(kv_heads, head_dim, dtype) shared by both cache layouts."""
     cfg = model.config
     head_dim = cfg.hidden_size // cfg.num_attention_heads
     kv_heads = getattr(cfg, "num_key_value_heads", None) \
         or cfg.num_attention_heads
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    return kv_heads, head_dim, dtype
+
+
+def _empty_caches(model, batch):
+    kv_heads, head_dim, dtype = _cache_dims(model)
     empty = jnp.zeros((batch, 0, kv_heads, head_dim), dtype)
     return [(Tensor(empty), Tensor(empty))
-            for _ in range(cfg.num_hidden_layers)]
+            for _ in range(model.config.num_hidden_layers)]
+
+
+def _static_caches(model, batch, max_len):
+    """Fixed-size caches: every decode step reuses ONE set of op shapes
+    (the concat-growing cache changes shapes per token, recompiling each
+    step on TPU — see models/llama.py StaticKVCache)."""
+    from .llama import StaticKVCache
+
+    kv_heads, head_dim, dtype = _cache_dims(model)
+    return [StaticKVCache.empty(batch, max_len, kv_heads, head_dim, dtype)
+            for _ in range(model.config.num_hidden_layers)]
 
 
 def _select_token(logits, *, do_sample, temperature, top_k, top_p, key):
@@ -57,7 +74,7 @@ def _gather_caches(caches, idx):
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
-             eos_token_id=None, seed=None):
+             eos_token_id=None, seed=None, use_static_cache=False):
     """Decode continuations for a batch of prompts.
 
     Returns [B, T_prompt + T_new] token ids (beam search returns the best
@@ -72,13 +89,24 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     if ids.ndim == 1:
         ids = ids[None]
     B, T0 = ids.shape
+    max_pos = getattr(model.config, "max_position_embeddings", None)
+    if max_pos is not None and T0 + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({max_pos}) — the rope table has no "
+            f"entries past it (dynamic_slice would silently clamp)")
+    if use_static_cache and num_beams > 1:
+        raise NotImplementedError(
+            "use_static_cache with beam search is not supported yet "
+            "(beam gathering re-indexes grow caches)")
     with no_grad_ctx():
         if num_beams > 1:
             return _beam_generate(model, ids, max_new_tokens, num_beams,
                                   eos_token_id)
         # seed=None draws from the framework RNG stream (paddle.seed)
         key = rnd.next_key() if seed is None else jax.random.PRNGKey(seed)
-        caches = _empty_caches(model, B)
+        caches = _static_caches(model, B, T0 + max_new_tokens) \
+            if use_static_cache else _empty_caches(model, B)
         logits, caches = model(to_tensor(ids.astype(np.int32)),
                                caches=caches, position_offset=0)
         out = [ids]

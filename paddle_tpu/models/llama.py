@@ -88,13 +88,47 @@ def precompute_rope(head_dim, max_pos, theta):
 
 
 def apply_rope(x, cos, sin, position_offset=0):
-    """x: [B, T, H, D].  Rotate-half convention."""
+    """x: [B, T, H, D].  Rotate-half convention.  position_offset may be
+    a traced scalar (static-cache decode compiles ONE step program)."""
     T = x.shape[1]
-    c = cos[position_offset:position_offset + T][None, :, None, :]
-    s = sin[position_offset:position_offset + T][None, :, None, :]
+    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, T)[
+        None, :, None, :]
+    s = jax.lax.dynamic_slice_in_dim(sin, position_offset, T)[
+        None, :, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
+
+
+class StaticKVCache:
+    """Preallocated decode cache (TPU-native: a concat-growing cache
+    changes shape every token, forcing an XLA recompile per step; a
+    fixed-size buffer + dynamic_update_slice keeps ONE compiled decode
+    program for the whole generation).  The reference's analog is the
+    ring buffer inside fused_multi_transformer_op.cu's CacheKV."""
+
+    __slots__ = ("k", "v")
+
+    def __init__(self, k, v):
+        self.k = k  # [B, max_len, kv_heads, head_dim]
+        self.v = v
+
+    @staticmethod
+    def empty(batch, max_len, kv_heads, head_dim, dtype):
+        z = jnp.zeros((batch, max_len, kv_heads, head_dim), dtype)
+        return StaticKVCache(z, z)
+
+    def tree_flatten(self):
+        return (self.k, self.v), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    StaticKVCache, lambda c: c.tree_flatten(),
+    StaticKVCache.tree_unflatten)
 
 
 class LlamaRMSNorm(nn.Layer):
@@ -162,6 +196,41 @@ class LlamaAttention(nn.Layer):
         q = apply("rope", _rope_fn, q)
         k = apply("rope", _rope_fn, k)
 
+        if isinstance(cache, StaticKVCache):
+            # fixed-size buffer write; one compiled program per decode
+            def _upd(buf, new):
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype), (0, position_offset, 0, 0))
+
+            k_buf = apply("kv_cache_update", _upd, Tensor(cache.k), k)
+            v_buf = apply("kv_cache_update", _upd, Tensor(cache.v), v)
+            new_cache = StaticKVCache(k_buf._value, v_buf._value)
+            max_len = cache.k.shape[1]
+
+            def _static_attn(qv, kb, vb):
+                # attend over the full buffer, masking positions beyond
+                # the write frontier (and future positions within this
+                # chunk, for multi-token prefill into the buffer)
+                rep = qv.shape[2] // kb.shape[2]
+                if rep > 1:
+                    kb = jnp.repeat(kb, rep, axis=2)
+                    vb = jnp.repeat(vb, rep, axis=2)
+                scores = jnp.einsum(
+                    "bthd,bshd->bhts", qv, kb,
+                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(self.head_dim)
+                q_pos = position_offset + jnp.arange(qv.shape[1])
+                k_pos = jnp.arange(max_len)
+                valid = k_pos[None, :] <= q_pos[:, None]  # [T, max_len]
+                scores = jnp.where(valid[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(qv.dtype)
+                return jnp.einsum("bhts,bshd->bthd", probs, vb)
+
+            out = apply("static_cache_attention", _static_attn, q, k_buf,
+                        v_buf)
+            out = out.reshape([B, T, self.num_heads * self.head_dim])
+            return self.o_proj(out), new_cache
+
         if cache is not None:
             from ..ops.manipulation import concat
 
@@ -171,7 +240,12 @@ class LlamaAttention(nn.Layer):
         else:
             new_cache = None
 
-        causal = cache is None  # full prefill is causal; decode attends to all
+        # ALWAYS causal with bottom-right alignment: query row i sees keys
+        # up to i + (Tk - Tq).  Covers no-cache training (Tk == Tq), cached
+        # prefill (past == 0, so plain causal — the old `causal = cache is
+        # None` made cached prefill bidirectional, corrupting generation),
+        # and single-token decode (row 0 sees all past keys).
+        causal = True
 
         def _attn(qv, kv, vv):
             from ..core.flags import flag
@@ -384,7 +458,8 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k: Optional[int] = None, top_p: float = 1.0,
                  do_sample: Optional[bool] = None, num_beams: int = 1,
-                 eos_token_id: Optional[int] = None, seed=None):
+                 eos_token_id: Optional[int] = None, seed=None,
+                 use_static_cache: bool = False):
         """Decode with the KV cache (models/generation.py): greedy,
         temperature/top-k/top-p sampling, or beam search.
 
@@ -408,4 +483,5 @@ class LlamaForCausalLM(nn.Layer):
                 self, input_ids, max_new_tokens=max_new_tokens,
                 do_sample=do_sample, temperature=temperature,
                 top_k=top_k or 0, top_p=top_p, num_beams=num_beams,
-                eos_token_id=eos_token_id, seed=seed)
+                eos_token_id=eos_token_id, seed=seed,
+                use_static_cache=use_static_cache)

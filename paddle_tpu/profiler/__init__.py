@@ -254,12 +254,30 @@ class Profiler:
                 time_unit="ms"):
         agg = {}
         for tid, name, start_ns, end_ns, cat in self.events:
-            d = agg.setdefault(name, [0, 0.0])
+            d = agg.setdefault(name, [0, 0.0, 0.0, float("inf")])
+            dur = (end_ns - start_ns) / 1e6
             d[0] += 1
-            d[1] += (end_ns - start_ns) / 1e6
+            d[1] += dur
+            d[2] = max(d[2], dur)
+            d[3] = min(d[3], dur)
+        # SortedKeys: host-range stats (the GPU* keys of the reference map
+        # onto the same host table here — device timing lives in the
+        # Xplane trace jax.profiler captures)
+        sort_fns = {
+            None: lambda kv: -kv[1][1],
+            SortedKeys.CPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.GPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.CPUAvg: lambda kv: -(kv[1][1] / kv[1][0]),
+            SortedKeys.GPUAvg: lambda kv: -(kv[1][1] / kv[1][0]),
+            SortedKeys.CPUMax: lambda kv: -kv[1][2],
+            SortedKeys.GPUMax: lambda kv: -kv[1][2],
+            SortedKeys.CPUMin: lambda kv: kv[1][3],
+            SortedKeys.GPUMin: lambda kv: kv[1][3],
+        }
+        key_fn = sort_fns.get(sorted_by, sort_fns[None])
         lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
-        for name, (calls, total) in sorted(agg.items(), key=lambda kv:
-                                           -kv[1][1]):
+        for name, (calls, total, _mx, _mn) in sorted(agg.items(),
+                                                     key=key_fn):
             lines.append(f"{name:<40}{calls:>8}{total:>12.3f}"
                          f"{total / calls:>12.3f}")
         if self._step_times:
@@ -295,3 +313,28 @@ class benchmark:
         total_t = sum(t for t, _ in self._times)
         total_n = sum(n for _, n in self._times)
         return total_n / total_t if total_t else 0.0
+
+
+class SortedKeys:
+    """Summary-table sort orders (reference: profiler/profiler_statistic.py
+    SortedKeys enum)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name: str, worker_name: str = None):
+    """Post-run exporter hook (reference: profiler.export_protobuf writes
+    the profiler result protobuf).  The device half of our trace already
+    lands as Xplane protobufs under jax.profiler's log dir; the host
+    ranges export as chrome-trace JSON (the reference's .pb wire format
+    is paddle-internal) — same behavior as export_chrome_tracing,
+    including the timestamp suffix that keeps runs from clobbering each
+    other."""
+    return export_chrome_tracing(dir_name, worker_name)

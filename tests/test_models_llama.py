@@ -169,3 +169,41 @@ class TestGeneration:
         out = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
                              temperature=0.0, eos_token_id=eos).numpy()
         assert out.shape[1] < 3 + 6 or (out[0, 4:] == eos).all()
+
+    def test_cached_prefill_is_causal(self):
+        """Regression: prefill THROUGH the kv cache must produce the same
+        logits as the no-cache causal forward (the old cache path attended
+        bidirectionally during prefill, corrupting every generation)."""
+        from paddle_tpu.models.generation import _empty_caches
+
+        model = self._model()
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 100, (2, 6)).astype(np.int32))
+        ref = model(ids)
+        caches = _empty_caches(model, 2)
+        lg, _ = model(ids, caches=caches, position_offset=0)
+        np.testing.assert_allclose(lg.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_static_cache_matches_grow_cache(self):
+        model = self._model()
+        ids = paddle.to_tensor(np.random.RandomState(1).randint(
+            0, 100, (2, 4)).astype(np.int32))
+        grow = model.generate(ids, max_new_tokens=6, temperature=0.0)
+        static = model.generate(ids, max_new_tokens=6, temperature=0.0,
+                                use_static_cache=True)
+        np.testing.assert_array_equal(grow.numpy(), static.numpy())
+
+    def test_static_cache_shapes_constant(self):
+        """The whole point of StaticKVCache: every decode step reuses one
+        buffer shape (growing shapes would recompile per token on TPU)."""
+        from paddle_tpu.models.generation import _static_caches
+
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+        caches = _static_caches(model, 1, 8)
+        shape0 = tuple(caches[0].k.shape)
+        logits, caches = model(ids, caches=caches, position_offset=0)
+        for t in range(3, 7):
+            tok = paddle.to_tensor(np.array([[5]], np.int32))
+            logits, caches = model(tok, caches=caches, position_offset=t)
+            assert tuple(caches[0].k.shape) == shape0
