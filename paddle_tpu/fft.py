@@ -75,3 +75,52 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), _t(x))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a hermitian-symmetric signal (reference: paddle.fft.hfft2
+    — real output)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def _fn(v):
+        # hermitian n-d = fft over leading axes then hfft on the last;
+        # with axes=None, `s` applies to the LAST len(s) dims (numpy
+        # semantics), not to all of them
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))
+        else:
+            ax = tuple(range(-v.ndim, 0))
+        out = v
+        for i, a in enumerate(ax[:-1]):
+            out = jnp.fft.fft(out, n=None if s is None else s[i],
+                              axis=a, norm=norm)
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(out, n=n_last, axis=ax[-1], norm=norm)
+
+    return apply("hfftn", _fn, _t(x))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def _fn(v):
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))
+        else:
+            ax = tuple(range(-v.ndim, 0))
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(v, n=n_last, axis=ax[-1], norm=norm)
+        for i, a in enumerate(ax[:-1]):
+            out = jnp.fft.ifft(out, n=None if s is None else s[i],
+                               axis=a, norm=norm)
+        return out
+
+    return apply("ihfftn", _fn, _t(x))

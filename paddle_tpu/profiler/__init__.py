@@ -275,11 +275,11 @@ class Profiler:
             SortedKeys.GPUMin: lambda kv: kv[1][3],
         }
         key_fn = sort_fns.get(sorted_by, sort_fns[None])
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
-        for name, (calls, total, _mx, _mn) in sorted(agg.items(),
-                                                     key=key_fn):
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"
+                 f"{'Max(ms)':>12}{'Min(ms)':>12}"]
+        for name, (calls, total, mx, mn) in sorted(agg.items(), key=key_fn):
             lines.append(f"{name:<40}{calls:>8}{total:>12.3f}"
-                         f"{total / calls:>12.3f}")
+                         f"{total / calls:>12.3f}{mx:>12.3f}{mn:>12.3f}")
         if self._step_times:
             import numpy as np
 

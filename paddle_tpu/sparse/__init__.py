@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
+from ..nn.layer.layers import Layer as _Layer
 
 try:
     from jax.experimental import sparse as jsparse
@@ -20,6 +21,7 @@ except ImportError:  # pragma: no cover
     _HAS_BCOO = False
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "BatchNorm", "Conv3D", "MaxPool3D", "ReLU", "SubmConv3D",
            "is_same_shape", "add", "matmul", "masked_matmul", "relu",
            "nn"]
 
@@ -113,15 +115,173 @@ def relu(x: SparseCooTensor):
                      shape=x._shape), x._shape)
 
 
-class nn:
-    """paddle.sparse.nn subset (sparse conv is a planned kernel)."""
+def _dense_of(x):
+    return x.to_dense()._value if isinstance(x, SparseCooTensor) else \
+        (x._value if isinstance(x, Tensor) else jnp.asarray(x))
 
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
 
-    class Conv3D:
-        def __init__(self, *a, **k):
-            raise NotImplementedError(
-                "sparse submanifold conv: planned Pallas kernel (reference "
-                "phi/kernels/sparse/conv_kernel)")
+def _sparsify(dense, shape):
+    # channel-dense layout (n_dense=1): data is [nnz, C], the shape the
+    # per-site layers (BatchNorm) operate on
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense, n_dense=1),
+                           tuple(shape))
+
+
+def _channel_dense_bcoo(x):
+    """BCOO with a dense trailing channel dim ([nnz, C] data)."""
+    if x._bcoo.n_dense >= 1:
+        return x._bcoo
+    return jsparse.BCOO.fromdense(x._bcoo.todense(), n_dense=1)
+
+
+def _active_mask(x):
+    """[N, D, H, W, 1] bool mask of the INDEX SET (not the values —
+    explicitly-stored zeros are active sites in submanifold semantics)."""
+    bcoo = _channel_dense_bcoo(x)
+    idx = bcoo.indices  # [nnz, ndim_sparse]
+    mask = jnp.zeros(x._shape[:idx.shape[1]] + (1,), bool)
+    return mask.at[tuple(idx[:, i] for i in range(idx.shape[1]))
+                   + (0,)].set(True)
+
+
+class Conv3D(_Layer):
+    """Sparse 3-D conv on NDHWC COO tensors (reference:
+    paddle.sparse.nn.Conv3D over phi/kernels/sparse/conv_kernel).
+    Dense-lowered: XLA tiles the conv on the MXU; the gather/GEMM/
+    scatter kernel is the Pallas optimization path, the semantics live
+    here.  A real nn.Layer, so parameters register/train/checkpoint."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None):
+        super().__init__()
+        from ..nn.layer.conv import _ConvNd
+
+        _t3 = _ConvNd._tuplize
+        self.kernel_size = _t3(kernel_size, 3)
+        self.stride = _t3(stride, 3)
+        self.padding = _t3(padding, 3)
+        self.dilation = _t3(dilation, 3)
+        self.groups = groups
+        # kernel layout DHWIO (lax conv_general_dilated NDHWC convention)
+        self.weight = self.create_parameter(
+            list(self.kernel_size) + [in_channels // groups, out_channels])
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_channels], is_bias=True)
+
+    def _conv(self, dense):
+        out = jax.lax.conv_general_dilated(
+            dense, self.weight._value,
+            window_strides=self.stride,
+            padding=[(p, p) for p in self.padding],
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            feature_group_count=self.groups)
+        if self.bias is not None:
+            out = out + self.bias._value
+        return out
+
+    def forward(self, x):
+        out = self._conv(_dense_of(x))
+        return _sparsify(out, out.shape)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold conv: the OUTPUT index set equals the input's
+    (reference SubmConv3D; requires stride 1 / same-size output).  The
+    pattern comes from the INDEX SET, so sites storing all-zero features
+    stay active across layers."""
+
+    def forward(self, x):
+        dense = _dense_of(x)
+        out = self._conv(dense)
+        if out.shape[:4] != dense.shape[:4]:
+            raise ValueError("SubmConv3D requires a same-spatial-size "
+                             "output (stride 1, same padding)")
+        active = _active_mask(x)
+        out = jnp.where(active, out, 0.0)
+        bcoo = _channel_dense_bcoo(x)
+        # keep the input's index set verbatim: gather out at those sites
+        idx = bcoo.indices
+        data = out[tuple(idx[:, i] for i in range(idx.shape[1]))]
+        return SparseCooTensor(
+            jsparse.BCOO((data, idx),
+                         shape=tuple(out.shape[:4]) + (out.shape[-1],)),
+            tuple(out.shape))
+
+
+class BatchNorm(_Layer):
+    """BatchNorm over the channel dim of ACTIVE sites only (reference
+    paddle.sparse.nn.BatchNorm: statistics exclude the empty space)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self._mean = jnp.zeros(num_features)
+        self._var = jnp.ones(num_features)
+
+    def forward(self, x):
+        bcoo = _channel_dense_bcoo(x)
+        data = bcoo.data  # [nnz, C] — active sites only
+        if self.training:
+            mean = jnp.mean(data, axis=0)
+            var = jnp.var(data, axis=0)
+            self._mean = self.momentum * self._mean + (1 - self.momentum) \
+                * mean
+            self._var = self.momentum * self._var + (1 - self.momentum) * var
+        else:
+            mean, var = self._mean, self._var
+        norm = (data - mean) * jax.lax.rsqrt(var + self.epsilon)
+        new = norm * self.weight._value + self.bias._value
+        return SparseCooTensor(jsparse.BCOO((new, bcoo.indices),
+                                            shape=x._shape), x._shape)
+
+
+class MaxPool3D(_Layer):
+    """Max over ACTIVE sites only: empty space must not contribute its
+    implicit zero (which would beat negative features)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        from ..nn.layer.conv import _ConvNd
+
+        _t3 = _ConvNd._tuplize
+        self.kernel_size = _t3(kernel_size, 3)
+        self.stride = _t3(stride if stride is not None else kernel_size, 3)
+        self.padding = _t3(padding, 3)
+
+    def forward(self, x):
+        dense = _dense_of(x)
+        active = _active_mask(x)
+        guarded = jnp.where(active, dense, -jnp.inf)
+        win = (1,) + self.kernel_size + (1,)
+        strd = (1,) + self.stride + (1,)
+        pads = [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)]
+        out = jax.lax.reduce_window(guarded, -jnp.inf, jax.lax.max, win,
+                                    strd, pads)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)  # all-empty windows
+        return _sparsify(out, out.shape)
+
+
+class ReLU(_Layer):
+    def forward(self, x):
+        return relu(x)
+
+
+class nn_namespace:
+    """paddle.sparse.nn (reference: python/paddle/sparse/nn/)."""
+
+    ReLU = ReLU
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
+    BatchNorm = BatchNorm
+    MaxPool3D = MaxPool3D
+
+
+nn = nn_namespace

@@ -381,3 +381,87 @@ class TestUtilsFills:
         (tmp_path / "model.pdparams").write_bytes(b"123")
         p = u.download.get_weights_path_from_url("http://x/y/model.pdparams")
         assert p.endswith("model.pdparams")
+
+
+class TestSparseNN:
+    """paddle.sparse.nn layers (reference: python/paddle/sparse/nn over
+    phi/kernels/sparse): dense-lowered semantics on COO tensors."""
+
+    def _coo(self):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        dense[0, 1, 1, 1] = [1.0, -2.0]
+        dense[0, 2, 3, 0] = [3.0, 4.0]
+        x = sp.SparseCooTensor.__new__(sp.SparseCooTensor)
+        x._bcoo = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+        x._shape = dense.shape
+        return x, dense
+
+    def test_subm_conv_preserves_pattern(self):
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._coo()
+        y = sp.nn.SubmConv3D(2, 3, 3, padding=1)(x)
+        yd = y.to_dense().numpy()
+        active = (dense != 0).any(-1)
+        assert (yd[~active] == 0).all()
+        assert yd.shape == (1, 4, 4, 4, 3)
+
+    def test_conv_batchnorm_pool_relu(self):
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._coo()
+        z = sp.nn.Conv3D(2, 3, 2, stride=2)(x)
+        assert z.to_dense().numpy().shape == (1, 2, 2, 2, 3)
+        bn = sp.nn.BatchNorm(2)
+        assert abs(float(bn(x)._bcoo.data.mean(0)[0])) < 1e-5
+        m = sp.nn.MaxPool3D(2, 2)(x).to_dense().numpy()
+        assert float(m.max()) == 4.0
+        # empty sites must NOT contribute implicit zeros: the negative
+        # feature of the only active site in its window survives
+        assert m[0, 0, 0, 0, 1] == -2.0
+        r = sp.nn.ReLU()(x)
+        assert float(r.to_dense().numpy().min()) == 0.0
+
+    def test_layers_register_parameters_and_seed(self):
+        import paddle_tpu.sparse as sp
+
+        conv = sp.nn.SubmConv3D(2, 3, 3, padding=1)
+        assert len(conv.parameters()) == 2  # weight + bias register
+        paddle.seed(5)
+        c1 = sp.nn.Conv3D(2, 3, 2)
+        paddle.seed(6)
+        c2 = sp.nn.Conv3D(2, 3, 2)
+        assert not np.allclose(c1.weight.numpy(), c2.weight.numpy())
+
+    def test_submconv_keeps_zero_valued_sites(self):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        idx = jnp.array([[0, 0, 0, 0], [0, 1, 1, 1]])
+        data = jnp.array([[0.0], [2.0]])  # first site stores zeros
+        x = sp.SparseCooTensor(
+            jsparse.BCOO((data, idx), shape=(1, 2, 2, 2, 1)),
+            (1, 2, 2, 2, 1))
+        sub = sp.nn.SubmConv3D(1, 1, 3, padding=1, bias_attr=False)
+        assert sub(x).nnz() == 2  # index set preserved verbatim
+
+
+class TestHermitianFFT:
+    def test_hfft2_ihfft2_numpy_parity(self):
+        from paddle_tpu import fft
+
+        x = np.random.RandomState(0).randn(4, 5).astype(np.complex64)
+        got = fft.hfft2(paddle.to_tensor(x)).numpy()
+        want = np.fft.hfft(np.fft.fft(x, axis=-2), axis=-1)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+        real = np.real(want).astype(np.float32)
+        back = fft.ihfft2(paddle.to_tensor(real)).numpy()
+        want2 = np.fft.ifft(np.fft.ihfft(real, axis=-1), axis=-2)
+        np.testing.assert_allclose(back, want2, atol=1e-4)
