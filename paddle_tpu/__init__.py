@@ -13,6 +13,28 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+
+class version:
+    """paddle.version namespace (reference: generated python/paddle/version.py)."""
+
+    full_version = "0.1.0"
+    major, minor, patch = "0", "1", "0"
+    rc = "0"
+    cuda_version = "None"  # TPU build
+    cudnn_version = "None"
+
+    @staticmethod
+    def show():
+        print(f"paddle_tpu {version.full_version} (TPU/XLA build)")
+
+    @staticmethod
+    def cuda():
+        return None
+
+    @staticmethod
+    def cudnn():
+        return None
+
 # --- core types -----------------------------------------------------------
 from .core.dtype import (  # noqa: F401
     DType, bfloat16, bool_, complex64, complex128, float16, float32, float64,
@@ -55,6 +77,8 @@ from . import device  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .hapi import hub  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401

@@ -1,0 +1,50 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py — load models from
+github/gitee repos implementing hubconf.py).  No network egress here:
+`source='local'` works against a directory containing hubconf.py; remote
+sources raise with staging instructions."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entrypoints(mod):
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError(
+            "no network egress: clone the repo locally and pass "
+            "source='local'")
+    return _entrypoints(_load_hubconf(repo_dir))
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError("no network egress: use source='local'")
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise RuntimeError("no network egress: use source='local'")
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(
+            f"{model!r} not in hubconf entrypoints {_entrypoints(mod)}")
+    return fn(**kwargs)

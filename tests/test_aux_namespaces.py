@@ -465,3 +465,23 @@ class TestHermitianFFT:
         back = fft.ihfft2(paddle.to_tensor(real)).numpy()
         want2 = np.fft.ifft(np.fft.ihfft(real, axis=-1), axis=-2)
         np.testing.assert_allclose(back, want2, atol=1e-4)
+
+
+class TestHubAndVersion:
+    def test_hub_local_source(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_mlp(width=4):\n"
+            "    'a tiny mlp'\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(width, width)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny_mlp"]
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+        net = paddle.hub.load(str(tmp_path), "tiny_mlp", width=6)
+        assert net.weight.shape == [6, 6]
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.list("some/repo", source="github")
+
+    def test_version_namespace(self):
+        assert paddle.version.full_version == paddle.__version__
+        assert paddle.version.cuda() is None
+        assert hasattr(paddle, "callbacks")
