@@ -1,0 +1,45 @@
+"""Export -> serve example: jit.save (StableHLO artifact), the inference
+Predictor, sharded DistModel serving, and ONNX export with the numpy
+reference runtime.
+
+Run:  python examples/export_and_serve.py
+"""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, DistConfig, DistModel, Predictor
+from paddle_tpu.onnx import export as onnx_export, run_model
+from paddle_tpu.static import InputSpec
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 4))
+    x = np.random.randn(8, 16).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as d:
+        # 1. native serving artifact
+        paddle.jit.save(net, d + "/m",
+                        input_spec=[InputSpec([8, 16], "float32")])
+        pred = Predictor(Config(d + "/m"))
+        out = pred.run([paddle.to_tensor(x)])[0]
+        print("predictor:", out.numpy()[0])
+
+        # 2. mesh-sharded serving
+        dm = DistModel(Config(d + "/m"), DistConfig())
+        print("dist serve:", dm.run([paddle.to_tensor(x)])[0].numpy()[0])
+
+        # 3. ONNX export + dependency-free replay
+        path = onnx_export(net, d + "/m_onnx",
+                           input_spec=[InputSpec([8, 16], "float32")])
+        onnx_out = run_model(open(path, "rb").read(), [x])[0]
+        print("onnx runtime:", onnx_out[0])
+        np.testing.assert_allclose(onnx_out, out.numpy(), atol=1e-5)
+        print("all three paths agree")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Decoding example: greedy / sampling / beam search over the KV cache
+(static-shape cache keeps ONE compiled decode program on TPU).
+
+Run:  python examples/generate_text.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 100, (2, 8)).astype(np.int32))
+
+    greedy = model.generate(prompt, max_new_tokens=16, temperature=0.0,
+                            use_static_cache=True)
+    print("greedy:", greedy.numpy()[0].tolist())
+
+    sampled = model.generate(prompt, max_new_tokens=16, temperature=0.8,
+                             top_k=20, top_p=0.95, seed=7)
+    print("sampled:", sampled.numpy()[0].tolist())
+
+    beam = model.generate(prompt, max_new_tokens=16, num_beams=4,
+                          do_sample=False)
+    print("beam:", beam.numpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

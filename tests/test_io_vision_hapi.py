@@ -261,3 +261,32 @@ class TestDeviceLoader:
         batches = [np.ones((8, 2), np.float32)]
         (out,) = list(DeviceLoader(batches, sharding=sh))
         assert len(out._value.sharding.device_set) == 4
+
+
+class TestExamples:
+    """The examples/ scripts are runnable documentation — smoke them with
+    tiny settings (reference: book/ regression tests run example programs
+    to convergence thresholds)."""
+
+    def _run(self, mod_name, argv):
+        import importlib
+        import sys
+
+        sys.path.insert(0, "examples")
+        old_argv = sys.argv
+        try:
+            sys.argv = [mod_name] + argv
+            mod = importlib.import_module(mod_name)
+            return mod.main()
+        finally:
+            sys.argv = old_argv
+            sys.path.pop(0)
+
+    def test_train_mnist_loss_decreases(self):
+        loss = self._run("train_mnist", ["--steps", "25", "--batch", "16"])
+        assert loss < 2.0  # synthetic 10-class CE starts ~2.3
+
+    def test_pretrain_llama_single(self):
+        loss = self._run("pretrain_llama",
+                         ["--steps", "2", "--batch", "2", "--seq", "32"])
+        assert np.isfinite(loss)

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# CI driver (reference: paddle/scripts/paddle_build.sh + tools/ci_* gates).
+# Runs the test suite, the API-freeze gate, the examples as smoke tests,
+# and (when two bench artifacts are given) the perf-regression gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
+
+echo "== unit + integration tests =="
+python -m pytest tests/ -q
+
+echo "== example smoke runs =="
+python examples/train_mnist.py --steps 3 --batch 8
+python examples/pretrain_llama.py --steps 2 --batch 2 --seq 32
+python examples/generate_text.py
+python examples/export_and_serve.py
+
+echo "== multichip dryrun =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+if [ "$#" -eq 2 ]; then
+  echo "== perf regression gate =="
+  python tools/check_bench_result.py "$1" "$2"
+fi
+echo "CI OK"
