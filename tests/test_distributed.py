@@ -1455,3 +1455,49 @@ class TestFleetExecutor:
         outs = exe.run([float(i) for i in range(10)])
         assert seen == [float(i) for i in range(10)]
         assert len(outs) == 10
+
+
+class TestLaunchController:
+    """Launch controller end-to-end (reference:
+    launch/controllers/collective.py): supervise a real subprocess, set
+    the trainer env, elastic restart on failure."""
+
+    def test_single_node_success_and_env(self, tmp_path):
+        from paddle_tpu.distributed.launch import Controller
+
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+            "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+            "print('worker ran')\n")
+        ctrl = Controller(str(script), [], nnodes=1,
+                          log_dir=str(tmp_path / "log"))
+        assert ctrl.run() == 0
+        log = (tmp_path / "log" / "worker.0.log").read_text()
+        assert "worker ran" in log
+
+    def test_elastic_restart_then_success(self, tmp_path):
+        from paddle_tpu.distributed.launch import Controller
+
+        marker = tmp_path / "attempt"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            f"p = {str(marker)!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "sys.exit(1 if n == 0 else 0)\n")
+        ctrl = Controller(str(script), [], nnodes=1, elastic_level=1,
+                          max_restarts=2, log_dir=str(tmp_path / "log"))
+        assert ctrl.run() == 0
+        assert marker.read_text() == "2"  # first attempt died, second ran
+
+    def test_failure_without_elastic_propagates(self, tmp_path):
+        from paddle_tpu.distributed.launch import Controller
+
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        ctrl = Controller(str(script), [], nnodes=1,
+                          log_dir=str(tmp_path / "log"))
+        assert ctrl.run() == 3
