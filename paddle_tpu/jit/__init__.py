@@ -568,3 +568,8 @@ class TracedLayer:
     def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
         save(self._layer, path, input_spec=list(self._example_args))
         return path
+
+
+# reference name for what jit.load returns (fluid/dygraph/io.py
+# TranslatedLayer); LoadedFunction is the implementation
+TranslatedLayer = LoadedFunction
