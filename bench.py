@@ -192,7 +192,29 @@ def main():
         first_err = f"{type(e).__name__}: {e}"
     # One retry with the Pallas kernels disabled: a kernel-lowering
     # regression must cost MFU, not the round's number (the XLA fallback
-    # paths are always available).
+    # paths are always available).  Skip the retry when the kernels can't
+    # have been the cause — backend init never got a device (the retry
+    # would just repeat a ~long probe cycle), or the flag was already off.
+    init_failure = ("backend init failed" in first_err
+                    or "Unable to initialize" in first_err
+                    or "grabbed by another process" in first_err)
+    flag_was_on = True
+    try:
+        import paddle_tpu as _pt
+
+        flag_was_on = _pt.get_flags(["FLAGS_use_pallas_kernels"])[
+            "FLAGS_use_pallas_kernels"]
+    except Exception:  # noqa: BLE001
+        pass
+    if init_failure or not flag_was_on:
+        _emit({
+            "metric": "llama_pretrain_tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tokens/s/chip",
+            "vs_baseline": None,
+            "error": first_err,
+        })
+        return
     print("# retrying with FLAGS_use_pallas_kernels=0", file=sys.stderr)
     try:
         import paddle_tpu as paddle

@@ -72,6 +72,42 @@ def _gather_caches(caches, idx):
             for c in caches]
 
 
+def make_decode_step(model):
+    """One jit-compiled single-token decode step over static caches.
+
+    Returns step(tok[B,1] int32, caches, offset int32 scalar) ->
+    (last_logits[B,V] f32, new_caches).  The token position rides in as a
+    TRACED scalar and the caches are fixed-size, so every decode step of
+    every generation with the same (B, max_len) hits ONE executable —
+    the TPU serving property the reference gets from
+    fused_multi_transformer's decode kernel.  Model weights are captured
+    as jit constants (inference: they never change under the trace).
+
+    The wrapper is cached ON THE MODEL: jax.jit's own cache then holds
+    one executable per (B, max_len) across generate() calls — a fresh
+    wrapper per call would retrace + recompile the whole transformer
+    every request."""
+    step = getattr(model, "_decode_step", None)
+    if step is not None:
+        return step
+
+    from .llama import StaticKVCache
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    def step(tok, caches, offset):
+        with no_grad_ctx():
+            wrapped = [StaticKVCache(k, v) for k, v in caches]
+            logits, new_caches = model(Tensor(tok), caches=wrapped,
+                                       position_offset=offset)
+            return (logits._value[:, -1].astype(jnp.float32),
+                    [(c.k, c.v) for c in new_caches])
+
+    model._decode_step = step
+    return step
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
              eos_token_id=None, seed=None, use_static_cache=False):
@@ -109,10 +145,14 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             if use_static_cache else _empty_caches(model, B)
         logits, caches = model(to_tensor(ids.astype(np.int32)),
                                caches=caches, position_offset=0)
+        decode_step = None
+        if use_static_cache:
+            decode_step = make_decode_step(model)
+            cache_arrays = [(c.k, c.v) for c in caches]
         out = [ids]
         finished = np.zeros((B,), bool)
+        last = logits._value[:, -1].astype(jnp.float32)
         for step in range(max_new_tokens):
-            last = logits._value[:, -1].astype(jnp.float32)
             key, sub = jax.random.split(key)
             tok = _select_token(last, do_sample=do_sample,
                                 temperature=temperature, top_k=top_k,
@@ -124,9 +164,16 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             out.append(tok_np[:, None])
             if eos_token_id is not None and finished.all():
                 break
-            cur = to_tensor(tok_np[:, None].astype(np.int32))
-            logits, caches = model(cur, caches=caches,
-                                   position_offset=T0 + step)
+            cur_raw = tok_np[:, None].astype(np.int32)
+            if decode_step is not None:
+                # one compiled program for the whole generation: the
+                # position is a traced scalar, the caches fixed-size
+                last, cache_arrays = decode_step(
+                    cur_raw, cache_arrays, np.int32(T0 + step))
+            else:
+                logits, caches = model(to_tensor(cur_raw), caches=caches,
+                                       position_offset=T0 + step)
+                last = logits._value[:, -1].astype(jnp.float32)
         return to_tensor(np.concatenate(out, axis=1))
 
 

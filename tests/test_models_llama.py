@@ -207,3 +207,18 @@ class TestGeneration:
             tok = paddle.to_tensor(np.array([[5]], np.int32))
             logits, caches = model(tok, caches=caches, position_offset=t)
             assert tuple(caches[0].k.shape) == shape0
+
+    def test_decode_step_single_executable(self):
+        """All decode positions share ONE compiled program (the traced
+        offset + fixed cache shapes make retraces impossible)."""
+        from paddle_tpu.models.generation import (_static_caches,
+                                                  make_decode_step)
+
+        model = self._model()
+        step = make_decode_step(model)
+        caches = [(c.k, c.v) for c in _static_caches(model, 2, 12)]
+        for t in range(4, 10):
+            last, caches = step(np.ones((2, 1), np.int32), caches,
+                                np.int32(t))
+        assert step._cache_size() == 1
+        assert last.shape == (2, model.config.vocab_size)
