@@ -4,16 +4,163 @@ from __future__ import annotations
 from ...nn.functional.attention import scaled_dot_product_attention
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "use nn.MultiHeadAttention / F.scaled_dot_product_attention — the "
-        "Pallas flash kernel is the fused path on TPU")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """Whole attention block from explicit weights (reference:
+    incubate/nn/functional/fused_transformer.py fused_multi_head_attention
+    over fused_attention_op.cu).  qkv_weight: [3, n_heads, head_dim, D];
+    linear_weight: [D, D].  On TPU the fusion is XLA's + the flash kernel
+    inside scaled_dot_product_attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+    from ...core.tensor import Tensor, to_tensor
+
+    def _v(t):
+        return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+    def _ln(v, scale, bias, eps):
+        mu = jnp.mean(v, -1, keepdims=True)
+        var = jnp.var(v, -1, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        if scale is not None:
+            out = out * _v(scale)
+        if bias is not None:
+            out = out + _v(bias)
+        return out
+
+    def _fn(xv, qkv_w, lin_w, *rest):
+        names = []
+        extras = {}
+        ri = 0
+        for nm, t in [("pre_s", pre_ln_scale), ("pre_b", pre_ln_bias),
+                      ("ln_s", ln_scale), ("ln_b", ln_bias),
+                      ("qkv_b", qkv_bias), ("lin_b", linear_bias),
+                      ("mask", attn_mask)]:
+            if t is not None:
+                extras[nm] = rest[ri]
+                ri += 1
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            h = _ln(h, extras.get("pre_s"), extras.get("pre_b"),
+                    pre_ln_epsilon)
+        three, nh, hd, D = qkv_w.shape
+        B, T, _ = h.shape
+        qkv = jnp.einsum("btd,khed->btkhe", h.astype(jnp.float32),
+                         qkv_w.astype(jnp.float32))
+        if "qkv_b" in extras:
+            qkv = qkv + extras["qkv_b"].reshape(1, 1, 3, nh, hd)
+        q, k, v = (qkv[:, :, 0].astype(xv.dtype),
+                   qkv[:, :, 1].astype(xv.dtype),
+                   qkv[:, :, 2].astype(xv.dtype))
+        scores = jnp.einsum("bthe,bshe->bhts", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        if "mask" in extras:
+            scores = scores + extras["mask"].astype(jnp.float32)
+        probs = jax.nn.softmax(scores, -1).astype(xv.dtype)
+        ctx = jnp.einsum("bhts,bshe->bthe", probs, v).reshape(B, T, nh * hd)
+        out = ctx @ lin_w.astype(ctx.dtype)
+        if "lin_b" in extras:
+            out = out + extras["lin_b"]
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, extras.get("ln_s"), extras.get("ln_b"),
+                      ln_epsilon)
+        return out.astype(xv.dtype)
+
+    args = [x if isinstance(x, Tensor) else to_tensor(x),
+            qkv_weight if isinstance(qkv_weight, Tensor)
+            else to_tensor(qkv_weight),
+            linear_weight if isinstance(linear_weight, Tensor)
+            else to_tensor(linear_weight)]
+    for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, qkv_bias,
+              linear_bias, attn_mask):
+        if t is not None:
+            args.append(t if isinstance(t, Tensor) else to_tensor(t))
+    return apply("fused_multi_head_attention", _fn, *args)
 
 
-def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "XLA fuses the FFN chain automatically; use incubate.nn."
-        "FusedFeedForward for the layer API")
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      ring_id=-1, add_residual=True, name=None):
+    """Whole FFN block from explicit weights (reference:
+    fused_feedforward over fused_feedforward_op.cu): optional pre/post
+    layernorm, two linears, activation, residual.  XLA fuses the chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import apply
+    from ...core.tensor import Tensor, to_tensor
+
+    acts = {"relu": jax.nn.relu,
+            "gelu": lambda v: jax.nn.gelu(v, approximate=False)}
+    act = acts[activation]
+
+    def _v(t):
+        return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+    def _ln(v, scale, bias, eps):
+        mu = jnp.mean(v, -1, keepdims=True)
+        var = jnp.var(v, -1, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        if scale is not None:
+            out = out * _v(scale)
+        if bias is not None:
+            out = out + _v(bias)
+        return out
+
+    def _fn(xv, w1, w2, *rest):
+        extras = {}
+        ri = 0
+        for nm, t in [("b1", linear1_bias), ("b2", linear2_bias),
+                      ("s1", ln1_scale), ("sb1", ln1_bias),
+                      ("s2", ln2_scale), ("sb2", ln2_bias)]:
+            if t is not None:
+                extras[nm] = rest[ri]
+                ri += 1
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            h = _ln(h, extras.get("s1"), extras.get("sb1"), ln1_epsilon)
+        h = h @ w1.astype(h.dtype)
+        if "b1" in extras:
+            h = h + extras["b1"]
+        h = act(h)
+        h = h @ w2.astype(h.dtype)
+        if "b2" in extras:
+            h = h + extras["b2"]
+        if add_residual:
+            h = residual + h
+        if not pre_layer_norm:
+            h = _ln(h, extras.get("s2"), extras.get("sb2"), ln2_epsilon)
+        return h.astype(xv.dtype)
+
+    args = [x if isinstance(x, Tensor) else to_tensor(x),
+            linear1_weight if isinstance(linear1_weight, Tensor)
+            else to_tensor(linear1_weight),
+            linear2_weight if isinstance(linear2_weight, Tensor)
+            else to_tensor(linear2_weight)]
+    for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+              ln2_bias):
+        if t is not None:
+            args.append(t if isinstance(t, Tensor) else to_tensor(t))
+    return apply("fused_feedforward", _fn, *args)
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

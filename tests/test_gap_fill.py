@@ -672,3 +672,57 @@ class TestNamespaceFillsR2:
         want = 0.5 * np.log(2 * np.pi * np.e
                             * np.array([1.0, 4.0, 9.0]))
         np.testing.assert_allclose(bent, want, rtol=1e-5)
+
+
+class TestIncubateFusedFunctional:
+    """Explicit-weight fused blocks (reference: incubate/nn/functional/
+    fused_transformer.py over fused_attention/feedforward CUDA ops)."""
+
+    def test_fused_mha_postln_normalized(self):
+        import numpy as np
+
+        from paddle_tpu.incubate.nn import functional as IF
+
+        B, T, D, H = 2, 5, 16, 4
+        x = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+        qkv_w = np.random.RandomState(1).randn(
+            3, H, D // H, D).astype(np.float32) * 0.1
+        lin_w = np.random.RandomState(2).randn(D, D).astype(np.float32) * 0.1
+        out = IF.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+            paddle.to_tensor(lin_w), ln_scale=np.ones(D, np.float32),
+            ln_bias=np.zeros(D, np.float32)).numpy()
+        assert out.shape == (B, T, D)
+        assert abs(out.mean(-1)).max() < 1e-5
+        assert abs(out.var(-1) - 1).max() < 1e-3
+
+    def test_fused_ffn_matches_reference_formula(self):
+        import jax
+
+        from paddle_tpu.incubate.nn import functional as IF
+
+        B, T, D = 2, 4, 8
+        x = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+        w1 = np.random.RandomState(1).randn(D, 16).astype(np.float32) * 0.1
+        w2 = np.random.RandomState(2).randn(16, D).astype(np.float32) * 0.1
+        f = IF.fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            activation="gelu", ln2_scale=np.ones(D, np.float32)).numpy()
+        ref = x + np.asarray(jax.nn.gelu(x @ w1, approximate=False)) @ w2
+        refn = (ref - ref.mean(-1, keepdims=True)) / np.sqrt(
+            ref.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(f, refn, atol=1e-4)
+
+    def test_grads_flow_through_fused_mha(self):
+        from paddle_tpu.incubate.nn import functional as IF
+
+        D, H = 8, 2
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            1, 3, D).astype(np.float32))
+        x.stop_gradient = False
+        qkv_w = paddle.to_tensor(np.random.RandomState(1).randn(
+            3, H, D // H, D).astype(np.float32) * 0.1)
+        lin_w = paddle.to_tensor(np.eye(D, dtype=np.float32))
+        g = paddle.grad(IF.fused_multi_head_attention(
+            x, qkv_w, lin_w).sum(), x)[0]
+        assert g.shape == x.shape

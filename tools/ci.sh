@@ -6,7 +6,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+# CI validates on the CPU backend (the TPU is exercised by bench.py);
+# the ambient env often pins an accelerator platform, so override it.
+export JAX_PLATFORMS=${CI_JAX_PLATFORMS:-cpu}
 export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
 
 echo "== unit + integration tests =="
