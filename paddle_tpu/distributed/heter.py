@@ -38,7 +38,8 @@ class ProcessGroupHeter:
 
     def __init__(self, store, cluster_id: int, n_clusters: int,
                  local_group=None, local_rank: int = 0,
-                 local_world_size: int = 1, gid: int = 0):
+                 local_world_size: int = 1, gid: int = 0,
+                 timeout: float = 120.0):
         self.store = store
         self.cluster_id = int(cluster_id)
         self.n_clusters = int(n_clusters)
@@ -46,6 +47,7 @@ class ProcessGroupHeter:
         self.local_rank = int(local_rank)
         self.local_world_size = max(1, int(local_world_size))
         self.id = gid
+        self.timeout = float(timeout)
         self._round = 0
 
     # -- helpers --
@@ -60,7 +62,8 @@ class ProcessGroupHeter:
                            payload.tobytes())
         outs = []
         for c in range(self.n_clusters):
-            raw = self.store.get(self._key(op_name, c), wait=True)
+            raw = self.store.get(self._key(op_name, c), wait=True,
+                                 timeout=self.timeout)
             outs.append(np.frombuffer(raw, dtype=payload.dtype)
                         .reshape(payload.shape))
         return outs
@@ -114,7 +117,8 @@ class ProcessGroupHeter:
             if self.cluster_id == src_cluster:
                 self.store.set(self._key("bcast", src_cluster),
                                np.asarray(tensor.numpy()).tobytes())
-            raw = self.store.get(self._key("bcast", src_cluster), wait=True)
+            raw = self.store.get(self._key("bcast", src_cluster), wait=True,
+                                 timeout=self.timeout)
             val = np.frombuffer(raw, dtype=np.asarray(
                 tensor.numpy()).dtype).reshape(tensor.shape)
             tensor.set_value(val)
