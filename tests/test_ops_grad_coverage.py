@@ -386,3 +386,46 @@ class TestBatchedRoiPools:
         rois = paddle.to_tensor(np.array([[0., 0., 4., 4.]], np.float32))
         with pytest.raises(ValueError, match="boxes_num"):
             vops.roi_pool(feat, rois, None, 2)
+
+
+class TestDecompositionGrads:
+    """svd/eigh/qr gradients: the factors carry sign/rotation freedom, so
+    FD-checks use rotation-INVARIANT scalar losses with known analytic
+    grads (reference checks these ops with special-cased tolerances)."""
+
+    def test_svd_singular_value_grad(self):
+        a = rn(4, 3, scale=1.0) + np.eye(4, 3, dtype=np.float32)
+
+        def loss(x):
+            _, s, _ = paddle.linalg.svd(x)
+            return s.sum()
+
+        check_grad(loss, [a], atol=3e-2, rtol=3e-2, eps=1e-3)
+
+    def test_eigh_eigenvalue_grad(self):
+        m = rn(3, 3)
+        a = (m + m.T) / 2 + 2 * np.eye(3, dtype=np.float32)
+
+        def loss(x):
+            sym = (x + x.transpose([1, 0])) / 2
+            w, _ = paddle.linalg.eigh(sym)
+            return w.sum()
+
+        check_grad(loss, [a], atol=3e-2, rtol=3e-2, eps=1e-3)
+
+    def test_qr_frobenius_grad(self):
+        """sum(R^2) == ||A||_F^2 (Q orthonormal), so the autodiff grad
+        through the qr factors must equal 2A exactly."""
+        a = rn(4, 3) + np.eye(4, 3, dtype=np.float32)
+        t = paddle.to_tensor(a)
+        t.stop_gradient = False
+        q, r_ = paddle.linalg.qr(t)
+        g = paddle.grad((r_ ** 2).sum(), t)[0]
+        np.testing.assert_allclose(g.numpy(), 2 * a, rtol=1e-4, atol=1e-5)
+
+    def test_eigvalsh_matches_eigh_values(self):
+        m = rn(3, 3)
+        a = (m + m.T) / 2 + 2 * np.eye(3, dtype=np.float32)
+        w1 = paddle.linalg.eigvalsh(paddle.to_tensor(a)).numpy()
+        w2, _ = paddle.linalg.eigh(paddle.to_tensor(a))
+        np.testing.assert_allclose(w1, w2.numpy(), rtol=1e-5)
