@@ -54,6 +54,24 @@ class ProcessGroupHeter:
     def _key(self, op_name: str, cluster: int) -> str:
         return f"heter/{self.id}/{self._round}/{op_name}/{cluster}"
 
+    def _poll_get(self, key: str) -> bytes:
+        """Short non-blocking gets in a sleep loop instead of one long
+        blocking wait: the TCP client serializes calls under one mutex,
+        so a blocking wait would LOCK OUT a same-process peer's set()
+        for the whole wait (threaded gateways sharing a store deadlock
+        until timeout)."""
+        import time
+
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return self.store.get(key, wait=False)
+            except KeyError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"heter exchange timed out waiting for {key!r}")
+                time.sleep(0.005)
+
     def _exchange(self, op_name: str, payload: np.ndarray) -> list:
         """Gateway (local rank 0) publishes this cluster's array; every
         rank may fetch all peers' arrays."""
@@ -62,8 +80,7 @@ class ProcessGroupHeter:
                            payload.tobytes())
         outs = []
         for c in range(self.n_clusters):
-            raw = self.store.get(self._key(op_name, c), wait=True,
-                                 timeout=self.timeout)
+            raw = self._poll_get(self._key(op_name, c))
             outs.append(np.frombuffer(raw, dtype=payload.dtype)
                         .reshape(payload.shape))
         return outs
@@ -117,8 +134,7 @@ class ProcessGroupHeter:
             if self.cluster_id == src_cluster:
                 self.store.set(self._key("bcast", src_cluster),
                                np.asarray(tensor.numpy()).tobytes())
-            raw = self.store.get(self._key("bcast", src_cluster), wait=True,
-                                 timeout=self.timeout)
+            raw = self._poll_get(self._key("bcast", src_cluster))
             val = np.frombuffer(raw, dtype=np.asarray(
                 tensor.numpy()).dtype).reshape(tensor.shape)
             tensor.set_value(val)
