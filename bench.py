@@ -47,7 +47,8 @@ def _expects_accelerator() -> bool:
     return bool(plats) and "cpu" not in plats.split(",")
 
 
-def _init_backend(max_tries: int = 4):
+def _init_backend(max_tries: int = 3, probe_timeout: float = 90.0,
+                  total_budget: float = 300.0):
     """Return (devices, backend_name); retry init with backoff.
 
     A TPU held by a stale process (or a racing tunnel) raises
@@ -58,21 +59,31 @@ def _init_backend(max_tries: int = 4):
     imported here once a probe confirms the accelerator answers.  Without
     the probe, a retry would "succeed" on CPU and the bench would report a
     smoke-path number as the real perf result.
+
+    The whole init phase is bounded by ``total_budget`` seconds (probes,
+    backoffs, everything) so the error-JSON always lands inside the
+    driver's window — round 2's 600s-per-probe budget let a hung tunnel
+    eat the driver timeout before bench.py's own always-emit path fired.
     """
     import os
     import subprocess
 
+    deadline = time.monotonic() + total_budget
     last_err = None
     for attempt in range(max_tries):
+        remaining = deadline - time.monotonic()
+        if remaining <= 5.0:
+            break
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; d = jax.devices(); "
                  "print(jax.default_backend())"],
-                capture_output=True, text=True, timeout=600,
+                capture_output=True, text=True,
+                timeout=min(probe_timeout, remaining),
                 env=dict(os.environ))
         except subprocess.TimeoutExpired as e:
-            last_err = f"probe timed out after {e.timeout}s"
+            last_err = f"probe timed out after {e.timeout:.0f}s"
             print(f"# backend probe {attempt + 1}/{max_tries}: {last_err}",
                   file=sys.stderr)
             continue
@@ -91,13 +102,16 @@ def _init_backend(max_tries: int = 4):
                     "back to cpu — TPU likely grabbed by another process")
             return devices, backend
         last_err = (probe.stderr or probe.stdout or "").strip()[-500:]
-        wait = 5.0 * (attempt + 1)
+        wait = min(5.0 * (attempt + 1), max(0.0, deadline - time.monotonic()))
         print(f"# backend probe {attempt + 1}/{max_tries} failed "
               f"(backend={probed or 'none'}): {last_err!r}; retrying in "
               f"{wait:.0f}s", file=sys.stderr)
         time.sleep(wait)
+    why = ("time budget exhausted" if deadline - time.monotonic() <= 5.0
+           else f"{max_tries} probes failed")
     raise RuntimeError(
-        f"backend init failed after {max_tries} probes: {last_err}")
+        f"backend init failed ({why}, budget {total_budget:.0f}s): "
+        f"{last_err}")
 
 
 def _emit(result: dict):

@@ -27,7 +27,8 @@ from .parallel_layers import (  # noqa: F401
 from .sharding import shard_tensor, shard_op, reshard  # noqa: F401
 from .moe import ExpertMLP, MoELayer  # noqa: F401
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
-                       SharedLayerDesc, gpipe_spmd)
+                       SharedLayerDesc, gpipe_spmd, pipeline_1f1b,
+                       Compiled1F1BProgram, functional_call)
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .heter import ProcessGroupHeter  # noqa: F401
 from . import utils  # noqa: F401

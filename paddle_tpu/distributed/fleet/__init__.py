@@ -90,6 +90,13 @@ def distributed_model(model):
             stage = int((fleet.strategy.sharding_configs or {}).get(
                 "stage", 3))
         apply_group_sharding(model, mesh, stage=stage)
+    from ..pipeline import PipelineLayer, PipelineParallel
+
+    if (isinstance(model, PipelineLayer)
+            and mesh.shape.get("pp", 1) > 1):
+        # reference fleet_base.py:1042: pp models wrap in PipelineParallel,
+        # whose train_batch runs the compiled 1F1B schedule
+        return PipelineParallel(model, hcg, fleet.strategy)
     return model
 
 

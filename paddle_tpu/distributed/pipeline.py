@@ -279,10 +279,269 @@ class PipelineLayer(nn.Layer):
         return x
 
 
+def functional_call(layer, values, *inputs):
+    """Call an eager Layer as a PURE function of `values`.
+
+    `values` is a list of raw jnp arrays in `layer.named_parameters()`
+    order; `inputs` are raw arrays.  The layer's parameters are rebound to
+    `values` for the duration of the call (and restored after), so tracing
+    this under jax.vjp/jit differentiates with respect to `values` — the
+    TPU-native analog of running a reference pipeline stage's sublayers
+    under its rank-local autograd engine (pipeline_parallel.py
+    _forward_step).  The call runs under no_grad + static-trace guards:
+    the eager tape must not record tracer-valued ops.
+    """
+    from ..core import dispatch
+
+    params = [p for _, p in layer.named_parameters()]
+    if len(params) != len(values):
+        raise ValueError(
+            f"functional_call: layer has {len(params)} params, got "
+            f"{len(values)} values")
+    saved = [p._value for p in params]
+    try:
+        for p, v in zip(params, values):
+            p._value = v
+        with dispatch.no_grad_ctx(), dispatch.static_trace_guard():
+            out = layer(*[x if isinstance(x, Tensor) else Tensor(x)
+                          for x in inputs])
+        if isinstance(out, Tensor):
+            return out._value
+        if hasattr(out, "dtype") and hasattr(out, "shape"):
+            return out
+        raise TypeError(
+            f"functional_call: {type(layer).__name__} returned "
+            f"{type(out).__name__}; compiled pipeline stages must return a "
+            "single tensor")
+    finally:
+        for p, s in zip(params, saved):
+            p._value = s
+
+
+def _param_values(layer):
+    return [p._value for _, p in layer.named_parameters()]
+
+
+def _layer_sig(layer):
+    """Structural signature used to find the homogeneous pipeline body.
+
+    Includes the concrete class identity and every simple (scalar) config
+    attribute, so two same-shaped layers with different behavior knobs
+    (e.g. Block(act='relu') vs Block(act='gelu')) do NOT count as
+    homogeneous — they would silently run through stage 0's forward."""
+    entries = tuple((n, tuple(p.shape), str(p._value.dtype))
+                    for n, p in layer.named_parameters())
+
+    def cfg_of(l):
+        out = []
+        for k in sorted(vars(l)):
+            if k.startswith("_"):
+                continue
+            v = vars(l)[k]
+            if isinstance(v, (int, float, bool, str, bytes, type(None))):
+                out.append((k, v))
+        return tuple(out)
+
+    cfgs = tuple((id(type(sub)), cfg_of(sub))
+                 for _, _, sub in layer._walk("", True))
+    return (id(type(layer)), entries, cfgs)
+
+
+def _split_stages(built, n_stages):
+    """Partition a PipelineLayer's flat layer list into
+    (prologue, body, epilogue): the body is the longest contiguous run of
+    structurally identical layers, truncated to a multiple of n_stages
+    (spare tail layers join the epilogue).  Mirrors how reference models
+    are laid out for pp (pp_layers.py): embedding first, N identical
+    blocks, norm + head last."""
+    if not built:
+        raise ValueError("PipelineLayer has no layers")
+    sigs = [_layer_sig(l) for l in built]
+    best_start, best_len = 0, 1
+    start = 0
+    for i in range(1, len(sigs) + 1):
+        if i == len(sigs) or sigs[i] != sigs[start]:
+            if i - start > best_len:
+                best_start, best_len = start, i - start
+            start = i
+    body_len = (best_len // n_stages) * n_stages
+    if body_len == 0:
+        raise ValueError(
+            f"no homogeneous body of >= {n_stages} layers found for "
+            f"{n_stages} pipeline stages (longest run: {best_len})")
+    prologue = built[:best_start]
+    body = built[best_start:best_start + body_len]
+    epilogue = built[best_start + body_len:]
+    return prologue, body, epilogue
+
+
+def _has_persistable_buffers(layers):
+    for l in layers:
+        for _, lp, sub in l._walk("", True):
+            for bname, b in sub._buffers.items():
+                if b is not None and \
+                        bname not in sub._non_persistable_buffer_names:
+                    return True
+    return False
+
+
+class Compiled1F1BProgram:
+    """Generic PipelineLayer -> compiled 1F1B schedule (pipeline_1f1b).
+
+    Reference semantics: pipeline_parallel.py:153 train_batch runs the
+    1F1B schedule for ANY PipelineLayer's rank-local segment.  TPU-native:
+    the homogeneous body is stacked over the pp mesh axis ([S, L/S, ...]
+    leaves) and scanned per stage; prologue layers (e.g. embedding) run in
+    stage 0's branch, epilogue layers (final norm, head) + loss in stage
+    S-1's, matching SharedLayerDesc placement.  Parameters are read from
+    the eager layers at each step and gradients written back to
+    `param.grad`, so any eager Optimizer drives the update.
+
+    Restrictions (fall back to the eager microbatch loop otherwise): the
+    layer list must contain a homogeneous run of >= S layers, layers must
+    be buffer-free (no BN running stats), and activations must be a single
+    tensor between stages.
+    """
+
+    def __init__(self, pipeline_layer, mesh, axis_name="pp",
+                 data_axis=None, loss_fn=None):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.data_axis = data_axis
+        self.S = mesh.shape[axis_name]
+        built = list(pipeline_layer.run_function)
+        self.prologue, self.body, self.epilogue = _split_stages(built, self.S)
+        if _has_persistable_buffers(built):
+            raise ValueError("compiled 1F1B requires buffer-free layers")
+        self.L = len(self.body)
+        self._loss_fn = loss_fn
+        self._jit_cache = {}
+
+    # ---- parameter packing -------------------------------------------
+    def read_params(self):
+        shared = {"pro": [_param_values(l) for l in self.prologue],
+                  "epi": [_param_values(l) for l in self.epilogue]}
+        n_leaves = len(_param_values(self.body[0]))
+        stacked = []
+        for j in range(n_leaves):
+            leaf = jnp.stack([_param_values(l)[j] for l in self.body])
+            stacked.append(leaf.reshape(
+                (self.S, self.L // self.S) + leaf.shape[1:]))
+        return shared, tuple(stacked)
+
+    def write_grads(self, g_shared, g_stacked):
+        def acc(p, g):
+            g = g.astype(p._value.dtype)
+            if p.grad is None:
+                p.grad = Tensor(g, stop_gradient=True)
+            else:
+                p.grad = Tensor(p.grad._value + g, stop_gradient=True)
+
+        for layers, grads in ((self.prologue, g_shared["pro"]),
+                              (self.epilogue, g_shared["epi"])):
+            for l, gvals in zip(layers, grads):
+                for (_, p), g in zip(l.named_parameters(), gvals):
+                    acc(p, g)
+        for j, g in enumerate(g_stacked):
+            flat = g.reshape((self.L,) + g.shape[2:])
+            for i, l in enumerate(self.body):
+                params = [p for _, p in l.named_parameters()]
+                acc(params[j], flat[i])
+
+    # ---- stage function ----------------------------------------------
+    def _loss_value(self, out, target):
+        from ..core import dispatch
+
+        with dispatch.no_grad_ctx(), dispatch.static_trace_guard():
+            if self._loss_fn is None:
+                from ..nn import functional as F
+
+                loss = F.cross_entropy(Tensor(out), Tensor(target))
+            else:
+                loss = self._loss_fn(Tensor(out), Tensor(target))
+        raw = loss._value if isinstance(loss, Tensor) else loss
+        return raw.astype(jnp.float32).reshape(())
+
+    def make_stage_fn(self):
+        prologue, body, epilogue = self.prologue, self.body, self.epilogue
+        S = self.S
+        proto = body[0]
+
+        def stage_fn(stage, shared, local, x, mb_in, mb_tgt):
+            def pro_branch():
+                h = mb_in
+                for l, vals in zip(prologue, shared["pro"]):
+                    h = functional_call(l, vals, h)
+                return h.astype(x.dtype)
+
+            h = jax.lax.cond(stage == 0, pro_branch, lambda: x)
+
+            def body_fn(hh, lp):
+                return functional_call(proto, list(lp), hh), None
+
+            h, _ = jax.lax.scan(body_fn, h, local)
+
+            def loss_branch():
+                out = h
+                for l, vals in zip(epilogue, shared["epi"]):
+                    out = functional_call(l, vals, out)
+                return self._loss_value(out, mb_tgt)
+
+            loss = jax.lax.cond(stage == S - 1, loss_branch,
+                                lambda: jnp.float32(0.0))
+            return h, loss
+
+        return stage_fn
+
+    def _act_example(self, shared, mb_in_example):
+        """Shape/dtype of the inter-stage activation (prologue output)."""
+        if not self.prologue:
+            return jnp.zeros(mb_in_example.shape, mb_in_example.dtype)
+
+        def f(vals, mb):
+            h = mb
+            for l, v in zip(self.prologue, vals):
+                h = functional_call(l, v, h)
+            return h
+
+        out = jax.eval_shape(f, shared["pro"], mb_in_example)
+        return jnp.zeros(out.shape, out.dtype)
+
+    # ---- compiled step -----------------------------------------------
+    def step(self, x_mb, y_mb):
+        """Run one 1F1B step on microbatched arrays [M, micro, ...];
+        returns (loss, g_stacked, g_shared) as raw arrays."""
+        shared, stacked = self.read_params()
+        key = (x_mb.shape, str(x_mb.dtype), y_mb.shape, str(y_mb.dtype))
+        if key not in self._jit_cache:
+            stage_fn = self.make_stage_fn()
+            # activations inside shard_map are LOCAL shards: divide the
+            # microbatch dim by the dp degree when it is mesh-sharded
+            dp = (self.mesh.shape.get(self.data_axis, 1)
+                  if self.data_axis else 1)
+            if x_mb.shape[1] % dp:
+                raise ValueError(
+                    f"microbatch {x_mb.shape[1]} not divisible by dp={dp}")
+            mb_local = jnp.zeros((x_mb.shape[1] // dp,) + x_mb.shape[2:],
+                                 x_mb.dtype)
+            act = self._act_example(shared, mb_local)
+
+            def run(sh, st, xs, ys):
+                return pipeline_1f1b(
+                    stage_fn, st, sh, xs, ys, act, mesh=self.mesh,
+                    axis_name=self.axis_name, data_axis=self.data_axis)
+
+            self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key](shared, stacked, x_mb, y_mb)
+
+
 class PipelineParallel(nn.Layer):
     """Reference pipeline_parallel.py:31 wrapper: train_batch with the
-    microbatch schedule.  Compiled-schedule path for homogeneous bodies via
-    pipeline_stack()."""
+    microbatch schedule.  With a pp mesh axis of degree >= 2 and a
+    compilable PipelineLayer, train_batch runs the compiled 1F1B schedule
+    (reference forward_backward_pipeline, pipeline_parallel.py:81);
+    otherwise it falls back to an eager F-then-B accumulation loop with
+    identical math."""
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
@@ -290,13 +549,87 @@ class PipelineParallel(nn.Layer):
         self._hcg = hcg
         self.accumulate_steps = (strategy.pipeline_configs.get(
             "accumulate_steps", 1) if strategy is not None else 1)
+        self._1f1b = None
+        self._1f1b_failed = False
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    def _get_1f1b(self):
+        if self._1f1b is not None or self._1f1b_failed:
+            return self._1f1b
+        mesh = get_mesh()
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if pp <= 1:
+            return None  # not latched: the mesh may be initialized later
+        if not isinstance(self._layers, PipelineLayer):
+            self._1f1b_failed = True
+            return None
+        data_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
+        try:
+            self._1f1b = Compiled1F1BProgram(
+                self._layers, mesh, axis_name="pp", data_axis=data_axis,
+                loss_fn=getattr(self._layers, "_loss_fn", None))
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(f"compiled 1F1B unavailable ({e}); "
+                          "falling back to eager microbatch loop")
+            self._1f1b_failed = True
+        return self._1f1b
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Microbatch accumulation loop (F-then-B over microbatches)."""
         x, y = data
+        M = self.accumulate_steps
+        B = x._value.shape[0]
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by accumulate_steps {M}")
+        prog = self._get_1f1b() if scaler is None else None
+        if prog is not None:
+            micro = B // M
+            dp = (prog.mesh.shape.get(prog.data_axis, 1)
+                  if prog.data_axis else 1)
+            if micro % dp:
+                # this batch can't shard over dp; the eager loop can still
+                # run it — a per-call fallback, not a latched failure
+                prog = None
+        if prog is not None:
+            # only the compiled schedule itself is allowed to fall back;
+            # grads/optimizer run outside the guard so a failing optimizer
+            # can never cause a double-applied eager re-run
+            try:
+                loss, g_stacked, g_shared = self._run_1f1b(prog, x, y)
+            except Exception as e:  # noqa: BLE001 — tracing failures
+                import warnings
+
+                warnings.warn(
+                    f"compiled 1F1B step failed ({type(e).__name__}: {e}); "
+                    "falling back to the eager microbatch loop")
+                self._1f1b = None
+                self._1f1b_failed = True
+            else:
+                prog.write_grads(g_shared, g_stacked)
+                optimizer.step()
+                optimizer.clear_grad()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return Tensor(loss, stop_gradient=True)
+        return self._train_batch_eager(x, y, optimizer, lr_scheduler,
+                                       scaler)
+
+    def _run_1f1b(self, prog, x, y):
+        M = self.accumulate_steps
+        xv, yv = x._value, y._value
+        x_mb = xv.reshape((M, xv.shape[0] // M) + xv.shape[1:])
+        y_mb = yv.reshape((M, yv.shape[0] // M) + yv.shape[1:])
+        return prog.step(x_mb, y_mb)
+
+    def _train_batch_eager(self, x, y, optimizer, lr_scheduler,
+                           scaler=None):
+        """Microbatch accumulation loop (F-then-B over microbatches);
+        with a GradScaler, losses are scaled and the step goes through
+        scaler.step/update (reference pipeline_parallel.py amp path)."""
         n = self.accumulate_steps
         from ..ops.manipulation import split
 
@@ -306,9 +639,16 @@ class PipelineParallel(nn.Layer):
         for mx, my in zip(micro_x, micro_y):
             out = self._layers(mx)
             loss = self._loss(out, my) / n
-            loss.backward()
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
             total = loss if total is None else total + loss.detach()
-        optimizer.step()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()

@@ -433,6 +433,83 @@ class Test1F1B:
         finally:
             meshmod._GLOBAL_MESH = None
 
+    def test_fleet_train_batch_compiled_1f1b_generic(self):
+        """VERDICT r2 #2 done bar: fleet.distributed_model(PipelineLayer)
+        + train_batch runs the compiled 1F1B schedule for a generic
+        NON-Llama model (embedding prologue + homogeneous tanh-MLP body +
+        linear head) and matches the eager pp=1 microbatch loop to 1e-5
+        over 5 training steps.  Composes pp=2 x dp=4 so the microbatch dim
+        is mesh-sharded through the public fleet path (reference:
+        fleet_base.py:1042 -> pipeline_parallel.py:153 train_batch)."""
+        from paddle_tpu.distributed.pipeline import (PipelineLayer,
+                                                     PipelineParallel)
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.optimizer import SGD
+
+        vocab, d, nblocks = 16, 8, 4
+        B, T, M, steps, lr = 8, 6, 2, 5, 0.1
+
+        class _Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(d, d)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        def make_layers():
+            return ([nn.Embedding(vocab, d)]
+                    + [_Block() for _ in range(nblocks)]
+                    + [nn.Linear(d, vocab)])
+
+        def loss_fn(out, lab):
+            return F.cross_entropy(out.reshape([-1, vocab]),
+                                   lab.reshape([-1]))
+
+        rng = np.random.RandomState(0)
+        data = [rng.randint(0, vocab, (B, T)).astype(np.int32)
+                for _ in range(steps)]
+
+        # ---- eager pp=1 reference (the fallback microbatch loop) ----
+        paddle.seed(0)
+        ref = PipelineLayer(make_layers(), num_stages=1, loss_fn=loss_fn)
+        ref_opt = SGD(lr, parameters=ref.parameters())
+        ref_losses = []
+        for tok in data:
+            total = 0.0
+            for m in range(M):
+                mx = paddle.to_tensor(tok[m * (B // M):(m + 1) * (B // M)])
+                loss = loss_fn(ref(mx), mx) / M
+                loss.backward()
+                total += float(loss.numpy())
+            ref_opt.step()
+            ref_opt.clear_grad()
+            ref_losses.append(total)
+
+        # ---- compiled 1F1B through the fleet API (pp=2 x dp=4) ----
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": M}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(0)
+            pl = PipelineLayer(make_layers(), num_stages=2, loss_fn=loss_fn)
+            model = fleet.distributed_model(pl)
+            assert isinstance(model, PipelineParallel)
+            opt = SGD(lr, parameters=pl.parameters())
+            pp_losses = []
+            for tok in data:
+                t = paddle.to_tensor(tok)
+                loss = model.train_batch((t, t), opt)
+                pp_losses.append(float(loss.numpy()))
+            # the compiled schedule (not the eager fallback) must have run
+            assert model._1f1b is not None and not model._1f1b_failed
+            np.testing.assert_allclose(pp_losses, ref_losses, atol=1e-5,
+                                       rtol=1e-5)
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
+
     def test_memory_below_gpipe(self):
         """1F1B's point: peak live activations ~ min(M, 2S-1) microbatches
         vs GPipe-autodiff's M."""
