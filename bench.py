@@ -119,6 +119,87 @@ def _emit(result: dict):
     sys.stdout.flush()
 
 
+def _eager_overhead_us(n_ops: int = 1000):
+    """Per-op eager-dispatch overhead: Tensor-path chained adds vs raw jnp
+    (SURVEY §7 'eager-mode performance' hard part; the reference's hot
+    loop is TraceOpImpl, SURVEY §3.1).  Returns (overhead_us_per_op,
+    tensor_us_per_op, jnp_us_per_op)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+
+    x_t = paddle.to_tensor(np.ones((64, 64), np.float32))
+    x_j = jnp.ones((64, 64), jnp.float32)
+
+    def chain_tensor(n):
+        acc = x_t
+        for _ in range(n):
+            acc = acc + x_t
+        acc._value.block_until_ready()
+
+    def chain_jnp(n):
+        acc = x_j
+        for _ in range(n):
+            acc = acc + x_j
+        acc.block_until_ready()
+
+    chain_tensor(50)  # warm caches
+    chain_jnp(50)
+    t0 = time.perf_counter()
+    chain_tensor(n_ops)
+    t_tensor = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chain_jnp(n_ops)
+    t_jnp = time.perf_counter() - t0
+    per_op = (t_tensor - t_jnp) / n_ops * 1e6
+    return round(per_op, 3), round(t_tensor / n_ops * 1e6, 3), \
+        round(t_jnp / n_ops * 1e6, 3)
+
+
+def _moe_bench(on_tpu: bool):
+    """Second BASELINE config (expert-parallel MoE proxy, single chip):
+    tokens/s through a jitted fwd+bwd of an 8-expert top-2 MoE block
+    (BASELINE.md config 4; reference MoE path python/paddle/incubate/
+    distributed/models/moe/moe_layer.py)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.distributed.moe import MoELayer
+    from paddle_tpu.optimizer import AdamW
+
+    if on_tpu:
+        d_model, d_hidden, experts = 1024, 4096, 8
+        batch, seq, steps, warmup = 8, 512, 10, 3
+    else:
+        d_model, d_hidden, experts = 32, 64, 4
+        batch, seq, steps, warmup = 2, 16, 3, 1
+    moe = MoELayer(d_model=d_model, d_hidden=d_hidden, num_experts=experts,
+                   top_k=2)
+    opt = AdamW(1e-4, parameters=moe.parameters())
+
+    @jit.to_static
+    def step(x):
+        out = moe(x)
+        loss = (out * out).mean() + 0.01 * moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, seq, d_model).astype(np.float32))
+    for _ in range(warmup):
+        loss = step(x)
+    loss._value.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x)
+        loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+    return round(batch * seq * steps / dt, 1)
+
+
 def run_bench():
     devices, backend = _init_backend()
     on_tpu = backend == "tpu"
@@ -184,11 +265,30 @@ def run_bench():
         print(f"# unknown TPU device_kind={device_kind!r}; "
               "cannot compute MFU", file=sys.stderr)
 
+    # secondary workloads (VERDICT r2 #7/#8): never let them sink the
+    # headline number — errors land in stderr, fields stay null
+    extra = {}
+    try:
+        moe_tps = _moe_bench(on_tpu)
+        extra["moe_tokens_per_sec"] = moe_tps
+    except Exception as e:  # noqa: BLE001
+        print(f"# moe bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        ov, t_us, j_us = _eager_overhead_us()
+        extra["eager_op_overhead_us"] = ov
+        print(f"# eager dispatch: tensor={t_us}us/op jnp={j_us}us/op "
+              f"overhead={ov}us/op", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"# eager overhead bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     _emit({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4) if mfu is not None else None,
+        **({"extra": extra} if extra else {}),
     })
     print(f"# model={n_params/1e6:.1f}M params, batch={batch}, seq={seq}, "
           f"steps={steps}, step_time={dt/steps*1000:.1f}ms, "

@@ -121,8 +121,11 @@ def apply_group_sharding(model, mesh, stage=3):
     stage 1: optimizer state sharded (params+grads replicated) — slots are
       device_put onto the spec by distributed_optimizer's accumulator hook.
     stage 2: + gradients sharded (the reference's reduce-scatter becomes a
-      sharding constraint applied to each grad at step time; XLA lowers the
-      dp/sharding reduction to reduce-scatter instead of all-reduce).
+      sharding constraint applied to each grad at step time; the SPMD
+      partitioner emits all-reduce + partition slice — slot updates run at
+      shard shape — and the TPU/GPU backend pipelines merge that pair into
+      reduce-scatter; HLO-verified in TestZeROStages
+      test_zero_comm_lowering_in_hlo).
     stage 3: + parameters sharded (the reference's on-demand allgather +
       release hooks become compiler-scheduled GSPMD gathers).
     """

@@ -72,6 +72,15 @@ class FleetExecutor:
             n.name: queue.Queue() for n in self.sinks}
         errors: List[BaseException] = []
 
+        # (downstream, slot) pairs per node, precomputed from the upstream
+        # lists: upstream.index(node) would always resolve the FIRST slot
+        # when a node feeds the same downstream twice, starving the second
+        # input queue until the join timeout
+        out_edges: Dict[int, List] = {id(n): [] for n in self.nodes}
+        for d in self.nodes:
+            for slot, u in enumerate(d.upstream):
+                out_edges[id(u)].append((d, slot))
+
         def interceptor(node: TaskNode):
             qs = in_queues[id(node)]
             count = 0
@@ -92,16 +101,14 @@ class FleetExecutor:
                     continue
                 count += 1
                 if node.downstream:
-                    for d in node.downstream:
-                        slot = d.upstream.index(node)
+                    for d, slot in out_edges[id(node)]:
                         in_queues[id(d)][slot].put(out)
                 else:
                     sink_out[node.name].put(out)
                 if node.max_run_times and count >= node.max_run_times:
                     draining = True
             # propagate shutdown downstream
-            for d in node.downstream:
-                slot = d.upstream.index(node)
+            for d, slot in out_edges[id(node)]:
                 in_queues[id(d)][slot].put(_STOP)
 
         threads = [threading.Thread(target=interceptor, args=(n,),

@@ -150,11 +150,14 @@ class ProcessGroupHeter:
             self.store.add(key, 1)
         import time
 
-        for _ in range(3000):
+        # same configurable deadline as _poll_get (ADVICE r2: a group built
+        # with timeout=120 must not fail its barriers at a hardcoded 30s)
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
             if self.store.add(key, 0) >= self.n_clusters:
                 return
             time.sleep(0.01)
-        raise TimeoutError("heter barrier timed out")
+        raise TimeoutError(f"heter barrier timed out after {self.timeout}s")
 
     def rank(self):
         return self.cluster_id

@@ -82,9 +82,16 @@ class TCPStore:
         buf = ctypes.create_string_buffer(1 << 20)
         n = self._lib.tcp_store_get(self._client, key.encode(), buf,
                                     len(buf))
-        if n > len(buf):
-            # value larger than the probe buffer (tcp_store_get reports the
-            # full length and copies a prefix): refetch with the right size
+        # value larger than the probe buffer (tcp_store_get reports the
+        # full length and copies a prefix): refetch with the right size —
+        # looping because the value can grow again between fetches
+        refetches = 0
+        while n > len(buf):
+            if refetches >= 8:
+                raise RuntimeError(
+                    f"TCPStore.get: value for {key!r} kept growing across "
+                    f"{refetches} refetches")
+            refetches += 1
             buf = ctypes.create_string_buffer(int(n))
             n = self._lib.tcp_store_get(self._client, key.encode(), buf,
                                         len(buf))

@@ -24,6 +24,29 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     from ...core.dispatch import apply
     from ...core.tensor import Tensor, to_tensor
+    from ...ops import random as rnd
+
+    # reference fused_attention_op.cu applies dropout after softmax
+    # (attn_dropout_rate) and after the out-linear (dropout_rate); draw
+    # framework-RNG keys outside the pure fn (ADVICE r2: rates were
+    # silently ignored)
+    keys = {}
+    if training and attn_dropout_rate:
+        keys["attn"] = rnd.next_key()
+    if training and dropout_rate:
+        keys["out"] = rnd.next_key()
+
+    def _drop(v, key, p):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+        return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+
+    def _infer_scale(v, p):
+        # downscale_in_infer: no train-time upscale, so eval multiplies
+        # by the keep probability
+        if mode == "downscale_in_infer" and not training and p:
+            return (v * (1.0 - p)).astype(v.dtype)
+        return v
 
     def _v(t):
         return t._value if isinstance(t, Tensor) else jnp.asarray(t)
@@ -69,10 +92,16 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if "mask" in extras:
             scores = scores + extras["mask"].astype(jnp.float32)
         probs = jax.nn.softmax(scores, -1).astype(xv.dtype)
+        if "attn" in keys:
+            probs = _drop(probs, keys["attn"], attn_dropout_rate)
+        probs = _infer_scale(probs, attn_dropout_rate)
         ctx = jnp.einsum("bhts,bshe->bthe", probs, v).reshape(B, T, nh * hd)
         out = ctx @ lin_w.astype(ctx.dtype)
         if "lin_b" in extras:
             out = out + extras["lin_b"]
+        if "out" in keys:
+            out = _drop(out, keys["out"], dropout_rate)
+        out = _infer_scale(out, dropout_rate)
         if add_residual:
             out = residual + out
         if not pre_layer_norm:
@@ -107,10 +136,30 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
 
     from ...core.dispatch import apply
     from ...core.tensor import Tensor, to_tensor
+    from ...ops import random as rnd
 
     acts = {"relu": jax.nn.relu,
             "gelu": lambda v: jax.nn.gelu(v, approximate=False)}
     act = acts[activation]
+
+    # reference fused_feedforward_op.cu: dropout1 after the activation,
+    # dropout2 after linear2 (before the residual add)
+    drop_mode = mode or "upscale_in_train"
+    keys = {}
+    if training and dropout1_rate:
+        keys["d1"] = rnd.next_key()
+    if training and dropout2_rate:
+        keys["d2"] = rnd.next_key()
+
+    def _drop(v, key, p):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        scale = 1.0 / (1.0 - p) if drop_mode == "upscale_in_train" else 1.0
+        return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+
+    def _infer_scale(v, p):
+        if drop_mode == "downscale_in_infer" and not training and p:
+            return (v * (1.0 - p)).astype(v.dtype)
+        return v
 
     def _v(t):
         return t._value if isinstance(t, Tensor) else jnp.asarray(t)
@@ -142,9 +191,15 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         if "b1" in extras:
             h = h + extras["b1"]
         h = act(h)
+        if "d1" in keys:
+            h = _drop(h, keys["d1"], dropout1_rate)
+        h = _infer_scale(h, dropout1_rate)
         h = h @ w2.astype(h.dtype)
         if "b2" in extras:
             h = h + extras["b2"]
+        if "d2" in keys:
+            h = _drop(h, keys["d2"], dropout2_rate)
+        h = _infer_scale(h, dropout2_rate)
         if add_residual:
             h = residual + h
         if not pre_layer_norm:

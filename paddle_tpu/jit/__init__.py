@@ -248,9 +248,13 @@ class StaticFunction:
                                    tuple(dyn_idx))
             self._cache[key] = entry
 
-        lrs = jnp.asarray([opt.get_lr() for opt in state.optimizers],
-                          jnp.float32)
-        rng_key = rnd.default_generator().next_key()
+        # host numpy (not device jnp): in a multi-controller runtime
+        # (jax.distributed.initialize) a committed single-device array is
+        # not a valid jit input over a multi-process mesh, while numpy
+        # values are treated as replicated (same on every process)
+        lrs = np.asarray([opt.get_lr() for opt in state.optimizers],
+                         np.float32)
+        rng_key = np.asarray(rnd.default_generator().next_key())
         return entry.run(state, dyn_vals, lrs, rng_key)
 
     # ----- parity helpers

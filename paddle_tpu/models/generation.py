@@ -72,6 +72,15 @@ def _gather_caches(caches, idx):
             for c in caches]
 
 
+def _weights_fingerprint(model):
+    """Identity fingerprint of every parameter buffer.  Any rebind of a
+    param's backing array (optimizer step, set_state_dict, checkpoint
+    load) changes the tuple, invalidating decode steps that captured the
+    old weights as jit constants (ADVICE r2: a stale compiled step would
+    otherwise silently serve pre-update weights)."""
+    return tuple(id(p._value) for p in model.parameters())
+
+
 def make_decode_step(model):
     """One jit-compiled single-token decode step over static caches.
 
@@ -83,12 +92,14 @@ def make_decode_step(model):
     fused_multi_transformer's decode kernel.  Model weights are captured
     as jit constants (inference: they never change under the trace).
 
-    The wrapper is cached ON THE MODEL: jax.jit's own cache then holds
-    one executable per (B, max_len) across generate() calls — a fresh
-    wrapper per call would retrace + recompile the whole transformer
-    every request."""
+    The wrapper is cached ON THE MODEL keyed by a weights fingerprint:
+    jax.jit's own cache then holds one executable per (B, max_len) across
+    generate() calls — a fresh wrapper per call would retrace + recompile
+    the whole transformer every request, while an un-fingerprinted one
+    would keep serving stale weights after training/set_state_dict."""
+    fp = _weights_fingerprint(model)
     step = getattr(model, "_decode_step", None)
-    if step is not None:
+    if step is not None and getattr(model, "_decode_step_fp", None) == fp:
         return step
 
     from .llama import StaticKVCache
@@ -105,6 +116,39 @@ def make_decode_step(model):
                     [(c.k, c.v) for c in new_caches])
 
     model._decode_step = step
+    model._decode_step_fp = fp
+    return step
+
+
+def make_beam_decode_step(model):
+    """Beam-search decode step over static caches: re-indexes the
+    preallocated caches by `parents` on the batch*beam axis INSIDE the
+    compiled program, then decodes one token (reference semantics:
+    BeamSearchDecoder's gather of cell states, fluid/layers/rnn.py, over
+    fused_multi_transformer's fixed CacheKV).  step(tok[BV,1], caches,
+    offset, parents[BV]) -> (logits[BV,V] f32, new_caches)."""
+    fp = _weights_fingerprint(model)
+    step = getattr(model, "_beam_decode_step", None)
+    if step is not None and \
+            getattr(model, "_beam_decode_step_fp", None) == fp:
+        return step
+
+    from .llama import StaticKVCache
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    def step(tok, caches, offset, parents):
+        with no_grad_ctx():
+            wrapped = [StaticKVCache(k[parents], v[parents])
+                       for k, v in caches]
+            logits, new_caches = model(Tensor(tok), caches=wrapped,
+                                       position_offset=offset)
+            return (logits._value[:, -1].astype(jnp.float32),
+                    [(c.k, c.v) for c in new_caches])
+
+    model._beam_decode_step = step
+    model._beam_decode_step_fp = fp
     return step
 
 
@@ -131,14 +175,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings ({max_pos}) — the rope table has no "
             f"entries past it (dynamic_slice would silently clamp)")
-    if use_static_cache and num_beams > 1:
-        raise NotImplementedError(
-            "use_static_cache with beam search is not supported yet "
-            "(beam gathering re-indexes grow caches)")
     with no_grad_ctx():
         if num_beams > 1:
             return _beam_generate(model, ids, max_new_tokens, num_beams,
-                                  eos_token_id)
+                                  eos_token_id,
+                                  use_static_cache=use_static_cache)
         # seed=None draws from the framework RNG stream (paddle.seed)
         key = rnd.next_key() if seed is None else jax.random.PRNGKey(seed)
         caches = _static_caches(model, B, T0 + max_new_tokens) \
@@ -177,15 +218,24 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         return to_tensor(np.concatenate(out, axis=1))
 
 
-def _beam_generate(model, ids, max_new_tokens, beams, eos_token_id):
+def _beam_generate(model, ids, max_new_tokens, beams, eos_token_id,
+                   use_static_cache=False):
     B, T0 = ids.shape
     BV = B * beams
     # prefill once per prompt, then replicate caches across beams
-    caches = _empty_caches(model, B)
+    caches = _static_caches(model, B, T0 + max_new_tokens) \
+        if use_static_cache else _empty_caches(model, B)
     logits, caches = model(to_tensor(ids.astype(np.int32)), caches=caches,
                            position_offset=0)
     rep = jnp.repeat(jnp.arange(B), beams)
-    caches = _gather_caches(caches, rep)
+    beam_step = None
+    if use_static_cache:
+        beam_step = make_beam_decode_step(model)
+        # replicate the fixed-size buffers across beams; per-step gathers
+        # then happen inside the compiled step
+        cache_arrays = [(c.k[rep], c.v[rep]) for c in caches]
+    else:
+        caches = _gather_caches(caches, rep)
     last = jnp.repeat(logits._value[:, -1].astype(jnp.float32), beams,
                       axis=0)                      # [B*beams, V]
     scores = jnp.tile(jnp.asarray([0.0] + [-1e9] * (beams - 1)), (B,))
@@ -205,17 +255,25 @@ def _beam_generate(model, ids, max_new_tokens, beams, eos_token_id):
         parents = (top_idx // V + jnp.arange(B)[:, None] * beams).reshape(-1)
         toks = (top_idx % V).reshape(-1)
         scores = top_scores.reshape(-1)
-        caches = _gather_caches(caches, parents)
+        if beam_step is None:
+            caches = _gather_caches(caches, parents)
         if eos_token_id is not None:
             finished = finished[parents] | (toks == eos_token_id)
         tokens_acc.append(np.asarray(toks))
         parents_acc.append(np.asarray(parents))
         if eos_token_id is not None and bool(finished.all()):
             break
-        cur = to_tensor(np.asarray(toks)[:, None].astype(np.int32))
-        logits, caches = model(cur, caches=caches,
-                               position_offset=T0 + step)
-        last = logits._value[:, -1].astype(jnp.float32)
+        cur_raw = np.asarray(toks)[:, None].astype(np.int32)
+        if beam_step is not None:
+            # cache re-indexing by `parents` happens inside the compiled
+            # step: one executable serves the whole beam generation
+            last, cache_arrays = beam_step(
+                cur_raw, cache_arrays, np.int32(T0 + step),
+                np.asarray(parents))
+        else:
+            logits, caches = model(to_tensor(cur_raw), caches=caches,
+                                   position_offset=T0 + step)
+            last = logits._value[:, -1].astype(jnp.float32)
     # backtrace best beam (beam 0 holds the max score after top_k)
     T = len(tokens_acc)
     seq = np.zeros((BV, T), np.int64)

@@ -125,7 +125,8 @@ _METHODS = [
     "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
     "index_add", "index_fill", "masked_select", "masked_fill", "unique", "pad",
     "repeat_interleave", "as_complex", "as_real", "cast", "view", "view_as",
-    "tensordot", "where",
+    "tensordot", "where", "unfold", "as_strided", "vander", "trapezoid",
+    "cumulative_trapezoid",
     # logic
     "equal", "not_equal", "greater_than", "greater_equal", "less_than",
     "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",

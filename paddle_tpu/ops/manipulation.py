@@ -514,3 +514,115 @@ def array_read(array, i):
 
 def array_length(array):
     return Tensor(jnp.asarray(len(array)))  # int32 — TPU-native index width
+
+
+# ------------------------------------------------- strided views
+# TPU-native: XLA arrays are not strided, so these "view" ops lower to
+# gathers/slices the compiler fuses (reference: paddle Tensor.unfold /
+# as_strided are true views over strided memory).
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis`: returns shape
+    [..., n_windows, ..., size] with the window dim appended last
+    (paddle.Tensor.unfold semantics)."""
+    def _unfold(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        if n <= 0:
+            raise ValueError(
+                f"unfold: size {size} > dim {v.shape[ax]} along axis {ax}")
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+        return jnp.moveaxis(out, ax + 1, -1)
+    return apply("unfold_axis", _unfold, _t(x))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view re-expressed as a gather over the flattened buffer
+    (strides are in ELEMENTS of the flat layout, matching the reference's
+    as_strided over contiguous memory)."""
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+    if len(shape) != len(stride):
+        raise ValueError("as_strided: shape and stride rank mismatch")
+    if offset < 0 or any(s < 0 for s in shape) or any(
+            st < 0 for st in stride):
+        raise ValueError("as_strided: negative shape/stride/offset")
+    size = int(np.prod(_t(x).shape)) if _t(x).shape else 1
+    max_idx = offset + sum((s - 1) * st for s, st in zip(shape, stride)
+                           if s > 0)
+    if max_idx >= size:
+        raise ValueError(
+            f"as_strided: max element index {max_idx} out of bounds for "
+            f"tensor of {size} elements")
+
+    def _as_strided(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset)
+        for s, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(s) * st
+        return flat[idx.reshape(-1)].reshape(tuple(shape))
+    return apply("as_strided", _as_strided, _t(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference paddle.vander semantics; numpy's
+    column order is decreasing by default, same here)."""
+    def _vander(v):
+        if v.ndim != 1:
+            raise ValueError("vander expects a 1-D tensor")
+        cols = v.shape[0] if n is None else int(n)
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :].astype(v.dtype)
+    return apply("vander", _vander, _t(x))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral along `axis` (paddle.trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass either x or dx, not both")
+
+    if x is None:
+        d = 1.0 if dx is None else dx
+
+        def _trap(yv):
+            ys = jnp.moveaxis(yv, axis, -1)
+            return jnp.sum((ys[..., 1:] + ys[..., :-1]) * (d / 2.0), -1)
+        return apply("trapezoid", _trap, _t(y))
+
+    def _trap2(yv, xv):
+        ys = jnp.moveaxis(yv, axis, -1)
+        if xv.ndim == 1:
+            dxs = xv[1:] - xv[:-1]
+        else:
+            xs = jnp.moveaxis(xv, axis, -1)
+            dxs = xs[..., 1:] - xs[..., :-1]
+        return jnp.sum((ys[..., 1:] + ys[..., :-1]) * dxs / 2.0, -1)
+    return apply("trapezoid", _trap2, _t(y), _t(x))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid: pass either x or dx")
+    if x is None:
+        d = 1.0 if dx is None else dx
+
+        def _ct(yv):
+            ys = jnp.moveaxis(yv, axis, -1)
+            seg = (ys[..., 1:] + ys[..., :-1]) * (d / 2.0)
+            return jnp.moveaxis(jnp.cumsum(seg, -1), -1, axis)
+        return apply("cumulative_trapezoid", _ct, _t(y))
+
+    def _ct2(yv, xv):
+        ys = jnp.moveaxis(yv, axis, -1)
+        if xv.ndim == 1:
+            dxs = xv[1:] - xv[:-1]
+        else:
+            dxs = jnp.moveaxis(xv, axis, -1)
+            dxs = dxs[..., 1:] - dxs[..., :-1]
+        seg = (ys[..., 1:] + ys[..., :-1]) * dxs / 2.0
+        return jnp.moveaxis(jnp.cumsum(seg, -1), -1, axis)
+    return apply("cumulative_trapezoid", _ct2, _t(y), _t(x))

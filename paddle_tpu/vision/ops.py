@@ -499,3 +499,264 @@ def ConvNormActivation(in_channels, out_channels, kernel_size=3, stride=1,
     if activation_layer is not None:
         layers.append(activation_layer())
     return nn.Sequential(*layers)
+
+
+# ------------------------------------------------------------------ yolo
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference:
+    python/paddle/vision/ops.py:43 yolo_loss over
+    phi/kernels/cpu/yolov3_loss_kernel.cc Yolov3LossKernel).
+
+    TPU-native: fully vectorized jnp — per-cell ignore masks from a
+    broadcast IoU against all gt boxes, per-gt anchor matching by argmax,
+    and a lax.scan over the (static) gt slots reproducing the kernel's
+    sequential obj-mask overwrite semantics.  Differentiable w.r.t. x by
+    construction (the reference ships a handwritten grad kernel).
+    x: [N, S*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h in [0, 1]);
+    gt_label: [N, B] int; returns loss [N]."""
+    anchors = [int(a) for a in anchors]
+    anchor_mask = [int(m) for m in anchor_mask]
+    S = len(anchor_mask)
+    C = int(class_num)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def _sce(logit, label):
+        # sigmoid cross entropy, the kernel's numerically-stable form
+        return (jnp.maximum(logit, 0.0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def _iou_cwh(b1, b2):
+        # boxes as (cx, cy, w, h); ... broadcastable
+        lo = jnp.maximum(b1[..., :2] - b1[..., 2:] / 2,
+                         b2[..., :2] - b2[..., 2:] / 2)
+        hi = jnp.minimum(b1[..., :2] + b1[..., 2:] / 2,
+                         b2[..., :2] + b2[..., 2:] / 2)
+        wh = jnp.clip(hi - lo, 0.0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter)
+        return inter / jnp.where(union > 0, union, 1.0)
+
+    def _fn(xv, gtb, gtl, *rest):
+        gts = rest[0] if rest else None
+        N, _, H, W = xv.shape
+        B = gtb.shape[1]
+        input_size = downsample_ratio * H
+        xr = xv.reshape(N, S, 5 + C, H, W)
+        anc = jnp.asarray(anchors, xv.dtype).reshape(-1, 2)  # [A, 2]
+        anc_m = anc[jnp.asarray(anchor_mask)]                # [S, 2]
+
+        if use_label_smooth:
+            sm = min(1.0 / C, 1.0 / 40.0)
+            pos, neg = 1.0 - sm, sm
+        else:
+            pos, neg = 1.0, 0.0
+        score = gts if gts is not None else jnp.ones((N, B), xv.dtype)
+        valid = (gtb[..., 2] > 1e-6) & (gtb[..., 3] > 1e-6)   # [N, B]
+
+        # ---- per-cell decoded boxes & ignore mask (no grad: the kernel
+        # computes the mask as data, not through autodiff)
+        xd = jax.lax.stop_gradient(xr)
+        gy, gx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+        px = (gx[None, None] + jax.nn.sigmoid(xd[:, :, 0]) * scale
+              + bias) / W
+        py = (gy[None, None] + jax.nn.sigmoid(xd[:, :, 1]) * scale
+              + bias) / H
+        pw = jnp.exp(xd[:, :, 2]) * anc_m[None, :, 0, None, None] \
+            / input_size
+        ph = jnp.exp(xd[:, :, 3]) * anc_m[None, :, 1, None, None] \
+            / input_size
+        pred = jnp.stack([px, py, pw, ph], -1)          # [N, S, H, W, 4]
+        ious = _iou_cwh(pred[:, :, :, :, None, :],
+                        gtb[:, None, None, None, :, :])  # [N,S,H,W,B]
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = ious.max(-1)                          # [N, S, H, W]
+        obj_mask0 = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+        # ---- per-gt anchor matching (vs ALL anchors, centered boxes)
+        an_wh = anc / input_size                         # [A, 2]
+        zeros2 = jnp.zeros_like(an_wh)
+        an_boxes = jnp.concatenate([zeros2, an_wh], -1)  # [A, 4]
+        gt_shift = gtb.at[..., :2].set(0.0)              # [N, B, 4]
+        an_iou = _iou_cwh(gt_shift[:, :, None, :],
+                          an_boxes[None, None, :, :])    # [N, B, A]
+        best_n = jnp.argmax(an_iou, -1)                  # [N, B]
+        mask_lut = -np.ones(len(anchors) // 2, np.int32)
+        for mi, a in enumerate(anchor_mask):
+            mask_lut[a] = mi
+        mask_idx = jnp.asarray(mask_lut)[best_n]         # [N, B]
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        use = valid & (mask_idx >= 0)
+
+        # ---- scan over gt slots: location/class losses + obj overwrite
+        nidx = jnp.arange(N)
+
+        def per_gt(carry, t):
+            loss, obj = carry
+            gt_t = gtb[:, t]                              # [N, 4]
+            mi = jnp.clip(mask_idx[:, t], 0, S - 1)
+            gi_t, gj_t = gi[:, t], gj[:, t]
+            sc = score[:, t] * use[:, t].astype(xv.dtype)
+            cell = xr[nidx, mi, :, gj_t, gi_t]            # [N, 5+C]
+            tx = gt_t[:, 0] * W - gi_t
+            ty = gt_t[:, 1] * H - gj_t
+            tw = jnp.log(jnp.where(use[:, t],
+                                   gt_t[:, 2] * input_size
+                                   / anc[jnp.clip(best_n[:, t], 0,
+                                                  anc.shape[0] - 1), 0],
+                                   1.0))
+            th = jnp.log(jnp.where(use[:, t],
+                                   gt_t[:, 3] * input_size
+                                   / anc[jnp.clip(best_n[:, t], 0,
+                                                  anc.shape[0] - 1), 1],
+                                   1.0))
+            wbox = (2.0 - gt_t[:, 2] * gt_t[:, 3]) * sc
+            l_loc = (_sce(cell[:, 0], tx) + _sce(cell[:, 1], ty)
+                     + jnp.abs(cell[:, 2] - tw)
+                     + jnp.abs(cell[:, 3] - th)) * wbox
+            labels1h = jnp.where(
+                jax.nn.one_hot(gtl[:, t], C) > 0, pos, neg)
+            l_cls = (_sce(cell[:, 5:], labels1h).sum(-1)) * sc
+            loss = loss + l_loc + l_cls
+            obj = obj.at[nidx, mi, gj_t, gi_t].set(
+                jnp.where(use[:, t], sc, obj[nidx, mi, gj_t, gi_t]))
+            return (loss, obj), None
+
+        (loss, obj_mask), _ = jax.lax.scan(
+            per_gt, (jnp.zeros((N,), xv.dtype), obj_mask0), jnp.arange(B))
+
+        # ---- objectness loss over every cell
+        obj_logit = xr[:, :, 4]                           # [N, S, H, W]
+        l_pos = _sce(obj_logit, 1.0) * obj_mask
+        l_neg = _sce(obj_logit, 0.0)
+        l_obj = jnp.where(obj_mask > 1e-5, l_pos,
+                          jnp.where(obj_mask > -0.5, l_neg, 0.0))
+        return loss + l_obj.sum((1, 2, 3))
+
+    args = [_t(x), _t(gt_box), _t(gt_label)]
+    if gt_score is not None:
+        args.append(_t(gt_score))
+    return apply("yolo_loss", _fn, *args)
+
+
+# ------------------------------------------------- proposal generation
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """Faster-RCNN RPN proposals (reference:
+    fluid/layers/detection.py:2908 generate_proposals over
+    fluid/operators/detection/generate_proposals_v2_op.cc
+    ProposalForOneImage: top-k -> BoxCoder decode -> clip -> min-size
+    filter -> NMS -> top post_nms).  Data-dependent output sizes: host-
+    side op (eager only), like nms."""
+    if in_static_trace():
+        raise RuntimeError(
+            "generate_proposals has data-dependent shape; run outside jit")
+    sc = np.asarray(_t(scores)._value)       # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas)._value)  # [N, 4A, H, W]
+    ims = np.asarray(_t(img_size)._value)    # [N, 2] (h, w)
+    anc = np.asarray(_t(anchors)._value).reshape(-1, 4)
+    var = np.asarray(_t(variances)._value).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, rois_num = [], [], []
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)          # [(H W A)]
+        d = bd[i].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = len(s) if pre_nms_top_n <= 0 else min(pre_nms_top_n, len(s))
+        order = np.argsort(-s)[:k]
+        s_sel, d_sel = s[order], d[order]
+        a_sel, v_sel = anc[order], var[order]
+
+        aw = a_sel[:, 2] - a_sel[:, 0] + off
+        ah = a_sel[:, 3] - a_sel[:, 1] + off
+        acx = a_sel[:, 0] + 0.5 * aw
+        acy = a_sel[:, 1] + 0.5 * ah
+        cx = v_sel[:, 0] * d_sel[:, 0] * aw + acx
+        cy = v_sel[:, 1] * d_sel[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v_sel[:, 2] * d_sel[:, 2], _BBOX_CLIP)) * aw
+        bh = np.exp(np.minimum(v_sel[:, 3] * d_sel[:, 3], _BBOX_CLIP)) * ah
+        props = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        imh, imw = float(ims[i][0]), float(ims[i][1])
+        props[:, 0] = np.clip(props[:, 0], 0, imw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, imh - off)
+        props[:, 2] = np.clip(props[:, 2], 0, imw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, imh - off)
+
+        ms = max(float(min_size), 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            keep &= (props[:, 0] + ws / 2 <= imw) & \
+                    (props[:, 1] + hs / 2 <= imh)
+        props, s_keep = props[keep], s_sel[keep]
+        if len(props) == 0:
+            props = np.zeros((1, 4), sc.dtype)
+            s_keep = np.zeros((1,), sc.dtype)
+        elif nms_thresh > 0:
+            ki = np.asarray(nms(Tensor(jnp.asarray(props)),
+                                iou_threshold=nms_thresh,
+                                scores=Tensor(jnp.asarray(s_keep)))
+                            ._value)
+            if post_nms_top_n > 0:
+                ki = ki[:post_nms_top_n]
+            props, s_keep = props[ki], s_keep[ki]
+        all_rois.append(props)
+        all_probs.append(s_keep[:, None])
+        rois_num.append(len(props))
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(rois_num,
+                                                          np.int32)))
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Scatter RoIs to FPN levels by box scale (reference:
+    fluid/layers/detection.py:3687 over
+    operators/detection/distribute_fpn_proposals_op.h: level =
+    floor(log2(sqrt(area)/refer_scale + 1e-6) + refer_level), clipped).
+    Returns (multi_rois list, restore_ind [, rois_num_per_level])."""
+    if in_static_trace():
+        raise RuntimeError("distribute_fpn_proposals has data-dependent "
+                           "shape; run outside jit")
+    rois = np.asarray(_t(fpn_rois)._value)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, nums, order = [], [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(
+            rois[idx] if len(idx) else np.zeros((0, 4), rois.dtype))))
+        nums.append(len(idx))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    # restore_ind[j] = position of original roi j in the concatenated
+    # level-major output (the reference's RestoreIndex)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_ind = Tensor(jnp.asarray(restore[:, None].astype(np.int32)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, Tensor(
+            jnp.asarray(np.asarray(nums, np.int32)))
+    return multi_rois, restore_ind

@@ -485,3 +485,113 @@ class TestHubAndVersion:
         assert paddle.version.full_version == paddle.__version__
         assert paddle.version.cuda() is None
         assert hasattr(paddle, "callbacks")
+
+
+class TestSubmConvNative:
+    """Sparse-NATIVE submanifold conv (VERDICT r2 #4; reference:
+    phi/kernels/sparse/gpu/convolution_kernel.cu gather-GEMM-scatter)."""
+
+    def _random_coo(self, N, D, H, W, C, density, seed=0):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        rng = np.random.RandomState(seed)
+        dense = np.zeros((N, D, H, W, C), np.float32)
+        n_sites = max(1, int(density * N * D * H * W))
+        flat = rng.choice(N * D * H * W, n_sites, replace=False)
+        coords = np.stack(np.unravel_index(flat, (N, D, H, W)), 1)
+        dense[coords[:, 0], coords[:, 1], coords[:, 2], coords[:, 3]] = \
+            rng.randn(n_sites, C).astype(np.float32)
+        x = sp.SparseCooTensor.__new__(sp.SparseCooTensor)
+        x._bcoo = jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)
+        x._shape = dense.shape
+        return x, dense
+
+    def test_parity_with_dense_lowering(self):
+        import jax
+
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._random_coo(2, 6, 6, 6, 3, density=0.15)
+        for dil in (1, 2):
+            conv = sp.nn.SubmConv3D(3, 4, 3, padding=dil, dilation=dil)
+            y = conv(x).to_dense().numpy()
+            ref = jax.lax.conv_general_dilated(
+                dense, np.asarray(conv.weight._value.tolist(), np.float32),
+                window_strides=(1, 1, 1),
+                padding=[(dil, dil)] * 3, rhs_dilation=(dil, dil, dil),
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            ref = np.asarray(ref) + np.asarray(conv.bias._value)
+            active = (dense != 0).any(-1)
+            ref = np.where(active[..., None], ref, 0.0)
+            np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+    def test_no_todense_in_conv_path(self, monkeypatch):
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        x, _ = self._random_coo(1, 5, 5, 5, 2, density=0.1)
+        conv = sp.nn.SubmConv3D(2, 3, 3, padding=1)
+
+        def boom(*a, **k):
+            raise AssertionError("todense called in SubmConv3D path")
+
+        monkeypatch.setattr(jsparse.BCOO, "todense", boom)
+        monkeypatch.setattr(jsparse, "bcoo_todense", boom, raising=False)
+        y = conv(x)
+        assert y.nnz() == x.nnz()
+
+    def test_weight_grads_flow(self):
+        import paddle_tpu.sparse as sp
+
+        x, _ = self._random_coo(1, 5, 5, 5, 2, density=0.1)
+        conv = sp.nn.SubmConv3D(2, 3, 3, padding=1)
+        y = conv(x)
+        loss = (y.values() ** 2).sum()
+        loss.backward()
+        g = conv.weight.grad
+        assert g is not None and g.shape == conv.weight.shape
+        assert float(np.abs(g.numpy()).sum()) > 0
+
+    def test_speedup_vs_dense_at_1pct(self):
+        """>=5x faster than the dense lowering at 1% density (the sparse
+        win the todense path could never deliver)."""
+        import time
+
+        import jax
+
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._random_coo(1, 32, 32, 32, 32, density=0.01)
+        conv = sp.nn.SubmConv3D(32, 32, 3, padding=1, bias_attr=False)
+        w = conv.weight._value
+
+        def dense_path():
+            out = jax.lax.conv_general_dilated(
+                jax.numpy.asarray(dense), w, window_strides=(1, 1, 1),
+                padding=[(1, 1)] * 3,
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            out.block_until_ready()
+
+        def native_path():
+            y = conv(x)
+            y._bcoo.data.block_until_ready()
+
+        def best_of(fn, n):
+            # min-of-n wall time: robust to descheduling under suite load
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        native_path()  # warm compile caches
+        dense_path()
+        t_native = best_of(native_path, 5)
+        t_dense = best_of(dense_path, 3)
+        assert t_native * 5 < t_dense, (
+            f"native {t_native * 1e3:.1f}ms vs dense {t_dense * 1e3:.1f}ms")

@@ -707,11 +707,73 @@ class TestIncubateFusedFunctional:
         w2 = np.random.RandomState(2).randn(16, D).astype(np.float32) * 0.1
         f = IF.fused_feedforward(
             paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
-            activation="gelu", ln2_scale=np.ones(D, np.float32)).numpy()
+            activation="gelu", ln2_scale=np.ones(D, np.float32),
+            training=False).numpy()
         ref = x + np.asarray(jax.nn.gelu(x @ w1, approximate=False)) @ w2
         refn = (ref - ref.mean(-1, keepdims=True)) / np.sqrt(
             ref.var(-1, keepdims=True) + 1e-5)
         np.testing.assert_allclose(f, refn, atol=1e-4)
+
+    def test_fused_dropout_applied_in_training(self):
+        """ADVICE r2: dropout rates must actually drop under training
+        (reference fused ops default dropout 0.5), draw from the framework
+        RNG (seed-reproducible), and be inert at eval."""
+        from paddle_tpu.incubate.nn import functional as IF
+
+        B, T, D = 2, 6, 16
+        x = np.abs(np.random.RandomState(0).randn(B, T, D)).astype(
+            np.float32) + 1.0
+        w1 = np.eye(D, dtype=np.float32)
+        w2 = np.eye(D, dtype=np.float32)
+
+        def run(**kw):
+            return IF.fused_feedforward(
+                paddle.to_tensor(x), paddle.to_tensor(w1),
+                paddle.to_tensor(w2), add_residual=False,
+                pre_layer_norm=True, ln1_scale=np.ones(D, np.float32),
+                **kw).numpy()
+
+        paddle.seed(42)
+        a = run(training=True)
+        paddle.seed(42)
+        b = run(training=True)
+        np.testing.assert_array_equal(a, b)  # framework RNG, seeded
+        # relu zeroes ~half, then d1/d2 each drop 0.5 of survivors:
+        # expected nonzero ~ 0.5 * 0.25 = 0.125
+        frac_zero = float((a == 0).mean())
+        assert 0.7 < frac_zero < 0.97, frac_zero
+        c = run(training=False)
+        frac_zero_eval = float((c == 0).mean())
+        assert frac_zero_eval < 0.65, frac_zero_eval  # only relu's zeros
+        # upscale_in_train preserves expectation within tolerance
+        assert abs(a.mean() - c.mean()) / abs(c.mean()) < 0.35
+
+        # downscale_in_infer: no train upscale; eval multiplies by (1-p)
+        paddle.seed(42)
+        a_ds = run(training=True, mode="downscale_in_infer")
+        c_ds = run(training=False, mode="downscale_in_infer")
+        np.testing.assert_allclose(c_ds, c * 0.25, rtol=1e-5)  # two 0.5s
+        nz = a_ds != 0
+        np.testing.assert_allclose(a_ds[nz], c[nz], rtol=1e-5)  # no scale
+
+        # MHA: attn/out dropout engage only when rates are nonzero
+        H = 4
+        qkv_w = np.random.RandomState(1).randn(
+            3, H, D // H, D).astype(np.float32) * 0.1
+        lin_w = np.eye(D, dtype=np.float32)
+        paddle.seed(7)
+        m1 = IF.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+            paddle.to_tensor(lin_w), dropout_rate=0.5,
+            attn_dropout_rate=0.5, add_residual=False, training=True,
+            pre_layer_norm=True).numpy()
+        m_eval = IF.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+            paddle.to_tensor(lin_w), dropout_rate=0.5,
+            attn_dropout_rate=0.5, add_residual=False, training=False,
+            pre_layer_norm=True).numpy()
+        assert float((m1 == 0).mean()) > 0.2
+        assert float((m_eval == 0).mean()) < 0.05
 
     def test_grads_flow_through_fused_mha(self):
         from paddle_tpu.incubate.nn import functional as IF

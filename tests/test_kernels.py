@@ -281,11 +281,15 @@ class TestAutotuneCache:
         at._disk_loaded = False
         calls = []
 
+        # FAKE CLOCK (VERDICT r2 weak #7): real 1-3ms sleeps rank wrongly
+        # under full-suite load; a deterministic virtual timer keeps the
+        # ranking exact regardless of scheduler noise
+        fake_now = [0.0]
+        monkeypatch.setattr(at.time, "perf_counter", lambda: fake_now[0])
+
         def run(cfg):
             calls.append(cfg)
-            import time
-
-            time.sleep(0.001 * cfg[0])  # smaller cfg is faster
+            fake_now[0] += 0.001 * cfg[0]  # smaller cfg is "faster"
 
         best = at.autotune("dummy", (64, "f32"), [(2,), (1,), (3,)], run,
                            warmup=0, iters=1)

@@ -193,6 +193,69 @@ class TestGeneration:
                                 use_static_cache=True)
         np.testing.assert_array_equal(grow.numpy(), static.numpy())
 
+    def test_beam_static_cache_matches_grow_cache(self):
+        """VERDICT r2 #3 done bar: static-cache beam search == dynamic-cache
+        beam search token-for-token (the compiled step re-indexes the
+        preallocated caches by beam parents inside the jit)."""
+        model = self._model()
+        ids = paddle.to_tensor(np.random.RandomState(2).randint(
+            0, 100, (2, 4)).astype(np.int32))
+        grow = model.generate(ids, max_new_tokens=6, num_beams=3,
+                              do_sample=False)
+        static = model.generate(ids, max_new_tokens=6, num_beams=3,
+                                do_sample=False, use_static_cache=True)
+        np.testing.assert_array_equal(grow.numpy(), static.numpy())
+
+    def test_beam_one_matches_greedy(self):
+        """num_beams=1 beam search degenerates to greedy decoding (both
+        cache modes)."""
+        from paddle_tpu.models.generation import _beam_generate
+
+        model = self._model()
+        ids = np.random.RandomState(3).randint(0, 100, (2, 4)).astype(
+            np.int32)
+        greedy = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                temperature=0.0).numpy()
+        for static in (False, True):
+            beam1 = _beam_generate(model, ids, 5, 1, None,
+                                   use_static_cache=static)
+            np.testing.assert_array_equal(beam1.numpy(), greedy)
+
+    def test_beam_static_cache_eos(self):
+        """eos early-stop in static-cache beam search matches dynamic."""
+        model = self._model()
+        ids = np.array([[1, 2, 3]], np.int32)
+        g = model.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                           num_beams=2, do_sample=False).numpy()
+        eos = int(g[0, 3])
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                           num_beams=2, do_sample=False,
+                           eos_token_id=eos).numpy()
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                           num_beams=2, do_sample=False, eos_token_id=eos,
+                           use_static_cache=True).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_decode_step_invalidated_on_weight_change(self):
+        """ADVICE r2 (medium): the cached compiled decode step captures
+        weights as jit constants; rebinding any parameter (training step,
+        set_state_dict) must invalidate it — generation after a weight
+        update must NOT reuse stale compiled weights."""
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+        out1 = model.generate(ids, max_new_tokens=4, temperature=0.0,
+                              use_static_cache=True).numpy()
+        step1 = model._decode_step
+        # rebind weights to shifted values (as set_state_dict would)
+        sd = {k: v.numpy() + 0.05 for k, v in model.state_dict().items()}
+        model.set_state_dict(sd)
+        out2 = model.generate(ids, max_new_tokens=4, temperature=0.0,
+                              use_static_cache=True).numpy()
+        assert model._decode_step is not step1, \
+            "decode step must be rebuilt after weight rebind"
+        ref = model.generate(ids, max_new_tokens=4, temperature=0.0).numpy()
+        np.testing.assert_array_equal(out2, ref)
+
     def test_static_cache_shapes_constant(self):
         """The whole point of StaticKVCache: every decode step reuses one
         buffer shape (growing shapes would recompile per token on TPU)."""

@@ -429,3 +429,274 @@ class TestDecompositionGrads:
         w1 = paddle.linalg.eigvalsh(paddle.to_tensor(a)).numpy()
         w2, _ = paddle.linalg.eigh(paddle.to_tensor(a))
         np.testing.assert_allclose(w1, w2.numpy(), rtol=1e-5)
+
+
+def _yolo_loss_numpy(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                     ignore_thresh, downsample_ratio, gt_score=None,
+                     use_label_smooth=True, scale_x_y=1.0):
+    """Independent loop-style port of the kernel semantics
+    (phi/kernels/cpu/yolov3_loss_kernel.cc) used as the OpTest reference."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def sce(v, t):
+        return max(v, 0.0) - v * t + np.log1p(np.exp(-abs(v)))
+
+    def iou(b1, b2):
+        lo = np.maximum(b1[:2] - b1[2:] / 2, b2[:2] - b2[2:] / 2)
+        hi = np.minimum(b1[:2] + b1[2:] / 2, b2[:2] + b2[2:] / 2)
+        wh = np.clip(hi - lo, 0, None)
+        inter = wh[0] * wh[1]
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter + 1e-30)
+
+    N, _, H, W = x.shape
+    S = len(anchor_mask)
+    C = class_num
+    B = gt_box.shape[1]
+    xr = x.reshape(N, S, 5 + C, H, W)
+    input_size = downsample_ratio * H
+    scale, bias = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    an = np.asarray(anchors, np.float64).reshape(-1, 2)
+    score = gt_score if gt_score is not None else np.ones((N, B))
+    if use_label_smooth:
+        sm = min(1.0 / C, 1.0 / 40.0)
+        pos, neg = 1.0 - sm, sm
+    else:
+        pos, neg = 1.0, 0.0
+    loss = np.zeros(N)
+    for i in range(N):
+        obj = np.zeros((S, H, W))
+        for j in range(S):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + sig(xr[i, j, 0, k, l]) * scale + bias) / W
+                    py = (k + sig(xr[i, j, 1, k, l]) * scale + bias) / H
+                    pw = np.exp(xr[i, j, 2, k, l]) * an[anchor_mask[j], 0] \
+                        / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * an[anchor_mask[j], 1] \
+                        / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                            continue
+                        best = max(best, iou(np.array([px, py, pw, ph]),
+                                             gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj[j, k, l] = -1
+        for t in range(B):
+            if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                continue
+            gt = gt_box[i, t].astype(np.float64)
+            gi, gj = int(gt[0] * W), int(gt[1] * H)
+            best_iou, best_n = 0.0, 0
+            for a_i in range(an.shape[0]):
+                abox = np.array([0, 0, an[a_i, 0] / input_size,
+                                 an[a_i, 1] / input_size])
+                v = iou(abox, np.array([0, 0, gt[2], gt[3]]))
+                if v > best_iou:
+                    best_iou, best_n = v, a_i
+            if best_n not in anchor_mask:
+                continue
+            mi = anchor_mask.index(best_n)
+            sc = score[i, t]
+            tx, ty = gt[0] * W - gi, gt[1] * H - gj
+            tw = np.log(gt[2] * input_size / an[best_n, 0])
+            th = np.log(gt[3] * input_size / an[best_n, 1])
+            wb = (2.0 - gt[2] * gt[3]) * sc
+            cell = xr[i, mi, :, gj, gi]
+            loss[i] += (sce(cell[0], tx) + sce(cell[1], ty)
+                        + abs(cell[2] - tw) + abs(cell[3] - th)) * wb
+            obj[mi, gj, gi] = sc
+            lab = int(gt_label[i, t])
+            for c in range(C):
+                loss[i] += sce(cell[5 + c], pos if c == lab else neg) * sc
+        for j in range(S):
+            for k in range(H):
+                for l in range(W):
+                    o = obj[j, k, l]
+                    if o > 1e-5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 0.0)
+    return loss
+
+
+class TestDetectionLongTail:
+    """yolo_loss / generate_proposals / distribute_fpn_proposals
+    (VERDICT r2 #9; reference operators/detection/*.cc)."""
+
+    def _yolo_case(self):
+        rng = np.random.RandomState(0)
+        N, S, C, H = 2, 2, 3, 4
+        x = rng.randn(N, S * (5 + C), H, H).astype(np.float32) * 0.5
+        gt_box = rng.uniform(0.05, 0.6, (N, 5, 4)).astype(np.float32)
+        gt_box[:, -1, 2:] = 0.0  # a padded (invalid) gt slot
+        gt_label = rng.randint(0, C, (N, 5)).astype(np.int32)
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1],
+                  class_num=C, ignore_thresh=0.5, downsample_ratio=8)
+        return x, gt_box, gt_label, kw
+
+    def test_yolo_loss_matches_kernel_semantics(self):
+        import paddle_tpu.vision.ops as vops
+
+        x, gt_box, gt_label, kw = self._yolo_case()
+        got = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                             paddle.to_tensor(gt_label), **kw).numpy()
+        ref = _yolo_loss_numpy(x, gt_box, gt_label,
+                               kw["anchors"], kw["anchor_mask"],
+                               kw["class_num"], kw["ignore_thresh"],
+                               kw["downsample_ratio"])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        # label smooth off + mixup scores
+        rng = np.random.RandomState(3)
+        gts = rng.uniform(0.3, 1.0, gt_label.shape).astype(np.float32)
+        got2 = vops.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gt_box),
+                              paddle.to_tensor(gt_label),
+                              gt_score=paddle.to_tensor(gts),
+                              use_label_smooth=False, **kw).numpy()
+        ref2 = _yolo_loss_numpy(x, gt_box, gt_label,
+                                kw["anchors"], kw["anchor_mask"],
+                                kw["class_num"], kw["ignore_thresh"],
+                                kw["downsample_ratio"], gt_score=gts,
+                                use_label_smooth=False)
+        np.testing.assert_allclose(got2, ref2, rtol=1e-4, atol=1e-4)
+
+    def test_yolo_loss_grad_fd(self):
+        import paddle_tpu.vision.ops as vops
+
+        x, gt_box, gt_label, kw = self._yolo_case()
+        t = paddle.to_tensor(x, stop_gradient=False)
+        loss = vops.yolo_loss(t, paddle.to_tensor(gt_box),
+                              paddle.to_tensor(gt_label), **kw)
+        loss.sum().backward()
+        g = t.grad.numpy()
+        # central FD on a handful of coordinates (full FD too slow here)
+        rng = np.random.RandomState(5)
+        flat = x.reshape(-1)
+        for _ in range(6):
+            idx = rng.randint(0, flat.size)
+            eps = 1e-3
+            xp, xm = flat.copy(), flat.copy()
+            xp[idx] += eps
+            xm[idx] -= eps
+            lp = _yolo_loss_numpy(xp.reshape(x.shape), gt_box, gt_label,
+                                  kw["anchors"], kw["anchor_mask"],
+                                  kw["class_num"], kw["ignore_thresh"],
+                                  kw["downsample_ratio"]).sum()
+            lm = _yolo_loss_numpy(xm.reshape(x.shape), gt_box, gt_label,
+                                  kw["anchors"], kw["anchor_mask"],
+                                  kw["class_num"], kw["ignore_thresh"],
+                                  kw["downsample_ratio"]).sum()
+            fd = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(g.reshape(-1)[idx], fd, rtol=5e-2,
+                                       atol=5e-3)
+
+    def test_generate_proposals(self):
+        import paddle_tpu.vision.ops as vops
+
+        rng = np.random.RandomState(0)
+        N, A, H, W = 2, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = rng.randn(N, 4 * A, H, W).astype(np.float32) * 0.2
+        img = np.asarray([[32.0, 32.0], [32.0, 32.0]], np.float32)
+        # simple anchor grid
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for i in range(H):
+            for j in range(W):
+                for a in range(A):
+                    cx, cy, s = j * 8 + 4, i * 8 + 4, 4 * (a + 1)
+                    anchors[i, j, a] = [cx - s, cy - s, cx + s, cy + s]
+        var = np.ones_like(anchors)
+        rois, probs, num = vops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), pre_nms_top_n=20, post_nms_top_n=6,
+            nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+        rois, probs, num = rois.numpy(), probs.numpy(), num.numpy()
+        assert rois.shape[0] == probs.shape[0] == num.sum()
+        assert (num <= 6).all() and (num >= 1).all()
+        # proposals clipped to image
+        assert (rois >= 0).all() and (rois <= 32.0).all()
+        # scores are sorted descending within each image
+        ofs = 0
+        for n in num:
+            seg = probs[ofs:ofs + n, 0]
+            assert (np.diff(seg) <= 1e-6).all()
+            ofs += n
+
+    def test_distribute_fpn_proposals(self):
+        import paddle_tpu.vision.ops as vops
+
+        rois = np.asarray([
+            [0, 0, 16, 16],      # sqrt(area)=16 -> level 2 (min)
+            [0, 0, 56, 56],      # ~56 -> level 4 (refer)
+            [0, 0, 224, 224],    # 224 -> level 6 -> clip to 5
+            [0, 0, 112, 112],    # 112 -> level 5
+        ], np.float32)
+        multi, restore, nums = vops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=56, rois_num=True)
+        nums = nums.numpy()
+        assert list(nums) == [1, 0, 1, 2]
+        # concat(multi)[restore] must reproduce the original order
+        cat = np.concatenate([m.numpy() for m in multi if m.shape[0]], 0)
+        back = cat[restore.numpy()[:, 0]]
+        np.testing.assert_allclose(back, rois)
+
+
+class TestStridedViewOps:
+    """Tensor.unfold / as_strided / vander / trapezoid (VERDICT r2 #9)."""
+
+    def test_unfold_matches_numpy(self):
+        x = rn(2, 10)
+        t = paddle.to_tensor(x)
+        out = t.unfold(1, 4, 3).numpy()   # windows at 0, 3, 6
+        assert out.shape == (2, 3, 4)
+        for wi, st in enumerate([0, 3, 6]):
+            np.testing.assert_allclose(out[:, wi], x[:, st:st + 4])
+
+    def test_unfold_grad(self):
+        check_grad(lambda x: x.unfold(0, 3, 2), [rn(7)], atol=2e-2)
+
+    def test_as_strided(self):
+        x = np.arange(12, dtype=np.float32)
+        t = paddle.to_tensor(x)
+        out = paddle.as_strided(t, [3, 4], [4, 1]).numpy()
+        np.testing.assert_allclose(out, x.reshape(3, 4))
+        # overlapping windows: stride smaller than row length
+        out2 = paddle.as_strided(t, [4, 4], [2, 1], offset=1).numpy()
+        ref = np.stack([x[1 + 2 * i:5 + 2 * i] for i in range(4)])
+        np.testing.assert_allclose(out2, ref)
+
+    def test_as_strided_bounds_check(self):
+        t = paddle.to_tensor(np.arange(12, dtype=np.float32))
+        with pytest.raises(ValueError):
+            paddle.as_strided(t, [4, 4], [4, 1])  # needs index 15
+        with pytest.raises(ValueError):
+            paddle.as_strided(t, [2], [1], offset=-1)
+
+    def test_vander(self):
+        x = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.vander(paddle.to_tensor(x)).numpy(), np.vander(x))
+        np.testing.assert_allclose(
+            paddle.vander(paddle.to_tensor(x), n=2, increasing=True).numpy(),
+            np.vander(x, 2, increasing=True))
+
+    def test_trapezoid(self):
+        y = rn(3, 8)
+        np.testing.assert_allclose(
+            paddle.trapezoid(paddle.to_tensor(y), dx=0.5).numpy(),
+            np.trapz(y, dx=0.5, axis=-1), rtol=1e-5)
+        xs = np.sort(rn(8))
+        np.testing.assert_allclose(
+            paddle.trapezoid(paddle.to_tensor(y),
+                             x=paddle.to_tensor(xs)).numpy(),
+            np.trapz(y, x=xs, axis=-1), rtol=1e-4, atol=1e-5)
+
+    def test_cumulative_trapezoid(self):
+        y = rn(2, 6)
+        got = paddle.cumulative_trapezoid(paddle.to_tensor(y),
+                                          dx=0.25).numpy()
+        ref = np.cumsum((y[:, 1:] + y[:, :-1]) * 0.125, -1)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)

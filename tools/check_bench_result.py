@@ -18,14 +18,20 @@ import json
 import sys
 
 
-def load_value(path: str):
+def load_node(path: str):
     with open(path) as f:
         data = json.load(f)
     # driver format wraps the bench line under "parsed"; accept both
     node = data.get("parsed") if isinstance(data, dict) and "parsed" in data \
         else data
-    if not isinstance(node, dict) or node.get("value") is None:
-        return None, (node or {}).get("error") or data.get("tail", "")[-200:]
+    return node if isinstance(node, dict) else {}, data
+
+
+def load_value(path: str):
+    node, data = load_node(path)
+    if node.get("value") is None:
+        return None, node.get("error") or (
+            data.get("tail", "")[-200:] if isinstance(data, dict) else "")
     return float(node["value"]), None
 
 
@@ -54,7 +60,43 @@ def main(argv=None):
               f"threshold)")
         return 3
     print(f"OK: {cand:.1f} vs baseline {base:.1f} ({(ratio - 1) * 100:+.1f}%)")
-    return 0
+
+    # secondary gates over bench.py's extra fields (VERDICT r2 #7/#8):
+    # MoE throughput must not regress; eager per-op dispatch overhead must
+    # not balloon (it is host-side Python, so allow 50% headroom)
+    base_x = load_node(args.baseline)[0].get("extra") or {}
+    cand_x = load_node(args.candidate)[0].get("extra") or {}
+    rc = 0
+    b_moe, c_moe = base_x.get("moe_tokens_per_sec"), \
+        cand_x.get("moe_tokens_per_sec")
+    if b_moe is not None and c_moe is None:
+        # the regression this gate exists to catch: the secondary bench
+        # used to produce a number and now crashed/vanished
+        print(f"FAIL: baseline has moe_tokens_per_sec={b_moe} but the "
+              "candidate bench produced none")
+        rc = 3
+    elif b_moe and c_moe is not None:
+        r = c_moe / b_moe
+        if r < 1.0 - args.threshold:
+            print(f"FAIL: moe {c_moe:.1f} vs {b_moe:.1f} "
+                  f"({(1 - r) * 100:.1f}% slower)")
+            rc = 3
+        else:
+            print(f"OK: moe {c_moe:.1f} vs {b_moe:.1f} "
+                  f"({(r - 1) * 100:+.1f}%)")
+    b_ov, c_ov = base_x.get("eager_op_overhead_us"), \
+        cand_x.get("eager_op_overhead_us")
+    if b_ov is not None and c_ov is None:
+        print(f"WARN: baseline has eager_op_overhead_us={b_ov} but the "
+              "candidate bench produced none")
+    elif b_ov and c_ov is not None and b_ov > 0:
+        if c_ov > b_ov * 1.5:
+            print(f"FAIL: eager op overhead {c_ov}us vs {b_ov}us "
+                  "(>50% regression)")
+            rc = 3
+        else:
+            print(f"OK: eager op overhead {c_ov}us vs {b_ov}us")
+    return rc
 
 
 if __name__ == "__main__":
