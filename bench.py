@@ -200,6 +200,49 @@ def _moe_bench(on_tpu: bool):
     return round(batch * seq * steps / dt, 1)
 
 
+def _unet_bench(on_tpu: bool):
+    """Third BASELINE config (SDXL-UNet inference proxy, config 5):
+    denoise-step latency (ms) of a jitted UNet2DConditionModel forward —
+    the reference serves this through Paddle Inference's predictor
+    (ppdiffusers + inference/api.cc); here the predictor path IS jit."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.models.unet import UNet2DConditionModel, UNetConfig
+
+    if on_tpu:
+        cfg = UNetConfig(dtype="bfloat16")  # SDXL channel plan
+        B, HW, T = 1, 64, 77
+    else:
+        cfg = UNetConfig.tiny()
+        B, HW, T = 1, 8, 4
+    model = UNet2DConditionModel(cfg)
+    model.eval()
+
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    lat = paddle.to_tensor(jnp.asarray(
+        rng.randn(B, cfg.in_channels, HW, HW), dt))
+    ts = paddle.to_tensor(np.asarray([500], np.int32))
+    ctx = paddle.to_tensor(jnp.asarray(
+        rng.randn(B, T, cfg.cross_attention_dim), dt))
+
+    @jit.to_static
+    def denoise(lat, ts, ctx):
+        return model(lat, ts, ctx)
+
+    steps, warmup = (10, 3) if on_tpu else (3, 1)
+    for _ in range(warmup):
+        out = denoise(lat, ts, ctx)
+    out._value.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = denoise(lat, ts, ctx)
+        out._value.block_until_ready()
+    return round((time.perf_counter() - t0) / steps * 1000, 2)
+
+
 def run_bench():
     devices, backend = _init_backend()
     on_tpu = backend == "tpu"
@@ -281,6 +324,11 @@ def run_bench():
               f"overhead={ov}us/op", file=sys.stderr)
     except Exception as e:  # noqa: BLE001
         print(f"# eager overhead bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        extra["unet_denoise_ms"] = _unet_bench(on_tpu)
+    except Exception as e:  # noqa: BLE001
+        print(f"# unet bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
     _emit({

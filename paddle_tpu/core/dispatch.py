@@ -118,31 +118,142 @@ class no_grad:
         return wrapper
 
 
-def _is_tensor(x):
-    from .tensor import Tensor
+_TENSOR_CLS = None  # lazy-cached: a per-op-call import is hot-path cost
 
-    return isinstance(x, Tensor)
+
+def _tensor_cls():
+    global _TENSOR_CLS
+    if _TENSOR_CLS is None:
+        from .tensor import Tensor
+
+        _TENSOR_CLS = Tensor
+    return _TENSOR_CLS
+
+
+def _is_tensor(x):
+    return isinstance(x, _tensor_cls())
+
+
+_AMP_FN = None
+
+
+def _amp_op_dtype_fn():
+    """Cached ref to amp.amp_op_dtype (None until the amp module imports —
+    a try/import per op call is hot-path cost)."""
+    global _AMP_FN
+    if _AMP_FN is None:
+        try:
+            from ..amp import amp_op_dtype
+
+            _AMP_FN = amp_op_dtype
+        except ImportError:  # during early package import
+            return None
+    return _AMP_FN
+
+
+# dtypes are interned; cache differentiability per dtype instead of calling
+# jnp.issubdtype/result_type on every op argument (eager hot path)
+_DIFF_DTYPE_CACHE = {}
+
+
+# ---------------------------------------------------------------------------
+# Analytic eager VJP rules: jax.vjp re-linearizes the op on EVERY eager call
+# (~1.5 ms/op on CPU — the pjit python path under the jvp trace), which is
+# pure overhead for trivial elementwise math.  For those ops the backward is
+# a closed form, so we record it directly and skip jax.vjp — the analog of
+# the reference's codegen'd per-op GradNode pairs (imperative/tracer.cc
+# TraceOpImpl + generated grad ops).  jax.vjp remains the fallback for
+# everything else (and for double-grad, which re-derives through dispatch).
+# A rule fires only when `fn` IS the registered callable — a same-named op
+# with a different closure (custom axis, fused variant) falls back.
+def _unbroadcast(ct, shape, dtype):
+    shape = tuple(shape)
+    if ct.shape != shape:
+        extra = ct.ndim - len(shape)
+        if extra > 0:
+            ct = ct.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i, s in enumerate(shape)
+                     if s == 1 and ct.shape[i] != 1)
+        if axes:
+            ct = ct.sum(axis=axes, keepdims=True)
+    if ct.dtype != dtype:
+        ct = ct.astype(dtype)
+    return ct
+
+
+def _make_eager_vjp_rules():
+    def binop(fwd, bwd):
+        def rule(vals):
+            a, b = vals
+            out = fwd(a, b)
+
+            def vjp(ct):
+                ga, gb = bwd(ct, a, b, out)
+                return (_unbroadcast(ga, a.shape, a.dtype),
+                        _unbroadcast(gb, b.shape, b.dtype))
+            return out, vjp
+        return rule
+
+    def unop(fwd, bwd):
+        def rule(vals):
+            (a,) = vals
+            out = fwd(a)
+            return out, lambda ct: (bwd(ct, a, out).astype(a.dtype),)
+        return rule
+
+    return {
+        "add": (jnp.add, binop(
+            jnp.add, lambda ct, a, b, o: (ct, ct))),
+        "subtract": (jnp.subtract, binop(
+            jnp.subtract, lambda ct, a, b, o: (ct, -ct))),
+        "multiply": (jnp.multiply, binop(
+            jnp.multiply, lambda ct, a, b, o: (ct * b, ct * a))),
+        "divide": (jnp.divide, binop(
+            jnp.divide, lambda ct, a, b, o: (ct / b, -ct * o / b))),
+        "exp": (jnp.exp, unop(jnp.exp, lambda ct, a, o: ct * o)),
+        "log": (jnp.log, unop(jnp.log, lambda ct, a, o: ct / a)),
+        "tanh": (jnp.tanh, unop(
+            jnp.tanh, lambda ct, a, o: ct * (1.0 - o * o))),
+        "sqrt": (jnp.sqrt, unop(
+            jnp.sqrt, lambda ct, a, o: ct * 0.5 / o)),
+        "rsqrt": (jax.lax.rsqrt, unop(
+            jax.lax.rsqrt, lambda ct, a, o: ct * -0.5 * o * o * o)),
+    }
+
+
+_EAGER_VJP_RULES = _make_eager_vjp_rules()
 
 
 def _differentiable_dtype(v) -> bool:
-    return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+    dt = getattr(v, "dtype", None)
+    if dt is None:
+        return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+    hit = _DIFF_DTYPE_CACHE.get(dt)
+    if hit is None:
+        hit = _DIFF_DTYPE_CACHE[dt] = bool(
+            jnp.issubdtype(dt, jnp.inexact))
+    return hit
 
 
 def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
     """Run op `fn` over args (Tensors possibly nested in lists/tuples) with
     static keyword attrs; wrap outputs in Tensors and record the grad node.
     """
-    from .tensor import Tensor
+    Tensor = _tensor_cls()
 
     if _graph_recorder is not None:
         rec = _graph_recorder(name, fn, args, attrs)
         if rec is not NOT_RECORDED:
             return rec
 
-    flat, treedef = jax.tree_util.tree_flatten(
-        args, is_leaf=_is_tensor
-    )
-    tensor_idx = [i for i, leaf in enumerate(flat) if _is_tensor(leaf)]
+    # fast path: args with no containers skip the pytree machinery (the
+    # overwhelmingly common case — reference hot loop analog TraceOpImpl)
+    if all(not isinstance(a, (list, tuple, dict)) for a in args):
+        flat, treedef = list(args), None
+    else:
+        flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_tensor)
+    tensor_idx = [i for i, leaf in enumerate(flat)
+                  if isinstance(leaf, Tensor)]
 
     record = (
         _differentiable
@@ -167,16 +278,13 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
     # AutoCastInputs / amp_auto_cast.cc).  The cast happens inside raw_fn so
     # the vjp closure differentiates through it.
     amp_np_dtype = None
-    try:
-        from ..amp import amp_op_dtype
-
-        amp_target = amp_op_dtype(name)
+    amp_fn = _amp_op_dtype_fn()
+    if amp_fn is not None:
+        amp_target = amp_fn(name)
         if amp_target is not None:
             from .dtype import to_np
 
             amp_np_dtype = to_np(amp_target)
-    except ImportError:  # during early package import
-        pass
 
     def _amp_cast(v):
         if amp_np_dtype is not None and jnp.issubdtype(
@@ -191,12 +299,29 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
         for i in tensor_idx:
             if i not in diff_idx:
                 new_flat[i] = _amp_cast(new_flat[i]._value)
+        if treedef is None:
+            return fn(*new_flat, **attrs)
         new_args = jax.tree_util.tree_unflatten(treedef, new_flat)
         return fn(*new_args, **attrs)
 
     if record:
-        diff_vals = [flat[i]._value for i in diff_idx]
-        out_raw, vjp_fn = jax.vjp(raw_fn, *diff_vals)
+        out_raw = None
+        rule_entry = _EAGER_VJP_RULES.get(name)
+        if (rule_entry is not None and rule_entry[0] is fn
+                and amp_np_dtype is None and treedef is None
+                and not attrs and len(tensor_idx) == len(flat)):
+            out_raw, vjp_all = rule_entry[1]([t._value for t in flat])
+            if len(diff_idx) == len(flat):
+                vjp_fn = vjp_all
+            else:
+                sel = tuple(diff_idx)
+
+                def vjp_fn(ct, _v=vjp_all, _sel=sel):
+                    gs = _v(ct)
+                    return tuple(gs[i] for i in _sel)
+        if out_raw is None:
+            diff_vals = [flat[i]._value for i in diff_idx]
+            out_raw, vjp_fn = jax.vjp(raw_fn, *diff_vals)
         node = tape_mod.GradNode(name, vjp_fn)
         node.grad_raw_fn = raw_fn  # double-grad: recordable vjp recompute
     else:

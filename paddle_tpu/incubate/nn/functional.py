@@ -4,6 +4,25 @@ from __future__ import annotations
 from ...nn.functional.attention import scaled_dot_product_attention
 
 
+def _fused_dropout(v, key, p, mode):
+    """Shared dropout for the fused blocks (reference fused ops' dropout
+    semantics): upscale_in_train scales kept values by 1/(1-p)."""
+    import jax
+    import jax.numpy as jnp
+
+    keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+
+
+def _fused_infer_scale(v, p, mode, training):
+    """downscale_in_infer: no train-time upscale, so eval multiplies by
+    the keep probability."""
+    if mode == "downscale_in_infer" and not training and p:
+        return (v * (1.0 - p)).astype(v.dtype)
+    return v
+
+
 def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                pre_layer_norm=False, pre_ln_scale=None,
                                pre_ln_bias=None, ln_scale=None, ln_bias=None,
@@ -37,16 +56,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         keys["out"] = rnd.next_key()
 
     def _drop(v, key, p):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
-        scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
-        return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+        return _fused_dropout(v, key, p, mode)
 
     def _infer_scale(v, p):
-        # downscale_in_infer: no train-time upscale, so eval multiplies
-        # by the keep probability
-        if mode == "downscale_in_infer" and not training and p:
-            return (v * (1.0 - p)).astype(v.dtype)
-        return v
+        return _fused_infer_scale(v, p, mode, training)
 
     def _v(t):
         return t._value if isinstance(t, Tensor) else jnp.asarray(t)
@@ -152,14 +165,10 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         keys["d2"] = rnd.next_key()
 
     def _drop(v, key, p):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
-        scale = 1.0 / (1.0 - p) if drop_mode == "upscale_in_train" else 1.0
-        return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+        return _fused_dropout(v, key, p, drop_mode)
 
     def _infer_scale(v, p):
-        if drop_mode == "downscale_in_infer" and not training and p:
-            return (v * (1.0 - p)).astype(v.dtype)
-        return v
+        return _fused_infer_scale(v, p, drop_mode, training)
 
     def _v(t):
         return t._value if isinstance(t, Tensor) else jnp.asarray(t)

@@ -205,6 +205,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             out.append(tok_np[:, None])
             if eos_token_id is not None and finished.all():
                 break
+            if step == max_new_tokens - 1:
+                break  # the last token is chosen; don't pay one more step
             cur_raw = tok_np[:, None].astype(np.int32)
             if decode_step is not None:
                 # one compiled program for the whole generation: the
@@ -263,6 +265,8 @@ def _beam_generate(model, ids, max_new_tokens, beams, eos_token_id,
         parents_acc.append(np.asarray(parents))
         if eos_token_id is not None and bool(finished.all()):
             break
+        if step == max_new_tokens - 1:
+            break  # the last token is chosen; don't pay one more step
         cur_raw = np.asarray(toks)[:, None].astype(np.int32)
         if beam_step is not None:
             # cache re-indexing by `parents` happens inside the compiled

@@ -12,10 +12,11 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _cmp(name, fn):
+def _cmp(op_name, fn):
+    # public `name=None` kwarg must not shadow the dispatch name
     def op(x, y, name=None):
-        return apply(name, fn, _t(x), _t(y), _differentiable=False)
-    op.__name__ = name
+        return apply(op_name, fn, _t(x), _t(y), _differentiable=False)
+    op.__name__ = op_name
     return op
 
 

@@ -20,17 +20,19 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _binop(name, fn):
+def _binop(op_name, fn):
+    # NB: the public `name=None` kwarg (paddle API) must not shadow the
+    # op's dispatch name — it silently became None for every binop once
     def op(x, y, name=None):
-        return apply(name, fn, _t(x), _t(y))
-    op.__name__ = name
+        return apply(op_name, fn, _t(x), _t(y))
+    op.__name__ = op_name
     return op
 
 
-def _unop(name, fn):
+def _unop(op_name, fn):
     def op(x, name=None):
-        return apply(name, fn, _t(x))
-    op.__name__ = name
+        return apply(op_name, fn, _t(x))
+    op.__name__ = op_name
     return op
 
 
