@@ -82,6 +82,7 @@ from .hapi import hub  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401

@@ -1,0 +1,254 @@
+"""paddle.signal: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py:32,154,237,391.  Oracles: manual numpy
+framing/overlap-add, torch.stft/istft (same center/pad_mode/onesided
+semantics), FD grad checks via op_test.check_grad, and exact analytic
+round trips istft(stft(x)) == x under a NOLA-satisfying window.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.signal import frame, istft, overlap_add, stft
+
+from op_test import check_grad
+
+torch = pytest.importorskip("torch")
+
+
+def _hann(n):
+    return np.asarray(torch.hann_window(n).numpy(), np.float32)
+
+
+class TestFrameOverlapAdd:
+    def test_frame_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 100).astype(np.float32)
+        f = frame(paddle.to_tensor(x), 20, 5)
+        nf = 1 + (100 - 20) // 5
+        man = np.stack([x[..., j * 5:j * 5 + 20] for j in range(nf)],
+                       axis=-1)
+        assert f.shape == [2, 3, 20, nf]
+        np.testing.assert_allclose(f.numpy(), man)
+
+    def test_frame_axis0(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(50, 4).astype(np.float32)
+        f = frame(paddle.to_tensor(x), 10, 10, axis=0)  # non-overlapping
+        assert f.shape == [5, 10, 4]
+        np.testing.assert_allclose(f.numpy(), x.reshape(5, 10, 4))
+
+    def test_frame_1d(self):
+        x = np.arange(8, dtype=np.float32)
+        f = frame(paddle.to_tensor(x), 4, 2)
+        np.testing.assert_allclose(
+            f.numpy(), np.stack([x[0:4], x[2:6], x[4:8]], axis=-1))
+        # 1D + axis=0 uses the [num_frames, frame_length] convention
+        # (reference signal.py frame docstring, 1D example)
+        f0 = frame(paddle.to_tensor(x), 4, 2, axis=0)
+        np.testing.assert_allclose(
+            f0.numpy(), np.stack([x[0:4], x[2:6], x[4:8]], axis=0))
+
+    def test_frame_validation(self):
+        x = paddle.to_tensor(np.zeros(16, np.float32))
+        with pytest.raises(ValueError):
+            frame(x, 32, 4)          # frame_length > seq
+        with pytest.raises(ValueError):
+            frame(x, 4, 0)           # hop <= 0
+        with pytest.raises(ValueError):
+            frame(x, 4, 2, axis=1)   # axis not in {0, -1}
+
+    def test_overlap_add_rank_validation(self):
+        with pytest.raises(ValueError, match="rank"):
+            overlap_add(paddle.to_tensor(np.ones(8, np.float32)), 2)
+
+    def test_overlap_add_matches_manual(self):
+        rng = np.random.RandomState(2)
+        nf, fl, hop = 7, 12, 4
+        fr = rng.randn(2, fl, nf).astype(np.float32)
+        out = overlap_add(paddle.to_tensor(fr), hop)
+        seq = (nf - 1) * hop + fl
+        man = np.zeros((2, seq), np.float32)
+        for j in range(nf):
+            man[:, j * hop:j * hop + fl] += fr[:, :, j]
+        np.testing.assert_allclose(out.numpy(), man, rtol=1e-5)
+
+    def test_overlap_add_axis0(self):
+        rng = np.random.RandomState(3)
+        fr = rng.randn(5, 8, 3).astype(np.float32)  # (nf, fl, ...)
+        out = overlap_add(paddle.to_tensor(fr), 8, axis=0)
+        np.testing.assert_allclose(
+            out.numpy(), fr.reshape(40, 3), rtol=1e-5)
+
+    def test_frame_overlap_add_grads(self):
+        rng = np.random.RandomState(4)
+        check_grad(lambda x: frame(x, 8, 4), [rng.randn(30)], eps=1e-3)
+        check_grad(lambda x: overlap_add(x, 3),
+                   [rng.randn(6, 4)], eps=1e-3)
+
+
+class TestStft:
+    def test_matches_torch_real_onesided(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 400).astype(np.float32)
+        w = _hann(64)
+        y = stft(paddle.to_tensor(x), 64, hop_length=16,
+                 window=paddle.to_tensor(w))
+        yt = torch.stft(torch.tensor(x), 64, hop_length=16,
+                        window=torch.tensor(w), return_complex=True,
+                        center=True, pad_mode="reflect")
+        assert y.shape == [2, 33, 26]
+        np.testing.assert_allclose(y.numpy(), yt.numpy(), atol=1e-4)
+
+    def test_variants(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(300).astype(np.float32)
+        for kw in ({"center": False}, {"onesided": False},
+                   {"normalized": True}, {"pad_mode": "constant"},
+                   {"win_length": 48}, {"default_hop": True}):
+            default_hop = kw.pop("default_hop", False)
+            w = _hann(kw.get("win_length", 64))
+            y = stft(paddle.to_tensor(x), 64,
+                     hop_length=None if default_hop else 16,
+                     window=paddle.to_tensor(w), **kw)
+            yt = torch.stft(
+                torch.tensor(x), 64,
+                hop_length=64 // 4 if default_hop else 16,
+                window=torch.tensor(w), return_complex=True,
+                center=kw.get("center", True),
+                onesided=kw.get("onesided", True),
+                normalized=kw.get("normalized", False),
+                pad_mode=kw.get("pad_mode", "reflect"),
+                win_length=kw.get("win_length"))
+            np.testing.assert_allclose(y.numpy(), yt.numpy(), atol=1e-4,
+                                       err_msg=str(kw))
+
+    def test_complex_input(self):
+        rng = np.random.RandomState(2)
+        x = (rng.randn(200) + 1j * rng.randn(200)).astype(np.complex64)
+        y = stft(paddle.to_tensor(x), 32, hop_length=8, onesided=False)
+        yt = torch.stft(torch.tensor(x), 32, hop_length=8,
+                        return_complex=True, onesided=False)
+        np.testing.assert_allclose(y.numpy(), yt.numpy(), atol=1e-4)
+        with pytest.raises(ValueError):
+            stft(paddle.to_tensor(x), 32, onesided=True)
+
+    def test_grad_matches_torch(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(120).astype(np.float32)
+        w = _hann(32)
+        xt = torch.tensor(x, requires_grad=True)
+        (torch.stft(xt, 32, hop_length=8, window=torch.tensor(w),
+                    return_complex=True).abs() ** 2).sum().backward()
+        xp = paddle.to_tensor(x, stop_gradient=False)
+        wp = paddle.to_tensor(w, stop_gradient=False)
+        ((stft(xp, 32, hop_length=8, window=wp).abs() ** 2)
+         .sum().backward())
+        np.testing.assert_allclose(xp.grad.numpy(), xt.grad.numpy(),
+                                   atol=1e-3, rtol=1e-3)
+        assert wp.grad is not None  # window is differentiable too
+
+    def test_validation(self):
+        x = paddle.to_tensor(np.zeros(64, np.float32))
+        with pytest.raises(ValueError):
+            stft(x, 128)                      # n_fft > seq
+        with pytest.raises(ValueError):
+            stft(x, 32, win_length=48)        # win_length > n_fft
+        with pytest.raises(ValueError):
+            stft(x, 32, window=paddle.to_tensor(
+                np.ones(16, np.float32)))     # window size != win_length
+        with pytest.raises(ValueError):
+            stft(x, 32, pad_mode="circular")
+        with pytest.raises(ValueError, match="complex"):
+            stft(x, 32, window=paddle.to_tensor(
+                np.ones(32, np.complex64)), onesided=True)
+
+
+class TestIstft:
+    def test_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 400).astype(np.float32)
+        w = paddle.to_tensor(_hann(64))
+        y = stft(paddle.to_tensor(x), 64, hop_length=16, window=w)
+        xr = istft(y, 64, hop_length=16, window=w)
+        np.testing.assert_allclose(xr.numpy(), x, atol=1e-4)
+
+    def test_roundtrip_variants(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(320).astype(np.float32)
+        w = paddle.to_tensor(_hann(64))
+        for kw in ({"normalized": True}, {"onesided": False},
+                   {"length": 300}):
+            y = stft(paddle.to_tensor(x), 64, hop_length=16, window=w,
+                     onesided=kw.get("onesided", True),
+                     normalized=kw.get("normalized", False))
+            xr = istft(y, 64, hop_length=16, window=w, **kw)
+            want = x[:kw["length"]] if "length" in kw else x
+            np.testing.assert_allclose(xr.numpy(), want, atol=1e-4,
+                                       err_msg=str(kw))
+
+    def test_complex_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = (rng.randn(200) + 1j * rng.randn(200)).astype(np.complex64)
+        y = stft(paddle.to_tensor(x), 32, hop_length=8, onesided=False)
+        xr = istft(y, 32, hop_length=8, onesided=False,
+                   return_complex=True)
+        np.testing.assert_allclose(xr.numpy(), x, atol=1e-4)
+
+    def test_matches_torch(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(400).astype(np.float32)
+        w = _hann(64)
+        y = torch.stft(torch.tensor(x), 64, hop_length=16,
+                       window=torch.tensor(w), return_complex=True)
+        mine = istft(paddle.to_tensor(y.numpy()), 64, hop_length=16,
+                     window=paddle.to_tensor(w))
+        theirs = torch.istft(y, 64, hop_length=16, window=torch.tensor(w))
+        np.testing.assert_allclose(mine.numpy(), theirs.numpy(), atol=1e-4)
+
+    def test_nola_violation_raises(self):
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(160).astype(np.float32))
+        zero_w = paddle.to_tensor(np.zeros(32, np.float32))
+        ones_w = paddle.to_tensor(np.ones(32, np.float32))
+        y = stft(x, 32, hop_length=8, window=ones_w)
+        with pytest.raises(ValueError, match="NOLA"):
+            istft(y, 32, hop_length=8, window=zero_w)
+        # must fire even when the window participates in grad recording
+        # (the envelope is a Tracer inside the kernel then)
+        zero_wg = paddle.to_tensor(np.zeros(32, np.float32),
+                                   stop_gradient=False)
+        with pytest.raises(ValueError, match="NOLA"):
+            istft(y, 32, hop_length=8, window=zero_wg)
+
+    def test_int_validation(self):
+        x = paddle.to_tensor(np.zeros(64, np.float32))
+        with pytest.raises(ValueError, match="integer"):
+            frame(x, 8.0, 4)
+        with pytest.raises(ValueError, match="integer"):
+            stft(x, 32, hop_length=8.0)
+
+    def test_validation(self):
+        y = paddle.to_tensor(np.zeros((17, 9), np.complex64))
+        with pytest.raises(TypeError):
+            istft(paddle.to_tensor(np.zeros((17, 9), np.float32)), 32)
+        with pytest.raises(ValueError):
+            istft(y, 32, hop_length=64)       # hop > win
+        with pytest.raises(ValueError):
+            istft(y, 32, onesided=False)      # fft_size != n_fft
+        with pytest.raises(ValueError):
+            istft(y, 32, return_complex=True)  # needs onesided=False
+
+
+class TestSignalJit:
+    def test_stft_istft_under_jit(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 160).astype(np.float32)
+
+        @jit.to_static
+        def roundtrip(v):
+            return istft(stft(v, 32, hop_length=8), 32, hop_length=8)
+
+        np.testing.assert_allclose(
+            roundtrip(paddle.to_tensor(x)).numpy(), x, atol=1e-4)
