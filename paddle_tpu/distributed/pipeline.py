@@ -18,11 +18,14 @@ embeddings (SharedLayerDesc).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
+import hashlib
 from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import nn
@@ -322,24 +325,78 @@ def _param_values(layer):
     return [p._value for _, p in layer.named_parameters()]
 
 
+# Layer-machinery attrs excluded from the config signature: parameters are
+# covered by the (shape, dtype) entries, buffers are frozen separately with
+# their contents, and _hook_id is a registration counter with no behavior.
+_SIG_SKIP = {"_parameters", "_sub_layers", "_buffers", "_hook_id"}
+
+
+def _freeze_cfg(v):
+    """Hashable, comparable-by-value digest of a config attribute.
+
+    Scalars and (nested) containers compare by value; dataclasses by
+    field values; concrete arrays by shape/dtype/content hash.  Anything
+    else freezes to its object id — distinct instances then never compare
+    equal, so layers carrying unrecognized state are conservatively
+    treated as non-homogeneous and the pipeline falls back to the eager
+    per-layer loop instead of silently running body[0]'s forward
+    (ADVICE r3: tuple-valued knobs like kernel_size=(2,2) vs (3,3) were
+    invisible to the old scalar-only signature)."""
+    if isinstance(v, (int, float, bool, str, bytes, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return ("seq", tuple(_freeze_cfg(e) for e in v))
+    if isinstance(v, (set, frozenset)):
+        return ("set", tuple(sorted(repr(e) for e in v)))
+    if isinstance(v, dict):
+        return ("dict", tuple(sorted(
+            ((repr(k), _freeze_cfg(x)) for k, x in v.items()))))
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return ("dc", type(v).__name__, tuple(
+            (f.name, _freeze_cfg(getattr(v, f.name)))
+            for f in dataclasses.fields(v)))
+    arr = getattr(v, "_value", v)
+    if hasattr(arr, "shape") and hasattr(arr, "dtype"):
+        try:  # concrete array: compare by content (tracers fall through)
+            buf = np.asarray(arr)
+            if buf.size <= 65536:
+                digest = hashlib.sha1(buf.tobytes()).hexdigest()
+            else:
+                # large buffer (e.g. a 4096x64 rotary table): hash a
+                # strided sample — an id() fallback would make byte-
+                # identical per-layer tables signature-unique and
+                # silently disable the compiled 1F1B
+                flat = buf.reshape(-1)
+                sample = flat[::max(1, flat.size // 4096)][:4096]
+                digest = hashlib.sha1(sample.tobytes()).hexdigest()
+            return ("arr", buf.shape, str(buf.dtype), digest)
+        except Exception:  # noqa: BLE001
+            pass
+    return ("opaque", id(v))
+
+
 def _layer_sig(layer):
     """Structural signature used to find the homogeneous pipeline body.
 
-    Includes the concrete class identity and every simple (scalar) config
-    attribute, so two same-shaped layers with different behavior knobs
-    (e.g. Block(act='relu') vs Block(act='gelu')) do NOT count as
-    homogeneous — they would silently run through stage 0's forward."""
+    Includes the concrete class identity, every config attribute (public
+    AND private — Conv-style layers keep stride/kernel_size in private
+    attrs), forward hooks, and buffer contents, so two same-shaped layers
+    with different behavior knobs (Block(act='relu') vs Block(act='gelu'),
+    Conv2D(stride=1) vs Conv2D(stride=2), different rotary tables) do NOT
+    count as homogeneous — they would silently run through stage 0's
+    forward."""
     entries = tuple((n, tuple(p.shape), str(p._value.dtype))
                     for n, p in layer.named_parameters())
 
     def cfg_of(l):
         out = []
         for k in sorted(vars(l)):
-            if k.startswith("_"):
+            if k in _SIG_SKIP:
                 continue
-            v = vars(l)[k]
-            if isinstance(v, (int, float, bool, str, bytes, type(None))):
-                out.append((k, v))
+            out.append((k, _freeze_cfg(vars(l)[k])))
+        out.append(("<buffers>", tuple(
+            (bn, _freeze_cfg(b)) for bn, b in sorted(l._buffers.items())
+            if b is not None)))
         return tuple(out)
 
     cfgs = tuple((id(type(sub)), cfg_of(sub))
@@ -600,12 +657,23 @@ class PipelineParallel(nn.Layer):
             # can never cause a double-applied eager re-run
             try:
                 loss, g_stacked, g_shared = self._run_1f1b(prog, x, y)
-            except Exception as e:  # noqa: BLE001 — tracing failures
+            except (TypeError, ValueError, IndexError,
+                    NotImplementedError) as e:
+                # trace/lowering failures (jax trace errors subclass
+                # TypeError/ValueError/IndexError — e.g.
+                # NonConcreteBooleanIndexError — and missing lowerings
+                # raise NotImplementedError): this model can't compile —
+                # latch so every later step goes straight to the eager
+                # loop.
+                # Runtime faults (XlaRuntimeError -> RuntimeError, e.g. a
+                # transient OOM while another process holds the chip) are
+                # NOT caught: silently downgrading every subsequent step
+                # over a one-off would hide the real error (ADVICE r3).
                 import warnings
 
                 warnings.warn(
-                    f"compiled 1F1B step failed ({type(e).__name__}: {e}); "
-                    "falling back to the eager microbatch loop")
+                    f"compiled 1F1B trace failed ({type(e).__name__}: "
+                    f"{e}); falling back to the eager microbatch loop")
                 self._1f1b = None
                 self._1f1b_failed = True
             else:

@@ -11,6 +11,8 @@ serving); each step's math is jit-compiled by XLA.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -77,8 +79,23 @@ def _weights_fingerprint(model):
     param's backing array (optimizer step, set_state_dict, checkpoint
     load) changes the tuple, invalidating decode steps that captured the
     old weights as jit constants (ADVICE r2: a stale compiled step would
-    otherwise silently serve pre-update weights)."""
-    return tuple(id(p._value) for p in model.parameters())
+    otherwise silently serve pre-update weights).
+
+    Held as WEAKREFS, not id()s: CPython reuses freed addresses, and
+    set_state_dict frees each old array right before allocating its
+    same-sized replacement, so an id tuple can collide with the cached
+    one and keep serving pre-update weights (ADVICE r3).  A weakref to a
+    freed array returns None and can never match; holding the refs does
+    not extend the old arrays' lifetime."""
+    return tuple(weakref.ref(p._value) for p in model.parameters())
+
+
+def _fingerprint_matches(model, fp):
+    if fp is None:
+        return False
+    params = model.parameters()
+    return len(fp) == len(params) and all(
+        r() is p._value for r, p in zip(fp, params))
 
 
 def make_decode_step(model):
@@ -97,10 +114,11 @@ def make_decode_step(model):
     generate() calls — a fresh wrapper per call would retrace + recompile
     the whole transformer every request, while an un-fingerprinted one
     would keep serving stale weights after training/set_state_dict."""
-    fp = _weights_fingerprint(model)
     step = getattr(model, "_decode_step", None)
-    if step is not None and getattr(model, "_decode_step_fp", None) == fp:
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_decode_step_fp", None)):
         return step
+    fp = _weights_fingerprint(model)
 
     from .llama import StaticKVCache
 
@@ -127,11 +145,11 @@ def make_beam_decode_step(model):
     BeamSearchDecoder's gather of cell states, fluid/layers/rnn.py, over
     fused_multi_transformer's fixed CacheKV).  step(tok[BV,1], caches,
     offset, parents[BV]) -> (logits[BV,V] f32, new_caches)."""
-    fp = _weights_fingerprint(model)
     step = getattr(model, "_beam_decode_step", None)
-    if step is not None and \
-            getattr(model, "_beam_decode_step_fp", None) == fp:
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_beam_decode_step_fp", None)):
         return step
+    fp = _weights_fingerprint(model)
 
     from .llama import StaticKVCache
 
