@@ -359,16 +359,12 @@ def _freeze_cfg(v):
     if hasattr(arr, "shape") and hasattr(arr, "dtype"):
         try:  # concrete array: compare by content (tracers fall through)
             buf = np.asarray(arr)
-            if buf.size <= 65536:
-                digest = hashlib.sha1(buf.tobytes()).hexdigest()
-            else:
-                # large buffer (e.g. a 4096x64 rotary table): hash a
-                # strided sample — an id() fallback would make byte-
-                # identical per-layer tables signature-unique and
-                # silently disable the compiled 1F1B
-                flat = buf.reshape(-1)
-                sample = flat[::max(1, flat.size // 4096)][:4096]
-                digest = hashlib.sha1(sample.tobytes()).hexdigest()
+            # full-content hash: np.asarray already pulled the buffer to
+            # host and sha1 is ~1 ms / 4 MB at one-time program build; a
+            # sampled or id() digest would either miss differing entries
+            # (silently folding distinct layers into one homogeneous
+            # body) or split byte-identical per-layer tables
+            digest = hashlib.sha1(buf.tobytes()).hexdigest()
             return ("arr", buf.shape, str(buf.dtype), digest)
         except Exception:  # noqa: BLE001
             pass
@@ -655,24 +651,26 @@ class PipelineParallel(nn.Layer):
             # only the compiled schedule itself is allowed to fall back;
             # grads/optimizer run outside the guard so a failing optimizer
             # can never cause a double-applied eager re-run
+            # Until the program has stepped once, any failure (including
+            # XlaRuntimeError from backend compilation — e.g. a Mosaic
+            # tiling error that only surfaces on the real chip) is
+            # deterministic "this model can't compile": latch + eager
+            # fallback.  After a successful step, only trace-shaped error
+            # types latch; a runtime fault (transient OOM while another
+            # process holds the chip) propagates instead of silently
+            # downgrading every later step (ADVICE r3).
+            first_run = not getattr(prog, "_stepped_ok", False)
+            latchable = ((Exception,) if first_run else
+                         (TypeError, ValueError, IndexError,
+                          NotImplementedError))
             try:
                 loss, g_stacked, g_shared = self._run_1f1b(prog, x, y)
-            except (TypeError, ValueError, IndexError,
-                    NotImplementedError) as e:
-                # trace/lowering failures (jax trace errors subclass
-                # TypeError/ValueError/IndexError — e.g.
-                # NonConcreteBooleanIndexError — and missing lowerings
-                # raise NotImplementedError): this model can't compile —
-                # latch so every later step goes straight to the eager
-                # loop.
-                # Runtime faults (XlaRuntimeError -> RuntimeError, e.g. a
-                # transient OOM while another process holds the chip) are
-                # NOT caught: silently downgrading every subsequent step
-                # over a one-off would hide the real error (ADVICE r3).
+                prog._stepped_ok = True
+            except latchable as e:  # noqa: BLE001 — see above
                 import warnings
 
                 warnings.warn(
-                    f"compiled 1F1B trace failed ({type(e).__name__}: "
+                    f"compiled 1F1B step failed ({type(e).__name__}: "
                     f"{e}); falling back to the eager microbatch loop")
                 self._1f1b = None
                 self._1f1b_failed = True

@@ -24,11 +24,15 @@ def relu6(x, name=None):
     return apply("relu6", jax.nn.relu6, _t(x))
 
 
+def _gelu_impl(v, approximate=False):
+    return jax.nn.gelu(v, approximate=approximate)
+
+
 def gelu(x, approximate=False, name=None):
     # distinct op types so graph passes can tell the variants apart
     # (fuse_linear_act only fuses the exact-erf form)
     op = "gelu_tanh" if approximate else "gelu"
-    return apply(op, lambda v: jax.nn.gelu(v, approximate=approximate), _t(x))
+    return apply(op, _gelu_impl, _t(x), approximate=approximate)
 
 
 def sigmoid(x, name=None):
@@ -43,14 +47,17 @@ def tanh(x, name=None):
     return apply("tanh", jnp.tanh, _t(x))
 
 
-def softmax(x, axis=-1, dtype=None, name=None):
-    def _softmax(v):
-        if dtype is not None:
-            from ...core.dtype import to_np
+def _softmax_impl(v, axis=-1, dtype=None):
+    if dtype is not None:
+        v = v.astype(dtype)
+    return jax.nn.softmax(v, axis=axis)
 
-            v = v.astype(to_np(dtype))
-        return jax.nn.softmax(v, axis=axis)
-    return apply("softmax", _softmax, _t(x))
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import to_np
+
+    return apply("softmax", _softmax_impl, _t(x), axis=axis,
+                 dtype=to_np(dtype) if dtype is not None else None)
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
@@ -203,3 +210,63 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = y_hard + y - jax.lax.stop_gradient(y)
         return y
     return apply("gumbel_softmax", _gumbel, _t(x))
+
+
+# --------------------------------------------------------------------------
+# Analytic eager-VJP rules (core/dispatch.py register_eager_vjp): softmax
+# and both gelu variants have closed-form backwards; jax.vjp otherwise
+# re-linearizes on every eager call (VERDICT r3 #2).
+def _softmax_rule(vals, attrs):
+    if attrs.get("dtype") is not None:
+        return None
+    (a,) = vals
+    axis = attrs.get("axis", -1)
+    out = jax.nn.softmax(a, axis=axis)
+
+    def vjp(ct):
+        inner = jnp.sum(ct * out, axis=axis, keepdims=True)
+        return (((ct - inner) * out).astype(a.dtype),)
+    return out, vjp
+
+
+_SQRT_2_OVER_PI = 0.7978845608028654
+_GELU_C = 0.044715
+
+
+def _gelu_exact_rule(vals, attrs):
+    if attrs.get("approximate"):
+        return None
+    (a,) = vals
+    out = jax.nn.gelu(a, approximate=False)
+
+    def vjp(ct):
+        cdf = 0.5 * (1.0 + jax.scipy.special.erf(a * 0.7071067811865476))
+        pdf = jnp.exp(-0.5 * a * a) * 0.3989422804014327  # 1/sqrt(2*pi)
+        return ((ct * (cdf + a * pdf)).astype(a.dtype),)
+    return out, vjp
+
+
+def _gelu_tanh_rule(vals, attrs):
+    if not attrs.get("approximate"):
+        return None
+    (a,) = vals
+    out = jax.nn.gelu(a, approximate=True)
+
+    def vjp(ct):
+        u = _SQRT_2_OVER_PI * (a + _GELU_C * a * a * a)
+        t = jnp.tanh(u)
+        du = _SQRT_2_OVER_PI * (1.0 + 3.0 * _GELU_C * a * a)
+        g = 0.5 * (1.0 + t) + 0.5 * a * (1.0 - t * t) * du
+        return ((ct * g).astype(a.dtype),)
+    return out, vjp
+
+
+def _register_activation_rules():
+    from ...core.dispatch import register_eager_vjp
+
+    register_eager_vjp("softmax", _softmax_impl, _softmax_rule)
+    register_eager_vjp("gelu", _gelu_impl, _gelu_exact_rule)
+    register_eager_vjp("gelu_tanh", _gelu_impl, _gelu_tanh_rule)
+
+
+_register_activation_rules()

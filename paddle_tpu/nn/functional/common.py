@@ -16,11 +16,19 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _linear2_impl(v, w):
+    return v @ w
+
+
+def _linear3_impl(v, w, b):
+    return v @ w + b
+
+
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle weight layout [in_features, out_features]."""
     if bias is None:
-        return apply("linear", lambda v, w: v @ w, _t(x), _t(weight))
-    return apply("linear", lambda v, w, b: v @ w + b, _t(x), _t(weight), _t(bias))
+        return apply("linear", _linear2_impl, _t(x), _t(weight))
+    return apply("linear", _linear3_impl, _t(x), _t(weight), _t(bias))
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
@@ -67,14 +75,17 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return apply("alpha_dropout", _ad, _t(x))
 
 
+def _embedding_impl(idx, w, padding_idx=None):
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx is not None:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    def _embed(idx, w):
-        out = jnp.take(w, idx, axis=0)
-        if padding_idx is not None:
-            mask = (idx == padding_idx)[..., None]
-            out = jnp.where(mask, 0.0, out)
-        return out
-    return apply("embedding", _embed, _t(x), _t(weight))
+    return apply("embedding", _embedding_impl, _t(x), _t(weight),
+                 padding_idx=padding_idx)
 
 
 def one_hot(x, num_classes, name=None):
@@ -343,3 +354,71 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
         maxlen = int(np.asarray(lens._value).max())
     return apply("sequence_mask", _mask, lens, maxlen_val=int(maxlen))
+
+
+# --------------------------------------------------------------------------
+# Analytic eager-VJP rules (core/dispatch.py register_eager_vjp) for the
+# training hot path: linear and embedding dominate transformer eager steps
+# (VERDICT r3 #2; reference analog: codegen'd matmul_grad / lookup_table_grad).
+def _linear2_rule(vals, attrs):
+    if attrs:
+        return None
+    v, w = vals
+    if v.ndim < 2 or w.ndim != 2:
+        return None
+    out = v @ w
+
+    def vjp(ct):
+        gx = ct @ w.T
+        v2 = v.reshape(-1, v.shape[-1])
+        ct2 = ct.reshape(-1, ct.shape[-1])
+        gw = v2.T @ ct2
+        return (gx.astype(v.dtype), gw.astype(w.dtype))
+    return out, vjp
+
+
+def _linear3_rule(vals, attrs):
+    if attrs:
+        return None
+    v, w, b = vals
+    if v.ndim < 2 or w.ndim != 2 or b.ndim != 1:
+        return None
+    out = v @ w + b
+
+    def vjp(ct):
+        gx = ct @ w.T
+        v2 = v.reshape(-1, v.shape[-1])
+        ct2 = ct.reshape(-1, ct.shape[-1])
+        gw = v2.T @ ct2
+        gb = ct2.sum(axis=0)
+        return (gx.astype(v.dtype), gw.astype(w.dtype), gb.astype(b.dtype))
+    return out, vjp
+
+
+def _embedding_rule(vals, attrs):
+    idx, w = vals
+    if not jnp.issubdtype(idx.dtype, jnp.integer) or w.ndim != 2:
+        return None
+    pad = attrs.get("padding_idx")
+    out = _embedding_impl(idx, w, padding_idx=pad)
+
+    def vjp(ct):
+        c = ct
+        if pad is not None:
+            c = jnp.where((idx == pad)[..., None], 0.0, c)
+        gw = jnp.zeros_like(w).at[idx].add(c.astype(w.dtype))
+        # int ids are never differentiable; position 0 is unused by the
+        # dispatch selection but must exist in the tuple
+        return (None, gw)
+    return out, vjp
+
+
+def _register_common_rules():
+    from ...core.dispatch import register_eager_vjp
+
+    register_eager_vjp("linear", _linear2_impl, _linear2_rule)
+    register_eager_vjp("linear", _linear3_impl, _linear3_rule)
+    register_eager_vjp("embedding", _embedding_impl, _embedding_rule)
+
+
+_register_common_rules()

@@ -84,30 +84,64 @@ def with_no_grad_update(x, running_mean, running_var, channel_axis, momentum):
                                   running_var._value.dtype))
 
 
+def _layer_norm_impl(v, *wb, normalized_shape=(), epsilon=1e-5):
+    axes = tuple(range(v.ndim - len(normalized_shape), v.ndim))
+    mean = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+    if wb:
+        out = out * wb[0].reshape(tuple(normalized_shape))
+        if len(wb) > 1:
+            out = out + wb[1].reshape(tuple(normalized_shape))
+    return out.astype(v.dtype)
+
+
+def _layer_norm_rule(vals, attrs):
+    ns = tuple(attrs.get("normalized_shape") or ())
+    eps = attrs.get("epsilon", 1e-5)
+    v, wb = vals[0], vals[1:]
+    nd = len(ns)
+    if nd == 0 or v.ndim < nd:
+        return None
+    axes = tuple(range(v.ndim - nd, v.ndim))
+    lead = tuple(range(v.ndim - nd))
+    mean = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    ivar = jax.lax.rsqrt(var + eps)
+    xhat = (v - mean) * ivar
+    w = wb[0].reshape(ns) if wb else None
+    out = xhat if w is None else xhat * w
+    if len(wb) > 1:
+        out = out + wb[1].reshape(ns)
+    out = out.astype(v.dtype)
+
+    def vjp(ct):
+        # classic LN backward: gx = ivar*(gxh - E[gxh] - xhat*E[gxh*xhat])
+        gxh = ct if w is None else ct * w
+        m1 = jnp.mean(gxh, axis=axes, keepdims=True)
+        m2 = jnp.mean(gxh * xhat, axis=axes, keepdims=True)
+        grads = [(ivar * (gxh - m1 - xhat * m2)).astype(v.dtype)]
+        if wb:
+            gw = jnp.sum(ct * xhat, axis=lead) if lead else ct * xhat
+            grads.append(gw.reshape(wb[0].shape).astype(wb[0].dtype))
+            if len(wb) > 1:
+                gb = jnp.sum(ct, axis=lead) if lead else ct
+                grads.append(gb.reshape(wb[1].shape).astype(wb[1].dtype))
+        return tuple(grads)
+    return out, vjp
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
-    ndim_norm = len(normalized_shape)
-
-    def _ln(v, *wb):
-        axes = tuple(range(v.ndim - ndim_norm, v.ndim))
-        mean = jnp.mean(v, axis=axes, keepdims=True)
-        var = jnp.var(v, axis=axes, keepdims=True)
-        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
-        if wb:
-            w = wb[0].reshape(tuple(normalized_shape))
-            out = out * w
-            if len(wb) > 1:
-                out = out + wb[1].reshape(tuple(normalized_shape))
-        return out.astype(v.dtype)
-
     args = [_t(x)]
     if weight is not None:
         args.append(_t(weight))
         if bias is not None:
             args.append(_t(bias))
-    return apply("layer_norm", _ln, *args)
+    return apply("layer_norm", _layer_norm_impl, *args,
+                 normalized_shape=tuple(normalized_shape), epsilon=epsilon)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
@@ -190,3 +224,12 @@ def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
         sigma = u_ @ w_mat @ v_
         return w / sigma
     return apply("spectral_norm", _sn, _t(weight), _t(u), _t(v))
+
+
+def _register_norm_rules():
+    from ...core.dispatch import register_eager_vjp
+
+    register_eager_vjp("layer_norm", _layer_norm_impl, _layer_norm_rule)
+
+
+_register_norm_rules()

@@ -33,9 +33,19 @@ def cast(x, dtype):
     return apply("cast", lambda v: v.astype(to_np(dtype)), _t(x))
 
 
+def _reshape_impl(v, shape=None):
+    return jnp.reshape(v, shape)
+
+
+def _reshape_rule(vals, attrs):
+    (a,) = vals
+    out = jnp.reshape(a, attrs["shape"])
+    return out, lambda ct: (jnp.reshape(ct, a.shape).astype(a.dtype),)
+
+
 def reshape(x, shape, name=None):
     shape = _static_shape(shape)
-    return apply("reshape", lambda v: jnp.reshape(v, shape), _t(x))
+    return apply("reshape", _reshape_impl, _t(x), shape=tuple(shape))
 
 
 def reshape_(x, shape, name=None):
@@ -52,10 +62,26 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return apply("flatten", _flatten, _t(x))
 
 
+def _transpose_impl(v, perm=None):
+    return jnp.transpose(v, perm)
+
+
+def _transpose_rule(vals, attrs):
+    (a,) = vals
+    perm = attrs.get("perm")
+    out = jnp.transpose(a, perm)
+    inv = (None if perm is None
+           else tuple(int(i) for i in np.argsort(perm)))
+
+    def vjp(ct):
+        return (jnp.transpose(ct, inv).astype(a.dtype),)
+    return out, vjp
+
+
 def transpose(x, perm=None, name=None):
     if perm is not None:
-        perm = [int(p) for p in perm]
-    return apply("transpose", lambda v: jnp.transpose(v, perm), _t(x))
+        perm = tuple(int(p) for p in perm)
+    return apply("transpose", _transpose_impl, _t(x), perm=perm)
 
 
 def t(x, name=None):
@@ -626,3 +652,13 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
         seg = (ys[..., 1:] + ys[..., :-1]) * dxs / 2.0
         return jnp.moveaxis(jnp.cumsum(seg, -1), -1, axis)
     return apply("cumulative_trapezoid", _ct2, _t(y), _t(x))
+
+
+def _register_manipulation_rules():
+    from ..core.dispatch import register_eager_vjp
+
+    register_eager_vjp("reshape", _reshape_impl, _reshape_rule)
+    register_eager_vjp("transpose", _transpose_impl, _transpose_rule)
+
+
+_register_manipulation_rules()

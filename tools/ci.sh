@@ -23,6 +23,9 @@ python examples/export_and_serve.py
 echo "== multichip dryrun =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "== eager dispatch overhead gate =="
+python tools/check_eager_overhead.py
+
 if [ "$#" -eq 2 ]; then
   echo "== perf regression gate =="
   python tools/check_bench_result.py "$1" "$2"
