@@ -70,6 +70,8 @@ def main(argv=None):
     gates = [
         ("moe_tokens_per_sec", False, args.threshold, True),
         ("unet_denoise_ms", True, args.threshold, True),
+        ("resnet50_images_per_sec", False, args.threshold, True),
+        ("bert_dp_tokens_per_sec", False, args.threshold, True),
         # eager overhead is host-side Python: allow 50% headroom, and a
         # missing value only warns (it never gated a round's number)
         ("eager_op_overhead_us", True, 0.5, False),
