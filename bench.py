@@ -293,7 +293,6 @@ def _bert_dp_bench(on_tpu: bool):
     import paddle_tpu as paddle
     from paddle_tpu import jit
     from paddle_tpu.distributed import fleet
-    from paddle_tpu.distributed import mesh as meshmod
     from paddle_tpu.distributed.fleet import DistributedStrategy
     from paddle_tpu.distributed.sharding import shard_tensor
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
@@ -349,8 +348,7 @@ def _bert_dp_bench(on_tpu: bool):
         return round(batch * seq * steps
                      / (time.perf_counter() - t0) / dp, 1)
     finally:
-        meshmod._GLOBAL_MESH = None
-        meshmod._GLOBAL_HCG = None
+        fleet.shutdown()
 
 
 def run_bench():
@@ -434,19 +432,33 @@ def run_bench():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4) if mfu is not None else None,
     }
+    extra = {}
+    emit_lock = threading.Lock()
+    emitted = []
+
+    def _emit_once(payload):
+        # main thread and watchdog can race near the deadline; exactly
+        # ONE JSON line may reach stdout (the driver parses lines)
+        with emit_lock:
+            if emitted:
+                return
+            emitted.append(True)
+            _emit(payload)
 
     def _watchdog_fire():
-        print("# extras phase overran its budget; emitting headline only",
+        print("# extras phase overran its budget; emitting what we have",
               file=sys.stderr)
-        _emit({**headline, "error": "extras timed out"})
+        _emit_once({**headline,
+                    **({"extra": dict(extra)} if extra else {}),
+                    "error": "extras timed out"})
         sys.stderr.flush()
         os._exit(0)
 
-    watchdog = threading.Timer(600.0 if on_tpu else 480.0, _watchdog_fire)
+    # generous: 5 extras, two of which compile full models on TPU — this
+    # guards against HANGS (dead tunnel), not slow-but-healthy phases
+    watchdog = threading.Timer(900.0 if on_tpu else 480.0, _watchdog_fire)
     watchdog.daemon = True
     watchdog.start()
-
-    extra = {}
     try:
         moe_tps = _moe_bench(on_tpu)
         extra["moe_tokens_per_sec"] = moe_tps
@@ -478,7 +490,7 @@ def run_bench():
               file=sys.stderr)
 
     watchdog.cancel()
-    _emit({**headline, **({"extra": extra} if extra else {})})
+    _emit_once({**headline, **({"extra": extra} if extra else {})})
     print(f"# model={n_params/1e6:.1f}M params, batch={batch}, seq={seq}, "
           f"steps={steps}, step_time={dt/steps*1000:.1f}ms, "
           f"loss={float(np.asarray(loss.numpy())):.4f}, "

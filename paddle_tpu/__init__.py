@@ -57,6 +57,7 @@ _ops_pkg.monkey_patch()
 
 from .ops import *  # noqa: F401,F403
 from .ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .ops.random import check_shape  # noqa: F401  (reference: paddle.check_shape)
 
 # --- subsystems (grown as they land; see SURVEY.md §7 layer order) --------
 from . import autograd  # noqa: F401

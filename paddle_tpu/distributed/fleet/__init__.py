@@ -64,6 +64,18 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     return fleet.init(role_maker, is_collective, strategy)
 
 
+def shutdown():
+    """Tear down fleet state: the global mesh/HCG AND the fleet singleton
+    (reference: fleet_base.py stop_worker).  Leaves the process ready for
+    a fresh fleet.init with a different topology."""
+    from ..mesh import reset_mesh
+
+    reset_mesh()
+    fleet.strategy = None
+    fleet.hcg = None
+    fleet._is_initialized = False
+
+
 def get_hybrid_communicate_group_():
     return fleet.hcg
 

@@ -55,6 +55,15 @@ def get_mesh() -> Optional[Mesh]:
     return _GLOBAL_MESH
 
 
+def reset_mesh():
+    """Clear the process-global mesh + HCG (the teardown half of
+    fleet.init; reference analog: fleet_base stop_worker releasing the
+    communication groups).  Callers should prefer fleet.shutdown()."""
+    global _GLOBAL_MESH, _GLOBAL_HCG
+    _GLOBAL_MESH = None
+    _GLOBAL_HCG = None
+
+
 def set_mesh(mesh: Mesh):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh

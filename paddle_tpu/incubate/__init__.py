@@ -30,31 +30,37 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def segment_sum(data, segment_ids, name=None):
-    def _fn(v, ids):
-        n = int(jax.core.get_aval(ids).shape[0]) if False else None
-        num = jnp.max(ids) + 1 if not hasattr(ids, "aval") else None
-        # static segment count required under jit: use data length bound
-        return jax.ops.segment_sum(v, ids, num_segments=None)
-    # eager only when num_segments dynamic
+def _num_segments(ids_t, data_t):
+    """Static segment count: XLA needs a fixed output shape.
+
+    Eager: max(ids)+1 from the concrete values (reference output shape,
+    incubate/operators/graph_send_recv semantics).  Under a jit trace the
+    ids are tracers, so use len(data) — the tight static BOUND (paddle
+    requires sorted non-negative ids, one per row at most), giving the op
+    a trace-stable shape at the cost of trailing zero rows."""
+    v = ids_t._value
+    if isinstance(v, jax.core.Tracer):
+        return int(data_t.shape[0])
     import numpy as np
 
-    ids = np.asarray(_t(segment_ids).numpy())
-    num = int(ids.max()) + 1 if ids.size else 0
+    ids = np.asarray(v)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    data, segment_ids = _t(data), _t(segment_ids)
+    num = _num_segments(segment_ids, data)
     return apply("segment_sum",
                  lambda v, i: jax.ops.segment_sum(v, i, num_segments=num),
-                 _t(data), _t(segment_ids))
+                 data, segment_ids)
 
 
 def _segment_reduce(name, combiner, init):
     def op(data, segment_ids, name_arg=None):
-        import numpy as np
-
-        ids = np.asarray(_t(segment_ids).numpy())
-        num = int(ids.max()) + 1 if ids.size else 0
+        data_t, ids_t = _t(data), _t(segment_ids)
+        num = _num_segments(ids_t, data_t)
 
         def _fn(v, i):
-            one_hot = jax.nn.one_hot(i, num, dtype=v.dtype)
             if name == "mean":
                 s = jax.ops.segment_sum(v, i, num_segments=num)
                 cnt = jax.ops.segment_sum(jnp.ones_like(v), i,
@@ -63,7 +69,7 @@ def _segment_reduce(name, combiner, init):
             if name == "max":
                 return jax.ops.segment_max(v, i, num_segments=num)
             return jax.ops.segment_min(v, i, num_segments=num)
-        return apply(f"segment_{name}", _fn, _t(data), _t(segment_ids))
+        return apply(f"segment_{name}", _fn, data_t, ids_t)
     return op
 
 

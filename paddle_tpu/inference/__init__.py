@@ -62,7 +62,18 @@ class Config:
         pass
 
     def enable_tensorrt_engine(self, **kwargs):
-        pass  # XLA is the engine
+        # XLA is the engine; accepted for API parity.  But a precision
+        # request is a quantization decision the reference would honor
+        # (analysis_predictor.cc:975 TensorRT int8 path) — dropping it
+        # silently would change serving numerics, so say so.
+        precision = kwargs.get("precision_mode")
+        if precision is not None and "int8" in str(precision).lower():
+            import warnings
+
+            warnings.warn(
+                "enable_tensorrt_engine(precision_mode=int8) is ignored: "
+                "XLA serves this model at its trained precision; use "
+                "paddle_tpu.quantization (PTQ/QAT) for int8")
 
     def set_cpu_math_library_num_threads(self, n):
         pass

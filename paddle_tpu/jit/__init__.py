@@ -374,7 +374,26 @@ def not_to_static(fn):
 
 
 def ignore_module(modules):
-    return None
+    """Mark every public function of the given module(s) as not-to-static
+    (reference: jit/api.py ignore_module tells the AST transcriber to skip
+    third-party modules).  Here trace-based to_static executes Python
+    directly, so "ignored" means: functions keep their eager semantics and
+    are never rewritten — implemented by tagging them like @not_to_static
+    so the dy2static AST pass and trace machinery leave them alone."""
+    import types
+
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    for mod in modules:
+        for attr in dir(mod):
+            fn = getattr(mod, attr, None)
+            if isinstance(fn, types.FunctionType) and \
+                    getattr(fn, "__module__", None) == getattr(
+                        mod, "__name__", None):
+                try:
+                    fn._not_to_static = True
+                except (AttributeError, TypeError):
+                    pass
 
 
 # ------------------------------------------------------------- control flow

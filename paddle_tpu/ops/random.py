@@ -257,3 +257,41 @@ def randn_like(x, dtype=None, name=None):
     key = next_key()
     dt = to_np(dtype) if dtype is not None else x._value.dtype
     return Tensor(jax.random.normal(key, tuple(x.shape), dt))
+
+
+def check_shape(shape, op_name="check_shape",
+                expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """Validate a shape argument before it reaches a creation op
+    (reference: fluid/data_feeder.py:152, exported as paddle.check_shape
+    via tensor/random.py).  Accepts a list/tuple of non-negative ints
+    (or int Tensors) or an int32/int64 shape Tensor."""
+    from ..core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if str(shape.dtype).split(".")[-1] not in expected_tensor_dtype:
+            raise TypeError(
+                f"{op_name}: a shape Tensor must be "
+                f"{'/'.join(expected_tensor_dtype)}, got {shape.dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(
+            f"{op_name}: shape must be a list/tuple or int Tensor, "
+            f"got {type(shape).__name__}")
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            if str(ele.dtype).split(".")[-1] not in expected_tensor_dtype:
+                raise TypeError(
+                    f"{op_name}: an element Tensor of shape must be "
+                    f"{'/'.join(expected_tensor_dtype)}, got {ele.dtype}")
+            continue
+        if not isinstance(ele, expected_element_type) or isinstance(
+                ele, bool):
+            raise TypeError(
+                f"{op_name}: all elements of shape must be integers, "
+                f"got {ele!r}")
+        if ele < 0:
+            raise ValueError(
+                f"{op_name}: all elements of shape must be non-negative "
+                f"when given as a list/tuple, got {ele}")

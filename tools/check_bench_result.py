@@ -70,8 +70,11 @@ def main(argv=None):
     gates = [
         ("moe_tokens_per_sec", False, args.threshold, True),
         ("unet_denoise_ms", True, args.threshold, True),
-        ("resnet50_images_per_sec", False, args.threshold, True),
-        ("bert_dp_tokens_per_sec", False, args.threshold, True),
+        # the two full-model extras are best-effort by design (bench.py
+        # watchdog may drop them on a dead tunnel): a missing value WARNS
+        # instead of sinking the round, a present-but-worse value FAILS
+        ("resnet50_images_per_sec", False, args.threshold, False),
+        ("bert_dp_tokens_per_sec", False, args.threshold, False),
         # eager overhead is host-side Python: allow 50% headroom, and a
         # missing value only warns (it never gated a round's number)
         ("eager_op_overhead_us", True, 0.5, False),
