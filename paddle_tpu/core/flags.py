@@ -70,5 +70,11 @@ define_flag("use_pallas_kernels", True, "use Pallas kernels for fused ops on TPU
 define_flag("use_autotune", False, "search + cache kernel tile sizes "
             "(reference: phi/kernels/autotune switch_autotune)")
 define_flag("benchmark", False, "synchronize after every op (timing mode)")
+define_flag("heter_max_payload_mb", 64,
+            "cap (MiB) on a single array moved through the TCPStore by the "
+            "heter gateway; large gradients belong on XLA collectives "
+            "(reference rides Gloo here, ProcessGroupHeter.h:64)")
+define_flag("heter_chunk_mb", 4,
+            "chunk size (MiB) for store-routed heter payloads")
 define_flag("tracer_mkldnn_ops_on", "", "parity stub")
 define_flag("max_inplace_grad_add", 0, "parity stub")

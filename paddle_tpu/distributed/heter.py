@@ -74,16 +74,49 @@ class ProcessGroupHeter:
 
     def _exchange(self, op_name: str, payload: np.ndarray) -> list:
         """Gateway (local rank 0) publishes this cluster's array; every
-        rank may fetch all peers' arrays."""
+        rank may fetch all peers' arrays.
+
+        The store is a CONTROL path, not a gradient transport (the
+        reference rides Gloo for the inter-cluster hop,
+        ProcessGroupHeter.h:64): payloads are capped by
+        FLAGS_heter_max_payload_mb with a clear error, and moved in
+        FLAGS_heter_chunk_mb pieces so one giant value never sits in a
+        single store message.  The chunk-count meta key is written LAST —
+        the TCP client serializes ops, so a reader that sees the meta key
+        is guaranteed every chunk is already published."""
         if self.local_rank == 0:
-            self.store.set(self._key(op_name, self.cluster_id),
-                           payload.tobytes())
+            self._publish(self._key(op_name, self.cluster_id),
+                          payload.tobytes())
         outs = []
         for c in range(self.n_clusters):
-            raw = self._poll_get(self._key(op_name, c))
+            raw = self._fetch(self._key(op_name, c))
             outs.append(np.frombuffer(raw, dtype=payload.dtype)
                         .reshape(payload.shape))
         return outs
+
+    def _publish(self, key: str, data: bytes):
+        from ..core.flags import flag
+
+        cap = int(flag("heter_max_payload_mb")) << 20
+        if cap and len(data) > cap:
+            raise ValueError(
+                f"heter gateway payload is {len(data) >> 20} MiB, above "
+                f"the {cap >> 20} MiB FLAGS_heter_max_payload_mb cap. "
+                "Keep large tensors on the intra-cluster XLA collectives "
+                "(fleet hybrid dp/sharding) and reserve the cross-cluster "
+                "store hop for small partials; raise the flag via "
+                "paddle_tpu.set_flags({'FLAGS_heter_max_payload_mb': N}) "
+                "only if you accept the store bandwidth")
+        chunk = max(1, int(flag("heter_chunk_mb"))) << 20
+        n_chunks = max(1, -(-len(data) // chunk))
+        for i in range(n_chunks):
+            self.store.set(f"{key}/{i}", data[i * chunk:(i + 1) * chunk])
+        self.store.set(key, str(n_chunks).encode())
+
+    def _fetch(self, key: str) -> bytes:
+        n_chunks = int(self._poll_get(key))
+        return b"".join(self.store.get(f"{key}/{i}", wait=False)
+                        for i in range(n_chunks))
 
     # -- collectives --
     def all_reduce(self, tensor: Tensor, op=ReduceOp.SUM):
@@ -132,9 +165,9 @@ class ProcessGroupHeter:
         self._round += 1
         if self.local_rank == 0:
             if self.cluster_id == src_cluster:
-                self.store.set(self._key("bcast", src_cluster),
-                               np.asarray(tensor.numpy()).tobytes())
-            raw = self._poll_get(self._key("bcast", src_cluster))
+                self._publish(self._key("bcast", src_cluster),
+                              np.asarray(tensor.numpy()).tobytes())
+            raw = self._fetch(self._key("bcast", src_cluster))
             val = np.frombuffer(raw, dtype=np.asarray(
                 tensor.numpy()).dtype).reshape(tensor.shape)
             tensor.set_value(val)

@@ -543,6 +543,137 @@ class TestHubAndVersion:
         assert hasattr(paddle, "callbacks")
 
 
+class TestConv3DNative:
+    """Sparse-NATIVE plain Conv3D (VERDICT r3 #5): output site set =
+    union of stride-mapped shifted input sites, gather-GEMM, no todense.
+    Reference: phi/kernels/sparse/gpu/convolution_kernel.cu."""
+
+    def _coo(self, *a, **k):
+        return TestSubmConvNative._random_coo(TestSubmConvNative(), *a, **k)
+
+    def test_parity_and_site_set(self):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._coo(2, 10, 10, 10, 3, density=0.02)
+        for stride, pad, dil in [(1, 1, 1), (2, 1, 1), (2, 0, 1),
+                                 (1, 2, 2), (3, 1, 1)]:
+            conv = sp.nn.Conv3D(3, 4, 3, stride=stride, padding=pad,
+                                dilation=dil)
+            y = conv(x)
+            ref = jax.lax.conv_general_dilated(
+                jnp.asarray(dense), conv.weight._value,
+                window_strides=(stride,) * 3, padding=[(pad, pad)] * 3,
+                rhs_dilation=(dil,) * 3,
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            ref = np.asarray(ref) + np.asarray(conv.bias._value)
+            # expected ACTIVE set: positions any kernel tap can reach —
+            # ones-kernel conv over the occupancy mask
+            occ = (dense != 0).any(-1, keepdims=True).astype(np.float32)
+            reach = jax.lax.conv_general_dilated(
+                jnp.asarray(occ), jnp.ones((3, 3, 3, 1, 1), jnp.float32),
+                window_strides=(stride,) * 3, padding=[(pad, pad)] * 3,
+                rhs_dilation=(dil,) * 3,
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            active = np.asarray(reach)[..., 0] > 0
+            yd = np.asarray(y.to_dense().numpy())
+            assert yd.shape == ref.shape
+            np.testing.assert_allclose(
+                yd, np.where(active[..., None], ref, 0.0),
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"stride={stride} pad={pad} dil={dil}")
+            # site set is exactly the reachable set
+            got = (np.asarray(y.to_dense().numpy()) != 0).any(-1)
+            assert y.nnz() == int(active.sum()), (y.nnz(), active.sum())
+            assert not (got & ~active).any()
+
+    def test_no_todense_in_forward(self, monkeypatch):
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        x, _ = self._coo(1, 6, 6, 6, 2, density=0.05)
+        conv = sp.nn.Conv3D(2, 3, 3, stride=2, padding=1)
+
+        def boom(*a, **k):
+            raise AssertionError("todense called in Conv3D path")
+
+        monkeypatch.setattr(jsparse.BCOO, "todense", boom)
+        monkeypatch.setattr(jsparse, "bcoo_todense", boom, raising=False)
+        y = conv(x)
+        assert y.nnz() > 0
+
+    def test_grads_flow(self):
+        import paddle_tpu.sparse as sp
+
+        x, _ = self._coo(1, 6, 6, 6, 2, density=0.08)
+        conv = sp.nn.Conv3D(2, 3, 3, stride=2, padding=1)
+        out = conv(x)
+        out.values().sum().backward()
+        gw = conv.weight.grad
+        gb = conv.bias.grad
+        assert gw is not None and np.abs(gw.numpy()).sum() > 0
+        assert gb is not None and np.abs(gb.numpy()).sum() > 0
+
+    def test_traced_fallback_matches_eager(self):
+        """Under a jit trace output nnz is data-dependent, so Conv3D
+        dense-lowers — but masked to the reachable set, so VALUES match
+        the eager native path (bias only on active sites)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._coo(1, 8, 8, 8, 2, density=0.03)
+        conv = sp.nn.Conv3D(2, 3, 3, stride=2, padding=1)
+        eager = np.asarray(conv(x).to_dense().numpy())
+
+        @jax.jit
+        def traced(d):
+            xt = sp.SparseCooTensor.__new__(sp.SparseCooTensor)
+            xt._bcoo = jsparse.BCOO.fromdense(d, n_dense=1,
+                                              nse=int(x.nnz()))
+            xt._shape = tuple(d.shape)
+            return conv(xt).to_dense()._value
+
+        np.testing.assert_allclose(np.asarray(traced(jnp.asarray(dense))),
+                                   eager, rtol=1e-4, atol=1e-5)
+
+    def test_speed_vs_dense_at_low_density(self):
+        """>= the SubmConv bar: at ~1% density the gather-GEMM must beat
+        the dense lowering (the whole point of the sparse kernel)."""
+        import time
+
+        import jax
+
+        import paddle_tpu.sparse as sp
+
+        x, dense = self._coo(1, 24, 24, 24, 16, density=0.01, seed=3)
+        conv = sp.nn.Conv3D(16, 16, 3, stride=2, padding=1)
+
+        def native():
+            y = conv(x)
+            y.values()._value.block_until_ready()
+
+        def dense_path():
+            out = conv._conv(jax.numpy.asarray(dense))
+            out.block_until_ready()
+
+        native(); dense_path()  # warm
+        t0 = time.perf_counter()
+        for _ in range(5):
+            native()
+        t_nat = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(5):
+            dense_path()
+        t_dense = time.perf_counter() - t0
+        assert t_nat < t_dense * 1.2, (t_nat, t_dense)
+
+
 class TestSubmConvNative:
     """Sparse-NATIVE submanifold conv (VERDICT r2 #4; reference:
     phi/kernels/sparse/gpu/convolution_kernel.cu gather-GEMM-scatter)."""
