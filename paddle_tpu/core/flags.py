@@ -74,7 +74,8 @@ define_flag("heter_max_payload_mb", 64,
             "cap (MiB) on a single array moved through the TCPStore by the "
             "heter gateway; large gradients belong on XLA collectives "
             "(reference rides Gloo here, ProcessGroupHeter.h:64)")
-define_flag("heter_chunk_mb", 4,
-            "chunk size (MiB) for store-routed heter payloads")
+define_flag("heter_chunk_mb", 1,
+            "chunk size (MiB) for store-routed heter payloads; 1 MiB "
+            "fits the TCPStore client's probe buffer in one RPC")
 define_flag("tracer_mkldnn_ops_on", "", "parity stub")
 define_flag("max_inplace_grad_add", 0, "parity stub")

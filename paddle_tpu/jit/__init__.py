@@ -196,12 +196,28 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, **unused):
         self._fn = fn
+        self._traced_fn = None  # dy2static-converted clone, built lazily
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}
         self._bound_cache: Dict[int, "StaticFunction"] = {}
         self._layers = None
         self._optimizers = None
         functools.update_wrapper(self, fn, updated=[])
+
+    def _trace_target(self):
+        """The function the tracer compiles: the AST-converted clone when
+        the dy2static pass applies (data-dependent if/while ->
+        jit.cond/while_loop, reference program_translator semantics), the
+        original otherwise.  ProgramTranslator.enable(False) bypasses
+        this entirely — the ORIGINAL runs eagerly."""
+        if self._traced_fn is None:
+            from . import dy2static
+
+            try:
+                self._traced_fn = dy2static.convert_function(self._fn)
+            except Exception:  # noqa: BLE001 — the pass must never break
+                self._traced_fn = self._fn
+        return self._traced_fn
 
     def __get__(self, instance, owner=None):
         if instance is None:
@@ -244,8 +260,8 @@ class StaticFunction:
         key = (_spec_key(static_flat, treedef, dyn_vals), state.signature())
         entry = self._cache.get(key)
         if entry is None:
-            entry = _CompiledEntry(self._fn, state, treedef, static_flat,
-                                   tuple(dyn_idx))
+            entry = _CompiledEntry(self._trace_target(), state, treedef,
+                                   static_flat, tuple(dyn_idx))
             self._cache[key] = entry
 
         # host numpy (not device jnp): in a multi-controller runtime

@@ -1,0 +1,512 @@
+"""Minimal AST dy2static pass (VERDICT r3 #7).
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py + convert_operators.py — the reference rewrites
+EVERY ``if``/``while`` into ``convert_ifelse``/``convert_while_loop``
+calls whose runtime helpers pick between Python control flow and the
+framework's functional cond/while ops based on whether the predicate is
+a Tensor.  This pass does the same for the common cases so reference
+scripts with data-dependent ``if tensor:`` / ``while tensor:`` compile
+under trace-based ``to_static`` instead of failing at trace time with a
+ConcretizationTypeError:
+
+- ``if``/``while`` statements are rewritten into local closures whose
+  parameter list is the set of names the bodies assign, called through
+  ``_cvt_ifelse`` / ``_cvt_while`` — Python semantics are preserved
+  exactly when the predicate is a plain bool, and data-dependent
+  predicates lower to ``jit.cond`` / ``jit.while_loop`` (XLA Cond/While).
+- A statement is left UNTOUCHED (trace fallback) when the minimal pass
+  cannot preserve semantics: ``return``/``break``/``continue`` in a
+  body, attribute/subscript stores (object mutation would run at trace
+  time for both branches), ``global``/``nonlocal``, or use of a name
+  the pass cannot thread through the closure.
+- The whole transform silently falls back to the original function when
+  source is unavailable (builtins, C, exec), the function closes over
+  free variables, or anything else goes wrong — exactly the posture of
+  the reference's ``@not_to_static`` escape hatch.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+__all__ = ["convert_function", "_cvt_ifelse", "_cvt_while"]
+
+_HELPERS = "__paddle_tpu_dy2static_helpers__"
+
+
+def _is_tensorish(x):
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        import jax
+
+        return isinstance(x._value, jax.core.Tracer)
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+class _Undefined:
+    """Placeholder for a carried name with no binding before the control
+    statement (reference: dygraph_to_static UndefinedVar).  Reaching one
+    at runtime means the user's code read a variable defined in only one
+    branch — the same error eager Python would raise, surfaced late."""
+
+    def __repr__(self):
+        return "<dy2static undefined variable>"
+
+
+_UNDEF = _Undefined()
+
+
+def _is_operand(a):
+    """Values that can ride through lax.cond/while operands: tensors,
+    arrays, and plain scalars.  Everything else (layers, optimizers,
+    modules, strings, _UNDEF) is closed over as a trace-time constant."""
+    if a is _UNDEF:
+        return False
+    from ..core.tensor import Tensor
+
+    return (isinstance(a, Tensor) or hasattr(a, "dtype")
+            or isinstance(a, (bool, int, float, complex)))
+
+
+def _wrap_val(v):
+    from ..core.tensor import Tensor
+
+    return Tensor(v) if hasattr(v, "dtype") and not isinstance(v, Tensor) \
+        else v
+
+
+def _raw_val(o):
+    from ..core.tensor import Tensor
+
+    return o._value if isinstance(o, Tensor) else o
+
+
+def _cvt_ifelse(pred, true_fn, false_fn, args, names=(), n_stores=None):
+    """Runtime half of the if-rewrite (reference:
+    convert_operators.py convert_ifelse).
+
+    The Tensor-predicate path dispatches ONE tape op whose forward is a
+    lax.cond over the carried values: lax.cond is jax-differentiable, so
+    ``loss.backward()`` through a converted ``if`` reaches every carried
+    tensor (a bare jit.cond would return node-less Tensors and silently
+    drop the gradient chain).  Non-operand carried values (layers,
+    optimizers, modules, _UNDEF placeholders) are closed over as
+    trace-time constants; assigned positions always come OUT of the cond
+    so both-branch-assigned names work even when undefined before."""
+    if n_stores is None:
+        n_stores = len(args)
+    if _is_tensorish(pred):
+        from ..core.dispatch import apply, no_grad_ctx
+
+        in_idx = [i for i, a in enumerate(args) if _is_operand(a)]
+        out_idx = sorted(set(in_idx) | set(range(n_stores)))
+
+        def mk(branch):
+            def run(raw_vals):
+                full = list(args)
+                for i, v in zip(in_idx, raw_vals):
+                    full[i] = _wrap_val(v)
+                with no_grad_ctx():  # the outer vjp owns differentiation
+                    out = branch(*full)
+                out = out if isinstance(out, tuple) else (out,)
+                return tuple(_raw_val(out[i]) for i in out_idx)
+            return run
+
+        def _fn(p, *vals):
+            import jax
+
+            return jax.lax.cond(p, mk(true_fn), mk(false_fn), tuple(vals))
+
+        try:
+            out = apply("dy2st_cond", _fn, pred,
+                        *[args[i] for i in in_idx])
+        except TypeError as e:
+            if "Undefined" not in str(e):
+                raise
+            undef = [n for n, a in zip(names, args) if a is _UNDEF]
+            raise ValueError(
+                "dy2static: variable(s) assigned in only one branch of a "
+                f"Tensor-predicate if cannot compile to XLA Cond: "
+                f"{undef or '<unknown>'}; initialize them before the if "
+                "(both branches of a compiled conditional must produce "
+                "the same variables)") from e
+        out = list(out) if isinstance(out, (tuple, list)) else [out]
+        res = list(args)
+        for i, v in zip(out_idx, out):
+            res[i] = v
+        return tuple(res)
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def _cvt_while(cond_fn, body_fn, args, names=(), n_stores=None):
+    """Runtime half of the while-rewrite (reference:
+    convert_operators.py convert_while_loop).  The Tensor-condition path
+    lowers to XLA While via jit.while_loop (forward-only: XLA While has
+    no reverse-mode); non-operand carried values are closed over."""
+    if n_stores is None:
+        n_stores = len(args)
+    first = cond_fn(*args)
+    if _is_tensorish(first):
+        if any(args[i] is _UNDEF for i in range(n_stores)):
+            undef = [n for n, a in zip(names, args) if a is _UNDEF]
+            raise ValueError(
+                "dy2static while over a Tensor condition: every "
+                f"loop-carried variable must be initialized before the "
+                f"loop (XLA While needs typed loop state): {undef}")
+        from . import while_loop
+
+        op_idx = [i for i, a in enumerate(args) if _is_operand(a)]
+
+        def merge(real):
+            full = list(args)
+            for i, v in zip(op_idx, real):
+                full[i] = v
+            return full
+
+        def c2(*real):
+            return cond_fn(*merge(real))
+
+        def b2(*real):
+            out = body_fn(*merge(real))
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(out[i] for i in op_idx)
+
+        real_out = while_loop(c2, b2, [args[i] for i in op_idx])
+        res = list(args)
+        for i, v in zip(op_idx, real_out):
+            res[i] = v
+        return tuple(res)
+    # python-bool loop: reuse `first` — re-evaluating a side-effecting
+    # condition (iterator, counter) would silently skip an iteration
+    vals = tuple(args)
+    cur = first
+    while cur:
+        out = body_fn(*vals)
+        vals = out if isinstance(out, tuple) else (out,)
+        cur = cond_fn(*vals)
+    return vals
+
+
+class _Unsupported(Exception):
+    pass
+
+
+def _assigned_names(stmts):
+    """Names bound by plain Name stores in a statement list (recursing
+    into nested ifs/loops but NOT into nested function/class defs)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # don't descend
+            names.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.append(node.name)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.append(node.id)
+
+    for s in stmts:
+        V().visit(s)
+    # preserve first-seen order, dedupe; generated helper names
+    # (__dy2st_*) are trace-time machinery, never loop/branch state
+    seen, out = set(), []
+    for n in names:
+        if n not in seen and not n.startswith("__dy2st_"):
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _check_supported(stmts):
+    """Raise _Unsupported if the bodies contain constructs the minimal
+    closure rewrite cannot preserve."""
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            raise _Unsupported("return in controlled block")
+
+        def visit_Break(self, node):
+            raise _Unsupported("break in controlled block")
+
+        def visit_Continue(self, node):
+            raise _Unsupported("continue in controlled block")
+
+        def visit_Global(self, node):
+            raise _Unsupported("global in controlled block")
+
+        def visit_Nonlocal(self, node):
+            raise _Unsupported("nonlocal in controlled block")
+
+        def visit_FunctionDef(self, node):  # nested defs: opaque, fine
+            return
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, (ast.Attribute, ast.Subscript)) \
+                            and isinstance(sub.ctx, ast.Store):
+                        raise _Unsupported(
+                            "attribute/subscript store in controlled "
+                            "block (object mutation would run at trace "
+                            "time)")
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, (ast.Attribute, ast.Subscript)):
+                raise _Unsupported("attribute/subscript augassign")
+            self.generic_visit(node)
+
+    for s in stmts:
+        V().visit(s)
+
+
+def _name(n, ctx):
+    return ast.Name(id=n, ctx=ctx)
+
+
+def _undef_guard(n):
+    """``try: n  except (NameError, UnboundLocalError): n = _UNDEF`` —
+    seeds carried names that have no binding yet."""
+    return ast.Try(
+        body=[ast.Expr(value=_name(n, ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError", ast.Load()),
+                                 _name("UnboundLocalError", ast.Load())],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[_name(n, ast.Store())],
+                value=ast.Attribute(
+                    value=_name(_HELPERS, ast.Load()),
+                    attr="_UNDEF", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[_name(n, ast.Load()) for n in names], ctx=ast.Load()))
+
+
+def _make_fn(fname, params, body, extra_ret):
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+    return ast.FunctionDef(
+        name=fname, args=args, body=body + [extra_ret],
+        decorator_list=[], returns=None)
+
+
+def _loaded_names(nodes):
+    """Names read in the given nodes (not descending into nested defs)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            return
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load):
+                names.append(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return names
+
+
+class _Rewriter(ast.NodeTransformer):
+    def __init__(self, global_names=(), local_names=(), free_names=()):
+        self.counter = 0
+        self.changed = False
+        import builtins
+
+        # reads of globals/builtins/free variables stay closed over;
+        # LOCALS override (a local named `input` shadowing the builtin
+        # must ride as an operand or the gradient chain through the
+        # dispatched cond silently breaks).  Free variables must NOT be
+        # carried: the rewrite's tuple-assignment would turn them into
+        # locals of the converted clone and shadow the closure.
+        self._skip = ((set(global_names) | set(dir(builtins))
+                       | set(free_names)) - set(local_names))
+
+    def _carried(self, stores, load_nodes):
+        """Carried set = assigned names + LOCAL names the bodies read.
+        Reads must ride as operands (not closure constants) so the
+        gradient chain through the dispatched cond reaches them; global
+        and builtin names stay closed over."""
+        carried = list(stores)
+        seen = set(stores)
+        for n in _loaded_names(load_nodes):
+            if n not in seen and n not in self._skip \
+                    and n != _HELPERS and not n.startswith("__dy2st_"):
+                seen.add(n)
+                carried.append(n)
+        return carried
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__dy2st_{kind}_{self.counter}"
+
+    # nested function definitions keep their own control flow untouched
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        try:
+            _check_supported(node.body + node.orelse)
+        except _Unsupported:
+            return node
+        stores = _assigned_names(node.body + node.orelse)
+        if not stores:
+            return node  # pure side-effect-free branch: nothing to thread
+        carried = self._carried(stores, node.body + node.orelse)
+        t_name, f_name = self._fresh("true"), self._fresh("false")
+        ret = _ret_tuple(carried)
+        t_fn = _make_fn(t_name, carried, list(node.body), ret)
+        f_fn = _make_fn(f_name, carried,
+                        list(node.orelse) if node.orelse else [ast.Pass()],
+                        ret)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=_name(_HELPERS, ast.Load()),
+                    attr="_cvt_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      _name(t_name, ast.Load()),
+                      _name(f_name, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load())
+                                      for n in carried],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in carried],
+                                ctx=ast.Load()),
+                      ast.Constant(value=len(stores))],
+                keywords=[]))
+        self.changed = True
+        return [_undef_guard(n) for n in carried] + [t_fn, f_fn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else: rare, unsupported
+        try:
+            _check_supported(node.body)
+        except _Unsupported:
+            return node
+        stores = _assigned_names(node.body)
+        if not stores:
+            return node
+        carried = self._carried(stores, node.body + [node.test])
+        c_name, b_name = self._fresh("cond"), self._fresh("body")
+        c_fn = _make_fn(c_name, carried, [], ast.Return(value=node.test))
+        b_fn = _make_fn(b_name, carried, list(node.body),
+                        _ret_tuple(carried))
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=_name(_HELPERS, ast.Load()),
+                    attr="_cvt_while", ctx=ast.Load()),
+                args=[_name(c_name, ast.Load()),
+                      _name(b_name, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load())
+                                      for n in carried],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in carried],
+                                ctx=ast.Load()),
+                      ast.Constant(value=len(stores))],
+                keywords=[]))
+        self.changed = True
+        return [_undef_guard(n) for n in carried] + [c_fn, b_fn, call]
+
+
+def convert_function(fn):
+    """Return a control-flow-converted clone of ``fn``, or ``fn`` itself
+    when the pass does not apply (no rewritable statements, no source,
+    free variables, @not_to_static, ...)."""
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    if inspect.ismethod(fn):
+        conv = convert_function(fn.__func__)
+        return fn if conv is fn.__func__ else conv.__get__(fn.__self__)
+    raw = inspect.unwrap(fn)
+    freevars, freevals = (), ()
+    if getattr(raw, "__closure__", None):
+        # closures: re-wrap the converted def in a factory taking the
+        # free variables as parameters — the cells are SNAPSHOT at
+        # conversion (the trace target is rebuilt per StaticFunction, so
+        # this matches when the closure binds layers/optimizers, the
+        # overwhelmingly common to_static pattern)
+        try:
+            freevals = tuple(c.cell_contents for c in raw.__closure__)
+        except ValueError:  # empty cell (self-referential def)
+            return fn
+        freevars = raw.__code__.co_freevars
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    rw = _Rewriter(global_names=raw.__globals__.keys(),
+                   local_names=raw.__code__.co_varnames,
+                   free_names=raw.__code__.co_freevars)
+    # visit the body statements, not fdef itself — visit_FunctionDef
+    # guards NESTED defs only
+    new_body = []
+    for s in fdef.body:
+        r = rw.visit(s)
+        if isinstance(r, list):
+            new_body.extend(r)
+        elif r is not None:
+            new_body.append(r)
+    fdef.body = new_body
+    if not rw.changed:
+        return fn
+    if freevars:
+        factory = _make_fn(
+            "__dy2st_factory__", list(freevars), [fdef],
+            ast.Return(value=_name(fdef.name, ast.Load())))
+        tree = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, f"<dy2static {raw.__name__}>", "exec")
+    except (SyntaxError, ValueError):
+        return fn
+    import sys
+
+    namespace = dict(raw.__globals__)
+    namespace[_HELPERS] = sys.modules[__name__]
+    exec(code, namespace)
+    if freevars:
+        converted = namespace["__dy2st_factory__"](*freevals)
+    else:
+        converted = namespace[fdef.name]
+    converted.__defaults__ = raw.__defaults__
+    converted.__kwdefaults__ = raw.__kwdefaults__
+    converted._dy2static_converted = True
+    return converted

@@ -261,3 +261,247 @@ class TestCompiledNanInfCheck:
                               paddle.to_tensor([0.0]))
         finally:
             set_flags({"check_nan_inf": False})
+
+
+class TestDy2StaticAST:
+    """Minimal AST dy2static pass (VERDICT r3 #7; reference:
+    dygraph_to_static/program_translator.py + convert_operators.py):
+    data-dependent if/while over scalar tensors compile under to_static
+    via jit.cond/jit.while_loop; Python-bool control flow and
+    unsupported constructs keep their trace semantics."""
+
+    def test_tensor_if_compiles_and_matches_eager(self):
+        def f(x):
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = jit.to_static(f)
+        xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(st(xp).numpy(), f(xp).numpy())
+        np.testing.assert_allclose(st(xn).numpy(), f(xn).numpy())
+        # ONE executable serves both predicate values (it's a lax.cond,
+        # not two traces specialized on a python bool)
+        assert len(st._cache) == 1
+
+    def test_tensor_while_compiles(self):
+        def g(x):
+            i = paddle.to_tensor(np.float32(0.0))
+            while paddle.sum(x) < 100.0:
+                x = x * 2.0
+                i = i + 1.0
+            return x, i
+
+        st = jit.to_static(g)
+        out, n = st(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(n.numpy(), 6.0)
+        np.testing.assert_allclose(out.numpy(), [64.0, 128.0])
+
+    def test_python_bool_if_untouched_semantics(self):
+        def f(x, flag):
+            if flag:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = jit.to_static(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(st(x, True).numpy(), [2.0])
+        np.testing.assert_allclose(st(x, False).numpy(), [0.0])
+
+    def test_nested_if_in_while(self):
+        def f(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            while paddle.sum(x) < 20.0:
+                if paddle.mean(x) > 1.5:
+                    x = x + 2.0
+                else:
+                    x = x * 3.0
+                s = s + 1.0
+            return x, s
+
+        st = jit.to_static(f)
+        x0 = np.array([1.0, 1.0], np.float32)
+
+        def ref(x):
+            s = 0.0
+            while x.sum() < 20.0:
+                if x.mean() > 1.5:
+                    x = x + 2.0
+                else:
+                    x = x * 3.0
+                s += 1.0
+            return x, s
+
+        out, s = st(paddle.to_tensor(x0))
+        rx, rs = ref(x0)
+        np.testing.assert_allclose(out.numpy(), rx)
+        np.testing.assert_allclose(s.numpy(), rs)
+
+    def test_translator_disable_runs_original_eagerly(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        st = jit.to_static(f)
+        jit.ProgramTranslator.get_instance().enable(False)
+        try:
+            out = st(paddle.to_tensor(np.array([2.0], np.float32)))
+            np.testing.assert_allclose(out.numpy(), [4.0])
+        finally:
+            jit.ProgramTranslator.get_instance().enable(True)
+
+    def test_return_in_branch_falls_back(self):
+        """return inside a branch is outside the minimal pass — the
+        function must keep working for python-bool predicates (trace
+        specializes on the bool, reference trace-fallback posture)."""
+        def f(x, flag):
+            if flag:
+                return x * 2.0
+            return x + 1.0
+
+        st = jit.to_static(f)
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        np.testing.assert_allclose(st(x, True).numpy(), [6.0])
+        np.testing.assert_allclose(st(x, False).numpy(), [4.0])
+
+    def test_layer_method_converted(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if paddle.mean(h) > 0:
+                    out = paddle.tanh(h)
+                else:
+                    out = h * 0.5
+                return out
+
+        paddle.seed(0)
+        net = Net()
+        eager_pos = net(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        eager_neg = net(paddle.to_tensor(-np.ones((2, 4), np.float32)))
+        paddle.seed(0)  # same init -> same weights as the eager net
+        st2 = jit.to_static(Net())
+        np.testing.assert_allclose(
+            st2(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy(),
+            eager_pos.numpy(), atol=1e-6)
+        np.testing.assert_allclose(
+            st2(paddle.to_tensor(-np.ones((2, 4), np.float32))).numpy(),
+            eager_neg.numpy(), atol=1e-6)
+
+    def test_one_branch_assignment_clear_error(self):
+        def f(x):
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+                tmp = x + 1.0  # noqa: F841 — branch-local, never merged
+            else:
+                y = x - 1.0
+            return y
+
+        st = jit.to_static(f)
+        with pytest.raises(ValueError, match="tmp"):
+            st(paddle.to_tensor(np.array([1.0], np.float32)))
+
+    def test_gradients_flow_through_converted_if(self):
+        """The tensor-pred if dispatches through the tape (lax.cond is
+        jax-differentiable) — a bare jit.cond would return node-less
+        Tensors and backward would silently produce no grads."""
+        net = nn.Linear(4, 1)
+        opt = SGD(0.1, parameters=net.parameters())
+
+        @jit.to_static
+        def step(x):
+            loss = net(x).square().mean()
+            if loss > 0.0:          # always true, but data-dependent
+                scaled = loss * 2.0
+            else:
+                scaled = loss
+            scaled.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(r(8, 4))
+        losses = [float(step(x).numpy()) for _ in range(10)]
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_builtin_shadowing_local_rides_as_operand(self):
+        """A local named `input` (shadowing the builtin — the standard
+        paddle argument name) must still be a cond operand, or backward
+        through the converted if silently drops the gradient chain."""
+        net = nn.Linear(4, 1)
+        opt = SGD(0.1, parameters=net.parameters())
+
+        @jit.to_static
+        def step(x):
+            input = net(x).square().mean()  # noqa: A002
+            if input > 0:
+                scaled = input * 2.0
+            else:
+                scaled = input
+            scaled.backward()
+            opt.step()
+            opt.clear_grad()
+            return input
+
+        x = paddle.to_tensor(r(8, 4))
+        losses = [float(step(x).numpy()) for _ in range(10)]
+        assert losses[-1] < 0.7 * losses[0], losses
+
+    def test_closure_layer_read_in_branch(self):
+        """A closure-captured layer called inside a branch stays closed
+        over (never carried — the tuple-assign would shadow it)."""
+        lin = nn.Linear(2, 2)
+
+        @jit.to_static
+        def f(x):
+            if paddle.mean(x) > 0:
+                y = lin(x)
+            else:
+                y = lin(x) * 0.5
+            return y
+
+        xp = paddle.to_tensor(np.ones((1, 2), np.float32))
+        xn = paddle.to_tensor(-np.ones((1, 2), np.float32))
+        ref = lin(xp).numpy()
+        np.testing.assert_allclose(f(xp).numpy(), ref, atol=1e-6)
+        np.testing.assert_allclose(f(xn).numpy(),
+                                   lin(xn).numpy() * 0.5, atol=1e-6)
+
+        @jit.to_static
+        def g(x):
+            while paddle.sum(x) < 10.0:
+                x = lin(x).abs() + x + 1.0
+            return x
+
+        out = g(paddle.to_tensor(np.zeros((1, 2), np.float32)))
+        assert float(out.sum().numpy()) >= 10.0
+
+    def test_side_effecting_python_while_condition(self):
+        """The python-bool path must not re-evaluate a side-effecting
+        condition for the first test (an extra call would silently skip
+        an iteration)."""
+        calls = []
+
+        @jit.to_static
+        def f(x):
+            s = x * 0.0
+            while len(calls) < 3 and (calls.append(1) or True):
+                s = s + 1.0
+            return s
+
+        out = f(paddle.to_tensor(np.float32(0.0)))
+        np.testing.assert_allclose(out.numpy(), 3.0)
