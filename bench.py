@@ -371,6 +371,10 @@ def run_bench():
             num_key_value_heads=8, max_position_embeddings=2048,
             dtype="bfloat16")
         batch, seq, steps, warmup = 8, 2048, 20, 5
+        # experiment knob (tools/run_tpu_experiments.sh): batch override
+        import os as _os
+
+        batch = int(_os.environ.get("BENCH_BATCH", batch))
     else:  # smoke path for CPU dev runs
         cfg = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 5, 2

@@ -70,6 +70,10 @@ define_flag("use_pallas_kernels", True, "use Pallas kernels for fused ops on TPU
 define_flag("use_autotune", False, "search + cache kernel tile sizes "
             "(reference: phi/kernels/autotune switch_autotune)")
 define_flag("benchmark", False, "synchronize after every op (timing mode)")
+define_flag("flash_block_q", 0,
+            "override flash-attention q-block size (0 = default/autotune)")
+define_flag("flash_block_k", 0,
+            "override flash-attention k-block size (0 = default/autotune)")
 define_flag("heter_max_payload_mb", 64,
             "cap (MiB) on a single array moved through the TCPStore by the "
             "heter gateway; large gradients belong on XLA collectives "

@@ -421,6 +421,13 @@ def flash_attention_bhtd(q, k, v, causal=False, scale=None,
     if not _HAS_PLTPU:
         return _attn_reference(q, k, v, causal, scale)
     if block_q is None or block_k is None:
+        # explicit flag override (perf experiments: FLAGS_flash_block_q=…
+        # env or set_flags) beats autotune/defaults
+        from ..core.flags import flag
+
+        block_q = block_q or (int(flag("flash_block_q")) or None)
+        block_k = block_k or (int(flag("flash_block_k")) or None)
+    if block_q is None or block_k is None:
         abq, abk = _autotuned_blocks(q, k, causal, scale, interpret)
         block_q = block_q or abq
         block_k = block_k or abk
