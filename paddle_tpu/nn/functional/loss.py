@@ -23,53 +23,123 @@ def _reduce(out, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True,
                   label_smoothing=0.0, name=None):
-    def _ce(logits, lab, *maybe_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
-        n_classes = logits.shape[axis]
-        if soft_label:
-            soft = lab
-            if label_smoothing > 0.0:
-                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
-            loss = -jnp.sum(soft * logp, axis=axis)
-            valid = None
-        else:
-            lab_idx = lab
-            if lab_idx.ndim == logp.ndim:
-                lab_idx = jnp.squeeze(lab_idx, axis)
-            lab_idx = lab_idx.astype(jnp.int32)
-            valid = lab_idx != ignore_index
-            safe = jnp.where(valid, lab_idx, 0)
-            picked = jnp.take_along_axis(
-                logp, jnp.expand_dims(safe, axis), axis=axis)
-            picked = jnp.squeeze(picked, axis)
-            if label_smoothing > 0.0:
-                smooth_loss = -jnp.mean(logp, axis=axis)
-                loss = (1 - label_smoothing) * (-picked) + \
-                    label_smoothing * smooth_loss
-            else:
-                loss = -picked
-            loss = jnp.where(valid, loss, 0.0)
-            if maybe_w:
-                w = maybe_w[0][safe]
-                loss = loss * jnp.where(valid, w, 0.0)
-        if reduction == "mean":
-            if valid is not None:
-                if maybe_w:
-                    denom = jnp.sum(jnp.where(valid, maybe_w[0][jnp.where(
-                        valid, lab_idx, 0)], 0.0))
-                else:
-                    denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
-                return jnp.sum(loss) / denom
-            return jnp.mean(loss)
-        return _reduce(loss, reduction)
-
     args = [_t(input), _t(label)]
     if weight is not None:
         args.append(_t(weight))
-    return apply("cross_entropy", _ce, *args)
+    return apply("cross_entropy", _cross_entropy_impl, *args,
+                 ignore_index=ignore_index, reduction=reduction,
+                 soft_label=soft_label, axis=axis, use_softmax=use_softmax,
+                 label_smoothing=label_smoothing)
+
+
+def _cross_entropy_impl(logits, lab, *maybe_w, ignore_index=-100,
+                        reduction="mean", soft_label=False, axis=-1,
+                        use_softmax=True, label_smoothing=0.0):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    n_classes = logits.shape[axis]
+    if soft_label:
+        soft = lab
+        if label_smoothing > 0.0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis)
+        valid = None
+    else:
+        loss, valid, safe = _hard_label_nll(logp, lab, ignore_index,
+                                            axis=axis)
+        if label_smoothing > 0.0:
+            smooth_loss = -jnp.mean(logp, axis=axis)
+            loss = (1 - label_smoothing) * loss + \
+                label_smoothing * jnp.where(valid, smooth_loss, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe]
+            loss = loss * jnp.where(valid, w, 0.0)
+    if reduction == "mean":
+        if valid is not None:
+            if maybe_w:
+                denom = jnp.sum(jnp.where(valid, maybe_w[0][safe], 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return jnp.mean(loss)
+    return _reduce(loss, reduction)
+
+
+def _hard_label_nll(logp, lab, ignore_index, axis=-1):
+    """Shared hard-label NLL pieces: (loss, valid, safe).  Used by BOTH
+    _cross_entropy_impl's hard-label branch and the analytic rule so the
+    two can never silently diverge numerically."""
+    lab_idx = lab
+    if lab_idx.ndim == logp.ndim:
+        lab_idx = jnp.squeeze(lab_idx, axis)
+    lab_idx = lab_idx.astype(jnp.int32)
+    valid = lab_idx != ignore_index
+    safe = jnp.where(valid, lab_idx, 0)
+    picked = jnp.squeeze(jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis), axis=axis), axis)
+    return jnp.where(valid, -picked, 0.0), valid, safe
+
+
+def _cross_entropy_rule(vals, attrs):
+    """Analytic softmax-CE backward — g = softmax, minus 1 at the label
+    positions — for the hard-label/no-weight/no-smoothing hot case
+    (every classification training loop's loss; reference codegen
+    analog: softmax_with_cross_entropy_grad)."""
+    if len(vals) != 2 or attrs.get("soft_label") \
+            or not attrs.get("use_softmax", True) \
+            or attrs.get("label_smoothing", 0.0):
+        return None
+    logits, lab = vals
+    axis = attrs.get("axis", -1)
+    if axis not in (-1, logits.ndim - 1):
+        return None
+    if not jnp.issubdtype(lab.dtype, jnp.integer):
+        return None
+    red = attrs.get("reduction", "mean")
+    if red not in ("mean", "sum", "none"):
+        return None
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss, valid, safe = _hard_label_nll(logp, lab,
+                                        attrs.get("ignore_index", -100))
+    denom = None
+    if red == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        out = jnp.sum(loss) / denom
+    elif red == "sum":
+        out = jnp.sum(loss)
+    else:
+        out = loss
+
+    def vjp(ct):
+        # softmax minus scatter of 1 at label positions — no dense
+        # one-hot temp (for an lm-head the one-hot would double the
+        # backward's peak memory)
+        g = jnp.exp(logp)
+        idx = jnp.expand_dims(safe, -1)
+        upd = jnp.take_along_axis(g, idx, axis=-1) - 1.0
+        g = jnp.put_along_axis(g, idx, upd, axis=-1, inplace=False)
+        g = g * valid[..., None].astype(g.dtype)
+        if red == "mean":
+            g = g * (ct / denom)
+        elif red == "sum":
+            g = g * ct
+        else:
+            g = g * ct[..., None]
+        return (g.astype(logits.dtype), None)  # int labels: no grad
+
+    return out, vjp
+
+
+def _register_loss_rules():
+    from ...core.dispatch import register_eager_vjp
+
+    register_eager_vjp("cross_entropy", _cross_entropy_impl,
+                       _cross_entropy_rule)
+
+
+_register_loss_rules()
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

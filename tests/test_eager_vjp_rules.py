@@ -244,3 +244,39 @@ class TestHigherOrderThroughRules:
             return losses
 
         np.testing.assert_allclose(train(False), train(True), rtol=1e-5)
+
+
+class TestCrossEntropyRule:
+    def test_variants_match_fallback(self):
+        logits = RNG.randn(6, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1, 3, 2], np.int64)
+        for red in ("mean", "sum", "none"):
+            _check("cross_entropy",
+                   lambda x: F.cross_entropy(
+                       x, paddle.to_tensor(labels), reduction=red).sum()
+                   if red == "none" else
+                   F.cross_entropy(x, paddle.to_tensor(labels),
+                                   reduction=red),
+                   [logits], atol=1e-5)
+
+    def test_ignore_index(self):
+        logits = RNG.randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100], np.int64)
+        _check("cross_entropy",
+               lambda x: F.cross_entropy(x, paddle.to_tensor(labels)),
+               [logits], atol=1e-5)
+
+    def test_unsupported_falls_back(self):
+        logits = RNG.randn(4, 3).astype(np.float32)
+        labels = np.array([0, 1, 2, 0], np.int64)
+        w = paddle.to_tensor(np.ones(3, np.float32))
+        with _count_fires("cross_entropy") as hits:
+            t = paddle.to_tensor(logits, stop_gradient=False)
+            F.cross_entropy(t, paddle.to_tensor(labels),
+                            weight=w).backward()
+        assert not hits  # weighted: jax.vjp fallback
+        with _count_fires("cross_entropy") as hits:
+            t = paddle.to_tensor(logits, stop_gradient=False)
+            F.cross_entropy(t, paddle.to_tensor(labels),
+                            label_smoothing=0.1).backward()
+        assert not hits

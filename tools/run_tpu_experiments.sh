@@ -29,10 +29,12 @@ run() {
     tail -5 "/tmp/bench_${name}.err" | sed 's/^/    /'
     rm -f "${out}"
   fi
-  # commit after EVERY experiment: a dying tunnel must not eat evidence
+  # commit after EVERY experiment: a dying tunnel must not eat evidence.
+  # Pathspec-limited so pre-staged unrelated work never rides along.
   if [ ${#FILES[@]} -gt 0 ]; then
     git add BENCH_LOCAL_"${STAMP}"_*.json 2>/dev/null || true
-    git commit -q -m "bench: TPU experiment ${name} (${STAMP})" || true
+    git commit -q -m "bench: TPU experiment ${name} (${STAMP})" \
+      -- BENCH_LOCAL_"${STAMP}"_*.json || true
   fi
 }
 
