@@ -427,16 +427,82 @@ def _unwrap_tree(t):
         _as_raw, t, is_leaf=lambda x: isinstance(x, Tensor))
 
 
+def _collect_captured_params(fn, seen=None, depth=0):
+    """Differentiable Tensors reachable from fn's closure — recursing
+    into nested function cells, Layers (their parameters), and small
+    containers.  These must ride as explicit tape operands or backward
+    through a dispatched cond/scan silently misses them (the classic
+    RNN-cell-closing-over-weights pattern)."""
+    if seen is None:
+        seen = {}
+    if fn is None or depth > 4:
+        return seen
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            _collect_from_value(cell.cell_contents, seen, depth)
+        except ValueError:  # empty cell
+            continue
+    return seen
+
+
+def _collect_from_value(v, seen, depth):
+    if isinstance(v, Tensor):
+        if not v.stop_gradient and id(v) not in seen:
+            seen[id(v)] = v
+    elif isinstance(v, Layer):
+        for p in v.parameters():
+            if not p.stop_gradient and id(p) not in seen:
+                seen[id(p)] = p
+    elif isinstance(v, (list, tuple)) and len(v) <= 64:
+        for e in v:
+            _collect_from_value(e, seen, depth)
+    elif callable(v) and getattr(v, "__closure__", None):
+        _collect_captured_params(v, seen, depth + 1)
+
+
+def _tape_cond(pred, true_fn, false_fn, operands, op_name="jit_cond"):
+    """Dispatch ONE tape op whose forward is lax.cond — jax-
+    differentiable, so backward reaches both the explicit operands and
+    any differentiable tensors the branches capture by closure (those
+    are auto-promoted to operands and functionally substituted during
+    the branch trace).  Shared by jit.cond and the dy2static if-rewrite."""
+    from ..core.dispatch import apply, no_grad_ctx
+
+    captured = list({**_collect_captured_params(true_fn),
+                     **_collect_captured_params(false_fn)}.values())
+    out_td = []
+
+    def _fn(p, ops, cap_vals):
+        def run(branch):
+            def inner(packed):
+                raw_ops, caps = packed
+                saved = [t._value for t in captured]
+                try:
+                    for t, v in zip(captured, caps):
+                        t._value = v
+                    with no_grad_ctx():  # the outer vjp differentiates
+                        res = _unwrap_tree(branch(*_wrap_tree(raw_ops)))
+                finally:
+                    for t, s in zip(captured, saved):
+                        t._value = s
+                flat, td = jax.tree_util.tree_flatten(res)
+                if not out_td:
+                    out_td.append(td)
+                return tuple(flat)
+            return inner
+        return jax.lax.cond(p, run(true_fn), run(false_fn),
+                            (ops, tuple(cap_vals)))
+
+    out = apply(op_name, _fn, pred, list(operands), list(captured))
+    out = out if isinstance(out, tuple) else (out,)
+    return jax.tree_util.tree_unflatten(out_td[0], list(out))
+
+
 def cond(pred, true_fn, false_fn, *operands):
     """Functional conditional lowered to XLA Cond (reference:
-    fluid/layers/control_flow.py cond)."""
-    raw_ops = _unwrap_tree(operands)
-    out = jax.lax.cond(
-        _as_raw(pred),
-        lambda ops: _unwrap_tree(true_fn(*_wrap_tree(ops))),
-        lambda ops: _unwrap_tree(false_fn(*_wrap_tree(ops))),
-        raw_ops)
-    return _wrap_tree(out)
+    fluid/layers/control_flow.py cond).  Differentiable through the tape
+    for operands AND closure-captured tensors/layer parameters."""
+    return _tape_cond(pred, true_fn, false_fn, operands)
 
 
 def while_loop(cond_fn, body_fn, loop_vars):
@@ -450,14 +516,41 @@ def while_loop(cond_fn, body_fn, loop_vars):
 
 
 def scan(f, init, xs):
-    """lax.scan with Tensor wrapping; the TPU-idiomatic loop primitive."""
+    """lax.scan with Tensor wrapping; the TPU-idiomatic loop primitive.
 
-    def body(carry, x):
-        new_c, y = f(_wrap_tree(carry), _wrap_tree(x))
-        return _unwrap_tree(new_c), _unwrap_tree(y)
+    Dispatched through the tape (lax.scan supports reverse mode), so
+    backward through a scan reaches init/xs — matching cond.  XLA While
+    (jit.while_loop) remains forward-only by backend design."""
+    from ..core.dispatch import apply, no_grad_ctx
 
-    carry, ys = jax.lax.scan(body, _unwrap_tree(init), _unwrap_tree(xs))
-    return _wrap_tree(carry), _wrap_tree(ys)
+    captured = list(_collect_captured_params(f).values())
+    meta = []
+
+    def _fn(init_raw, xs_raw, cap_vals):
+        def body(c, x):
+            saved = [t._value for t in captured]
+            try:
+                for t, v in zip(captured, cap_vals):
+                    t._value = v
+                with no_grad_ctx():  # the outer vjp owns differentiation
+                    new_c, y = f(_wrap_tree(c), _wrap_tree(x))
+            finally:
+                for t, s in zip(captured, saved):
+                    t._value = s
+            return _unwrap_tree(new_c), _unwrap_tree(y)
+
+        carry, ys = jax.lax.scan(body, init_raw, xs_raw)
+        cf, ctd = jax.tree_util.tree_flatten(carry)
+        yf, ytd = jax.tree_util.tree_flatten(ys)
+        if not meta:
+            meta.append((len(cf), ctd, ytd))
+        return tuple(cf) + tuple(yf)
+
+    out = apply("jit_scan", _fn, init, xs, list(captured))
+    out = out if isinstance(out, tuple) else (out,)
+    n, ctd, ytd = meta[0]
+    return (jax.tree_util.tree_unflatten(ctd, list(out[:n])),
+            jax.tree_util.tree_unflatten(ytd, list(out[n:])))
 
 
 # ------------------------------------------------------------- save / load

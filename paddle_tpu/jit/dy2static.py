@@ -73,19 +73,6 @@ def _is_operand(a):
             or isinstance(a, (bool, int, float, complex)))
 
 
-def _wrap_val(v):
-    from ..core.tensor import Tensor
-
-    return Tensor(v) if hasattr(v, "dtype") and not isinstance(v, Tensor) \
-        else v
-
-
-def _raw_val(o):
-    from ..core.tensor import Tensor
-
-    return o._value if isinstance(o, Tensor) else o
-
-
 def _cvt_ifelse(pred, true_fn, false_fn, args, names=(), n_stores=None):
     """Runtime half of the if-rewrite (reference:
     convert_operators.py convert_ifelse).
@@ -101,30 +88,25 @@ def _cvt_ifelse(pred, true_fn, false_fn, args, names=(), n_stores=None):
     if n_stores is None:
         n_stores = len(args)
     if _is_tensorish(pred):
-        from ..core.dispatch import apply, no_grad_ctx
+        from . import _tape_cond
 
         in_idx = [i for i, a in enumerate(args) if _is_operand(a)]
         out_idx = sorted(set(in_idx) | set(range(n_stores)))
 
-        def mk(branch):
-            def run(raw_vals):
+        def sel(branch):
+            def wrapped(*real):
                 full = list(args)
-                for i, v in zip(in_idx, raw_vals):
-                    full[i] = _wrap_val(v)
-                with no_grad_ctx():  # the outer vjp owns differentiation
-                    out = branch(*full)
+                for i, v in zip(in_idx, real):
+                    full[i] = v
+                out = branch(*full)
                 out = out if isinstance(out, tuple) else (out,)
-                return tuple(_raw_val(out[i]) for i in out_idx)
-            return run
-
-        def _fn(p, *vals):
-            import jax
-
-            return jax.lax.cond(p, mk(true_fn), mk(false_fn), tuple(vals))
+                return tuple(out[i] for i in out_idx)
+            return wrapped
 
         try:
-            out = apply("dy2st_cond", _fn, pred,
-                        *[args[i] for i in in_idx])
+            res_out = _tape_cond(pred, sel(true_fn), sel(false_fn),
+                                 [args[i] for i in in_idx],
+                                 op_name="dy2st_cond")
         except TypeError as e:
             if "Undefined" not in str(e):
                 raise
@@ -135,9 +117,10 @@ def _cvt_ifelse(pred, true_fn, false_fn, args, names=(), n_stores=None):
                 f"{undef or '<unknown>'}; initialize them before the if "
                 "(both branches of a compiled conditional must produce "
                 "the same variables)") from e
-        out = list(out) if isinstance(out, (tuple, list)) else [out]
         res = list(args)
-        for i, v in zip(out_idx, out):
+        if not isinstance(res_out, (tuple, list)):
+            res_out = (res_out,)
+        for i, v in zip(out_idx, res_out):
             res[i] = v
         return tuple(res)
     return true_fn(*args) if pred else false_fn(*args)

@@ -505,3 +505,92 @@ class TestDy2StaticAST:
 
         out = f(paddle.to_tensor(np.float32(0.0)))
         np.testing.assert_allclose(out.numpy(), 3.0)
+
+
+class TestControlFlowGrads:
+    """jit.cond and jit.scan dispatch through the tape (lax.cond/scan are
+    jax-differentiable) so backward reaches their tensor operands."""
+
+    def test_cond_backward(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        out = jit.cond(paddle.to_tensor(True),
+                       lambda a: (a * a).sum(),
+                       lambda a: a.sum(), x)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+        x2 = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                              stop_gradient=False)
+        out2 = jit.cond(paddle.to_tensor(False),
+                        lambda a: (a * a).sum(),
+                        lambda a: a.sum(), x2)
+        out2.backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [1.0, 1.0])
+
+    def test_scan_backward(self):
+        xs = paddle.to_tensor(np.arange(1, 5, dtype=np.float32),
+                              stop_gradient=False)
+        carry, ys = jit.scan(lambda c, x: (c * x, c),
+                             paddle.to_tensor(np.float32(1.0)), xs)
+        carry.backward()  # carry = prod(xs); d/dxi = prod/xi
+        np.testing.assert_allclose(xs.grad.numpy(),
+                                   [24.0, 12.0, 8.0, 6.0])
+
+    def test_cond_under_to_static_trains(self):
+        net = nn.Linear(4, 1)
+        opt = SGD(0.1, parameters=net.parameters())
+
+        @jit.to_static
+        def step(x):
+            loss = net(x).square().mean()
+            scaled = jit.cond(loss > 0.0,
+                              lambda v: v * 2.0, lambda v: v, loss)
+            scaled.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(r(8, 4))
+        losses = [float(step(x).numpy()) for _ in range(10)]
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_closure_captured_weights_get_grads(self):
+        """Branches/bodies closing over layer weights (the RNN-cell
+        pattern) must receive gradients: captured tensors are promoted
+        to tape operands and functionally substituted during the trace."""
+        w = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        out = jit.cond(paddle.to_tensor(True),
+                       lambda a: (a * w).sum(), lambda a: a.sum(), x)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        np.testing.assert_allclose(w.grad.numpy(), 3.0)
+
+        w2 = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        init = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+        xs = paddle.to_tensor(np.ones(3, np.float32))
+        carry, _ = jit.scan(lambda c, t: (c * w2 + t, c), init, xs)
+        carry.backward()
+        # carry = ((1*w+1)*w+1)*w+1 = w^3 + w^2 + w + 1; d/dw = 3w^2+2w+1
+        np.testing.assert_allclose(w2.grad.numpy(),
+                                   3 * 0.25 + 2 * 0.5 + 1, rtol=1e-6)
+        np.testing.assert_allclose(init.grad.numpy(), 0.125)
+
+    def test_rnn_scan_cell_trains(self):
+        cell = nn.Linear(4, 4)
+        opt = SGD(0.05, parameters=cell.parameters())
+        xs = paddle.to_tensor(np.random.RandomState(0)
+                              .randn(5, 2, 4).astype(np.float32))
+        init = paddle.to_tensor(np.zeros((2, 4), np.float32))
+
+        losses = []
+        for _ in range(50):
+            carry, _ = jit.scan(
+                lambda c, x: (paddle.tanh(cell(c) + x), c), init, xs)
+            loss = carry.square().mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.6 * losses[0], losses
