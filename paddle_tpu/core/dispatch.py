@@ -191,13 +191,15 @@ def _unbroadcast(ct, shape, dtype):
 _EAGER_VJP_RULES = {}
 
 
-def register_eager_vjp(name, impl_fn, rule):
+def register_eager_vjp(name, impl_fn, rule, allow_containers=False):
     """Register a closed-form eager VJP for op `name` when dispatched with
     `impl_fn` (matched by identity — a same-named op arriving with a
     different closure falls back to jax.vjp).  Multiple impls may share a
-    name (e.g. linear with/without bias)."""
+    name (e.g. linear with/without bias).  With allow_containers the rule
+    also fires for container-arg ops (concat/stack): it then receives the
+    FLATTENED tensor leaves in pytree order."""
     _EAGER_VJP_RULES[name] = _EAGER_VJP_RULES.get(name, ()) + (
-        (impl_fn, rule),)
+        (impl_fn, rule, allow_containers),)
 
 
 def eager_binop_rule(fwd, bwd):
@@ -347,9 +349,10 @@ def apply(name: str, fn, *args, _differentiable: bool = True, **attrs):
         out_raw = None
         rule_entries = _EAGER_VJP_RULES.get(name)
         if (rule_entries is not None and amp_np_dtype is None
-                and treedef is None and len(tensor_idx) == len(flat)):
-            for impl_fn, rule in rule_entries:
-                if impl_fn is fn:
+                and len(tensor_idx) == len(flat)):
+            for impl_fn, rule, allow_containers in rule_entries:
+                if impl_fn is fn and (treedef is None
+                                      or allow_containers):
                     res = rule([t._value for t in flat], attrs)
                     if res is not None:
                         out_raw, vjp_all = res

@@ -125,13 +125,44 @@ def unsqueeze_(x, axis, name=None):
     return x._rebind(unsqueeze(x, axis))
 
 
+def _concat_impl(vs, axis=0):
+    return jnp.concatenate(vs, axis=axis)
+
+
+def _concat_rule(vals, attrs):
+    ax = attrs.get("axis", 0)
+    out = jnp.concatenate(vals, axis=ax)
+    a = ax if ax >= 0 else vals[0].ndim + ax
+    points = np.cumsum([v.shape[a] for v in vals])[:-1].tolist()
+
+    def vjp(ct):
+        parts = jnp.split(ct, points, axis=a)
+        return tuple(p.astype(v.dtype) for p, v in zip(parts, vals))
+    return out, vjp
+
+
+def _stack_impl(vs, axis=0):
+    return jnp.stack(vs, axis=axis)
+
+
+def _stack_rule(vals, attrs):
+    ax = attrs.get("axis", 0)
+    out = jnp.stack(vals, axis=ax)
+    a = ax if ax >= 0 else out.ndim + ax
+
+    def vjp(ct):
+        return tuple(g.astype(v.dtype) for g, v in
+                     zip(jnp.moveaxis(ct, a, 0), vals))
+    return out, vjp
+
+
 def concat(x, axis=0, name=None):
     ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-    return apply("concat", lambda vs: jnp.concatenate(vs, axis=ax), list(x))
+    return apply("concat", _concat_impl, list(x), axis=ax)
 
 
 def stack(x, axis=0, name=None):
-    return apply("stack", lambda vs: jnp.stack(vs, axis=axis), list(x))
+    return apply("stack", _stack_impl, list(x), axis=int(axis))
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -659,6 +690,10 @@ def _register_manipulation_rules():
 
     register_eager_vjp("reshape", _reshape_impl, _reshape_rule)
     register_eager_vjp("transpose", _transpose_impl, _transpose_rule)
+    register_eager_vjp("concat", _concat_impl, _concat_rule,
+                       allow_containers=True)
+    register_eager_vjp("stack", _stack_impl, _stack_rule,
+                       allow_containers=True)
 
 
 _register_manipulation_rules()

@@ -42,7 +42,7 @@ def _count_fires(name):
         return counted
 
     dispatch._EAGER_VJP_RULES[name] = tuple(
-        (impl, wrap(rule)) for impl, rule in saved)
+        (impl, wrap(rule), allow) for impl, rule, allow in saved)
     try:
         yield hits
     finally:
@@ -280,3 +280,22 @@ class TestCrossEntropyRule:
             F.cross_entropy(t, paddle.to_tensor(labels),
                             label_smoothing=0.1).backward()
         assert not hits
+
+
+class TestContainerRules:
+    def test_concat(self):
+        a = RNG.randn(2, 3).astype(np.float32)
+        b = RNG.randn(4, 3).astype(np.float32)
+        c = RNG.randn(1, 3).astype(np.float32)
+        _check("concat", lambda *ts: paddle.concat(list(ts), axis=0),
+               [a, b, c])
+        a2 = RNG.randn(3, 2).astype(np.float32)
+        b2 = RNG.randn(3, 5).astype(np.float32)
+        _check("concat", lambda *ts: paddle.concat(list(ts), axis=-1),
+               [a2, b2])
+
+    def test_stack(self):
+        arrs = [RNG.randn(2, 3).astype(np.float32) for _ in range(3)]
+        _check("stack", lambda *ts: paddle.stack(list(ts), axis=0), arrs)
+        _check("stack", lambda *ts: paddle.stack(list(ts), axis=1), arrs)
+        _check("stack", lambda *ts: paddle.stack(list(ts), axis=-1), arrs)
