@@ -88,6 +88,7 @@ from . import sparse  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
+from . import cost_model  # noqa: F401
 from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import utils  # noqa: F401

@@ -37,6 +37,13 @@ from .store import TCPStore  # noqa: F401
 from ..kernels.ring_attention import ring_attention  # noqa: F401
 from ..kernels.ulysses_attention import ulysses_attention  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import passes  # noqa: F401
+from . import sharding as sharding_module  # noqa: F401
+from .sharding import (group_sharded_parallel,  # noqa: F401
+                       save_group_sharded_model)
+from .entry_attr import (CountFilterEntry, ProbabilityEntry,  # noqa: F401
+                         ShowClickEntry)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import planner  # noqa: F401
 from .planner import CostModel, Planner  # noqa: F401
 from . import launch  # noqa: F401

@@ -1,0 +1,60 @@
+"""Sparse-embedding entry policies (reference:
+python/paddle/distributed/entry_attr.py) — admission/eviction config for
+``paddle.static.nn.sparse_embedding`` rows on a parameter server.  Pure
+config descriptors: ``_to_attr()`` is the wire format the PS table reads."""
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is abstract")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id with fixed probability."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float) or not 0 < probability < 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id once it has been seen `count_filter` times."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError(
+                "count_filter must be a valid integer greater or equal "
+                "than 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Score rows by the named show/click slots (CTR-style eviction)."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name click_name must be a str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])

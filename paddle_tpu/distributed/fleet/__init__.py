@@ -358,3 +358,78 @@ class UserDefinedRoleMaker:
 class PaddleCloudRoleMaker:
     def __init__(self, is_collective=True, **kwargs):
         self._is_collective = is_collective
+
+
+class Role:
+    """reference: fleet/base/role_maker.py:28."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UtilBase:
+    """Cross-worker convenience collectives (reference:
+    fleet/base/util_factory.py UtilBase — there over Gloo comm_world
+    handles; here over the XLA/store-backed collective layer, so the
+    comm_world argument selects nothing and is accepted for parity)."""
+
+    def __init__(self):
+        self.role_maker = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ...core.tensor import to_tensor
+        from ..collective import all_reduce as _ar
+        from ..collective import ReduceOp
+
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = to_tensor(np.asarray(input))
+        _ar(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from ...core.tensor import to_tensor
+        from ..collective import all_gather as _ag
+
+        out = []
+        _ag(out, to_tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def get_file_shard(self, files):
+        """Contiguous near-even split of `files` for this worker
+        (reference util_factory.py:207 — first `remainder` workers get
+        one extra file)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read")
+        rank, world = worker_index(), worker_num()
+        per, rem = divmod(len(files), world)
+        begin = rank * per + min(rank, rem)
+        return files[begin:begin + per + (1 if rank < rem else 0)]
+
+    def print_on_rank(self, message, rank_id):
+        if get_rank() == rank_id:
+            print(message)
+
+
+# reference exposes the class as fleet.Fleet and a shared util instance
+Fleet = _Fleet
+util = UtilBase()
+
+from . import data_generator  # noqa: E402,F401
+from . import dataset as fleet_dataset  # noqa: E402
+from .data_generator import (  # noqa: E402,F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: E402,F401

@@ -1,0 +1,119 @@
+"""Fleet data generators (reference:
+python/paddle/distributed/fleet/data_generator/data_generator.py).
+
+The parameter-server data pipeline's user-side half: a subclass implements
+``generate_sample(line)`` returning an iterator over
+``[(slot_name, [feasign, ...]), ...]`` samples; ``run_from_stdin`` streams
+raw lines in and emits the MultiSlotDataFeed text protocol
+(``<ids_num> <id> <id> ...`` per slot) that QueueDataset / InMemoryDataset
+parse back into batches."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """User hook: return a zero-arg iterator over parsed samples."""
+        raise NotImplementedError(
+            "generate_sample must be implemented by the subclass")
+
+    def generate_batch(self, samples):
+        """User hook: batch-level post-processing; default passthrough."""
+
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def _drain(self, samples, out):
+        for sample in self.generate_batch(samples)():
+            out.write(self._gen_str(sample))
+
+    def run_from_memory(self):
+        """Emit samples produced by generate_sample(None) to stdout."""
+        batch = []
+        it = self.generate_sample(None)
+        for parsed in it():
+            if parsed is None:
+                continue
+            batch.append(parsed)
+            if len(batch) == self.batch_size_:
+                self._drain(batch, sys.stdout)
+                batch = []
+        if batch:
+            self._drain(batch, sys.stdout)
+
+    def run_from_stdin(self):
+        """Parse stdin lines with generate_sample, emit datafeed text."""
+        batch = []
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    self._drain(batch, sys.stdout)
+                    batch = []
+        if batch:
+            self._drain(batch, sys.stdout)
+
+
+def _check_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample must be a list or tuple of "
+            "(name, values) pairs, e.g. [('words', [1926, 8, 17]), "
+            "('label', [1])]")
+    return line
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric feasigns; records per-slot dtype in proto_info (uint64 for
+    ints, float for floats — the reference's protofile contract)."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        parts = []
+        proto = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            dtype = "uint64"
+            for v in elements:
+                if isinstance(v, float):
+                    dtype = "float"
+                parts.append(str(v))
+            proto.append((name, dtype))
+        if self._proto_info is None:
+            self._proto_info = proto
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Pre-stringified feasigns: fastest path, no type promotion."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(elements)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,211 @@
+"""PS-mode datasets (reference:
+python/paddle/distributed/fleet/dataset/dataset.py — DatasetBase /
+QueueDataset / InMemoryDataset over the C++ MultiSlotDataFeed).
+
+TPU-native shape: the C++ DataFeed/channel machinery collapses into a
+Python record pipeline (the heavy lifting on TPU is the infeed, which
+``paddle_tpu.io.DataLoader`` / DeviceLoader already own).  These classes
+keep the reference's FILE PROTOCOL — MultiSlot text, one ``<n> <v>...``
+group per slot per line, optionally produced by piping each file through
+``pipe_command`` (a data_generator script) — and yield padded numpy
+batches ready for Executor feed or DataLoader wrapping.
+"""
+from __future__ import annotations
+
+import random
+import shlex
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "QueueDataset", "InMemoryDataset"]
+
+
+def _var_name(v):
+    return v if isinstance(v, str) else getattr(v, "name", str(v))
+
+
+def _var_is_float(v):
+    dt = str(getattr(v, "dtype", "int64")).lower()
+    return "float" in dt
+
+
+class DatasetBase:
+    """Common config surface (reference DatasetBase.init/_set_* methods)."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_var: List = []
+        self.pipe_command = "cat"
+        self.input_type = 0
+        self.filelist: List[str] = []
+
+    def init(self, batch_size=1, thread_num=1, use_var=(), pipe_command="cat",
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat",
+             **kwargs):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.use_var = list(use_var)
+        self.pipe_command = pipe_command
+        self.input_type = input_type
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self.filelist = list(filelist)
+
+    # -- record parsing ----------------------------------------------------
+    def _read_lines(self, path: str):
+        if self.pipe_command and self.pipe_command != "cat":
+            # reference semantics: every file is piped through the user's
+            # data_generator command; its stdout is the MultiSlot text
+            with open(path, "rb") as fin:
+                proc = subprocess.Popen(
+                    shlex.split(self.pipe_command), stdin=fin,
+                    stdout=subprocess.PIPE, text=True)
+                try:
+                    yield from proc.stdout
+                finally:
+                    proc.stdout.close()
+                    proc.wait()
+        else:
+            with open(path) as f:
+                yield from f
+
+    def _parse_line(self, line: str) -> Optional[List[np.ndarray]]:
+        toks = line.split()
+        if not toks:
+            return None
+        out = []
+        pos = 0
+        for v in (self.use_var or [None]):
+            if pos >= len(toks):
+                return None  # short line: drop the record, like DataFeed
+            n = int(toks[pos])
+            vals = toks[pos + 1:pos + 1 + n]
+            pos += 1 + n
+            if v is None or _var_is_float(v):
+                out.append(np.asarray([float(x) for x in vals], np.float32))
+            else:
+                out.append(np.asarray([int(x) for x in vals], np.int64))
+        return out
+
+    def _records(self):
+        for path in self.filelist:
+            for line in self._read_lines(path):
+                rec = self._parse_line(line)
+                if rec is not None:
+                    yield rec
+
+    def _batch(self, records: List[List[np.ndarray]]) -> Dict[str, np.ndarray]:
+        """Pad each slot to the batch max length; LoD becomes (data, lens)."""
+        names = [_var_name(v) for v in (self.use_var or [])] or [
+            f"slot_{i}" for i in range(len(records[0]))]
+        out: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(names):
+            cols = [r[i] for r in records]
+            width = max(len(c) for c in cols)
+            arr = np.zeros((len(cols), width), cols[0].dtype)
+            for j, c in enumerate(cols):
+                arr[j, :len(c)] = c
+            out[name] = arr
+            out[name + "@len"] = np.asarray([len(c) for c in cols], np.int64)
+        return out
+
+    def _batches_of(self, it):
+        buf = []
+        for rec in it:
+            buf.append(rec)
+            if len(buf) == self.batch_size:
+                yield self._batch(buf)
+                buf = []
+        if buf:
+            yield self._batch(buf)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: records flow straight from file (through
+    pipe_command) to batches, nothing retained (reference QueueDataset)."""
+
+    def __iter__(self):
+        return self._batches_of(self._records())
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference InMemoryDataset: beam-style
+    load_into_memory / local_shuffle / global_shuffle / release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List = []
+        self._shuffled = 0
+
+    def init(self, **kwargs):
+        super().init(**kwargs)
+        return self
+
+    def update_settings(self, **kwargs):
+        for k, v in kwargs.items():
+            if k == "use_var":
+                self.use_var = list(v)
+            elif hasattr(self, k):
+                setattr(self, k, v)
+
+    def load_into_memory(self):
+        self._memory = list(self._records())
+
+    # preload is synchronous here: there is no C++ channel to overlap with
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        random.shuffle(self._memory)
+        self._shuffled = len(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Deterministic cross-rank partition: every rank shuffles the full
+        record set with the SAME seed, then keeps its hash slice — the
+        collective-free equivalent of the reference's shuffle service."""
+        rank, world = 0, 1
+        if fleet is not None:
+            rank = getattr(fleet, "worker_index", lambda: 0)()
+            world = getattr(fleet, "worker_num", lambda: 1)()
+        else:
+            from ..env import get_rank, get_world_size
+
+            rank, world = get_rank(), get_world_size()
+        rng = random.Random(2021)
+        order = list(range(len(self._memory)))
+        rng.shuffle(order)
+        self._memory = [self._memory[i] for i in order[rank::max(world, 1)]]
+        self._shuffled = len(self._memory)
+
+    def release_memory(self):
+        self._memory = []
+        self._shuffled = 0
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self._shuffled
+
+    def slots_shuffle(self, slots):
+        """Shuffle the VALUES of the named slots across records (the
+        reference's feature-importance ablation tool)."""
+        names = [_var_name(v) for v in self.use_var]
+        for s in slots:
+            if s not in names:
+                continue
+            i = names.index(s)
+            col = [r[i] for r in self._memory]
+            random.shuffle(col)
+            for r, c in zip(self._memory, col):
+                r[i] = c
+
+    def __iter__(self):
+        return self._batches_of(iter(self._memory))

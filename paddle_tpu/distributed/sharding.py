@@ -94,3 +94,82 @@ def reshard(x: Tensor, mesh=None, placements=None) -> Tensor:
     """Change a tensor's layout across the mesh (reference:
     auto_parallel/reshard.py — here it is one device_put; XLA moves bytes)."""
     return shard_tensor(x, mesh, placements)
+
+
+# ---------------------------------------------------------------------------
+# paddle.distributed.sharding module API (reference:
+# python/paddle/distributed/sharding/group_sharded.py)
+# ---------------------------------------------------------------------------
+
+_GSP_LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False):
+    """ZeRO wrapper (reference group_sharded.py:40: level 'os' shards
+    optimizer state, 'os_g' + gradients, 'p_g_os' + parameters).
+
+    GSPMD design: the reference's GroupShardedStage2/3 wrapper classes
+    (per-param allgather/reduce-scatter hooks, buffer management) collapse
+    into sharding ANNOTATIONS over the mesh's 'sharding' axis — the SPMD
+    partitioner inserts the reduce-scatter/allgather pairs and XLA
+    schedules them (HLO-verified in tests/test_distributed.py
+    TestZeROStages).  buffer_max_size / segment_size / sync_comm are
+    therefore accepted-and-ignored: fusion buffers and comm/compute
+    overlap are the compiler's job here.  offload=True is rejected rather
+    than ignored — parameter offload changes what fits in HBM, so
+    silently dropping it would misrepresent capacity."""
+    if level not in _GSP_LEVELS:
+        raise ValueError(
+            f"level must be one of {sorted(_GSP_LEVELS)}, got {level!r}")
+    if offload:
+        raise NotImplementedError(
+            "offload=True (CPU parameter offload) is not supported on the "
+            "TPU backend; use paddle.distributed.fleet recompute or a "
+            "higher sharding degree instead")
+    from .fleet import _pin_slot_shardings, apply_group_sharding
+    from .mesh import get_mesh, init_mesh
+
+    mesh = get_mesh()
+    if mesh is not None and "sharding" not in mesh.shape:
+        # never silently clobber a live mesh — every annotation already
+        # made against its axes would dangle
+        raise ValueError(
+            f"the global mesh {dict(mesh.shape)} has no 'sharding' axis; "
+            "build the mesh with one (e.g. fleet.init with "
+            "sharding_degree>1, or init_mesh({'dp': ..., 'sharding': ...}))"
+            " before calling group_sharded_parallel")
+    if mesh is None:
+        n = len(jax.devices())
+        if group is not None and getattr(group, "nranks", n) != n:
+            raise ValueError(
+                f"group.nranks={group.nranks} != visible devices {n}: "
+                "subgroup sharding needs a hybrid mesh — build it via "
+                "fleet.init(strategy with sharding_degree="
+                f"{group.nranks}) instead of passing `group` here")
+        mesh = init_mesh({"sharding": n})
+    apply_group_sharding(model, mesh, stage=_GSP_LEVELS[level])
+    # slots inherit the spec at the next step; pin eagerly-existing ones
+    if optimizer is not None and hasattr(optimizer, "_accumulators"):
+        try:
+            _pin_slot_shardings(optimizer)
+        except Exception:
+            pass  # slots not materialized yet; the step-time hook pins them
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference group_sharded.py:181 — gathers shards and saves full
+    state.  Orbax/np.save path: state_dict() values are global arrays
+    (GSPMD shards are views of the global value), so plain paddle.save
+    emits the full model."""
+    import os
+
+    from ..framework.io import save as _save
+
+    os.makedirs(output, exist_ok=True)
+    _save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        _save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

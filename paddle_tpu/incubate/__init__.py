@@ -7,8 +7,10 @@ import jax.numpy as jnp
 
 from ..core.dispatch import apply
 from ..core.tensor import Tensor, to_tensor
-from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 
 _autotune_config = {"kernel": {"enable": False},
                     "layout": {"enable": False},

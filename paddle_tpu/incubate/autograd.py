@@ -1,0 +1,7 @@
+"""paddle.incubate.autograd (reference:
+python/paddle/incubate/autograd/__init__.py) — the functional autograd
+API graduated to ``paddle.autograd`` in the reference too; incubate keeps
+the original import path alive.  Same objects, one implementation."""
+from ..autograd import Hessian, Jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]

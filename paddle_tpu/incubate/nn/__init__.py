@@ -76,3 +76,100 @@ class FusedTransformerEncoderLayer(nn.Layer):
 
 class FusedLinear(nn.Linear):
     pass
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Stacked fused decoder (reference:
+    incubate/nn/layer/fused_transformer.py FusedMultiTransformer — the
+    serving-path transformer used by PaddleNLP's generation engine, with
+    per-layer weight lists and a static KV cache).  Forward delegates to
+    functional.fused_multi_transformer; `caches` + `time_step` select
+    prefill vs decode exactly as the reference op does."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim "
+                f"({embed_dim})")
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        self.trans_qkvw = trans_qkvw
+        self.num_layers = num_layers
+        from ...nn import initializer as I
+
+        def mk(shape, attrs, i, is_bias=False, one=False):
+            attr = attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+            init = None
+            if attr is not None and hasattr(attr, "initializer"):
+                init = attr.initializer
+            if init is None:
+                init = I.Constant(1.0) if one else (
+                    I.Constant(0.0) if is_bias else I.XavierUniform())
+            return self.create_parameter(
+                list(shape), default_initializer=init, is_bias=is_bias)
+
+        H, hd, D, dff = num_heads, self.head_dim, embed_dim, dim_feedforward
+        # ParameterList, NOT plain lists: Layer.__setattr__ only registers
+        # Parameter/Layer values, so a bare list would leave every weight
+        # out of parameters()/state_dict() — optimizers and checkpoints
+        # would silently see an empty model
+        self.ln_scales, self.ln_biases = nn.ParameterList(), nn.ParameterList()
+        self.qkv_weights = nn.ParameterList()
+        self.qkv_biases = nn.ParameterList()
+        self.linear_weights = nn.ParameterList()
+        self.linear_biases = nn.ParameterList()
+        self.ffn_ln_scales = nn.ParameterList()
+        self.ffn_ln_biases = nn.ParameterList()
+        self.ffn1_weights = nn.ParameterList()
+        self.ffn1_biases = nn.ParameterList()
+        self.ffn2_weights = nn.ParameterList()
+        self.ffn2_biases = nn.ParameterList()
+        for i in range(num_layers):
+            self.ln_scales.append(mk([D], ln_scale_attrs, i, one=True))
+            self.ln_biases.append(mk([D], ln_bias_attrs, i, is_bias=True))
+            qkv_shape = [3, H, hd, D] if trans_qkvw else [3, D, H, hd]
+            self.qkv_weights.append(mk(qkv_shape, qkv_weight_attrs, i))
+            self.qkv_biases.append(mk([3, H, hd], qkv_bias_attrs, i,
+                                      is_bias=True))
+            self.linear_weights.append(mk([D, D], linear_weight_attrs, i))
+            self.linear_biases.append(mk([D], linear_bias_attrs, i,
+                                         is_bias=True))
+            self.ffn_ln_scales.append(mk([D], ffn_ln_scale_attrs, i,
+                                         one=True))
+            self.ffn_ln_biases.append(mk([D], ffn_ln_bias_attrs, i,
+                                         is_bias=True))
+            self.ffn1_weights.append(mk([D, dff], ffn1_weight_attrs, i))
+            self.ffn1_biases.append(mk([dff], ffn1_bias_attrs, i,
+                                       is_bias=True))
+            self.ffn2_weights.append(mk([dff, D], ffn2_weight_attrs, i))
+            self.ffn2_biases.append(mk([D], ffn2_bias_attrs, i,
+                                       is_bias=True))
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        out = functional.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training, trans_qkvw=self.trans_qkvw)
+        return out

@@ -1,0 +1,3 @@
+"""paddle.incubate.optimizer (reference:
+python/paddle/incubate/optimizer/__init__.py)."""
+from . import functional  # noqa: F401

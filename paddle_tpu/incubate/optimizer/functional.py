@@ -1,0 +1,218 @@
+"""Functional quasi-Newton minimizers (reference:
+python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py —
+minimize_bfgs returns (is_converge, num_func_calls, position, value,
+gradient, inverse_hessian); minimize_lbfgs drops the Hessian).
+
+Design: the reference builds these as static-graph while_loops so the
+whole solve lives in one program.  Here the solve runs eagerly over
+device arrays — each iteration is two fused XLA calls (value_and_grad +
+the rank-2 update) — and the strong-Wolfe line search is the standard
+bracket/zoom of Nocedal & Wright Alg. 3.5/3.6, the same scheme the
+reference's line_search.py implements.  Positive-definiteness is
+safeguarded by skipping the quasi-Newton update when s·y <= eps (the
+curvature condition fails only when the line search bailed early)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _prep(objective_func, initial_position, dtype):
+    jdt = jnp.dtype(dtype)
+    x0 = jnp.asarray(
+        initial_position._value if isinstance(initial_position, Tensor)
+        else np.asarray(initial_position), jdt).reshape(-1)
+
+    calls = [0]
+
+    def f_g(x):
+        calls[0] += 1
+        val, grad = _vg(x)
+        return val, grad
+
+    def scalar_fn(x):
+        out = objective_func(Tensor(x))
+        v = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        return v.reshape(())
+
+    _vg = jax.jit(jax.value_and_grad(scalar_fn))
+    return x0, f_g, calls
+
+
+def _strong_wolfe(f_g, xk, pk, f0, df0, alpha0, max_iters, c1=1e-4, c2=0.9):
+    """Bracket/zoom strong-Wolfe search along pk.  Returns
+    (alpha, f_new, g_new, ok)."""
+
+    def phi(a):
+        return f_g(xk + a * pk)
+
+    def dphi(g):
+        return float(jnp.dot(g, pk))
+
+    a_prev, f_prev, g_prev = 0.0, f0, None
+    d0 = df0
+    a = float(alpha0)
+    f_lo, a_lo, g_lo = f0, 0.0, None
+    a_hi = f_hi = None
+    for i in range(max_iters):
+        f_a, g_a = phi(a)
+        if (f_a > f0 + c1 * a * d0) or (i > 0 and f_a >= f_prev):
+            a_lo, f_lo, a_hi, f_hi = a_prev, f_prev, a, f_a
+            g_lo = g_prev
+            break
+        d_a = dphi(g_a)
+        if abs(d_a) <= -c2 * d0:
+            return a, f_a, g_a, True
+        if d_a >= 0:
+            a_lo, f_lo, a_hi, f_hi = a, f_a, a_prev, f_prev
+            g_lo = g_a
+            break
+        a_prev, f_prev, g_prev = a, f_a, g_a
+        a *= 2.0
+    else:
+        return a_prev, f_prev, g_prev, False
+
+    # zoom (Alg. 3.6): bisection flavor — robust, no cubic bookkeeping
+    for _ in range(max_iters):
+        a_j = 0.5 * (a_lo + a_hi)
+        f_j, g_j = phi(a_j)
+        if (f_j > f0 + c1 * a_j * d0) or (f_j >= f_lo):
+            a_hi, f_hi = a_j, f_j
+        else:
+            d_j = dphi(g_j)
+            if abs(d_j) <= -c2 * d0:
+                return a_j, f_j, g_j, True
+            if d_j * (a_hi - a_lo) >= 0:
+                a_hi, f_hi = a_lo, f_lo
+            a_lo, f_lo, g_lo = a_j, f_j, g_j
+        if abs(a_hi - a_lo) < 1e-12:
+            break
+    if g_lo is None:
+        f_lo, g_lo = phi(a_lo)
+    return a_lo, f_lo, g_lo, False
+
+
+def _pack(is_converge, calls, x, f, g, H=None):
+    out = [Tensor(jnp.asarray(is_converge)),
+           Tensor(jnp.asarray(calls, jnp.int32)),
+           Tensor(x), Tensor(f), Tensor(g)]
+    if H is not None:
+        out.append(Tensor(H))
+    return tuple(out)
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only strong_wolfe line search is supported, got "
+            f"{line_search_fn!r}")
+    x, f_g, calls = _prep(objective_func, initial_position, dtype)
+    n = x.shape[0]
+    identity = jnp.eye(n, dtype=x.dtype)
+    H = identity
+    if initial_inverse_hessian_estimate is not None:
+        H0 = initial_inverse_hessian_estimate
+        H = jnp.asarray(H0._value if isinstance(H0, Tensor)
+                        else np.asarray(H0), x.dtype)
+        if not bool(jnp.allclose(H, H.T, atol=1e-6)):
+            raise ValueError(
+                "initial_inverse_hessian_estimate must be symmetric")
+    f, g = f_g(x)
+    is_converge = False
+    for _ in range(int(max_iters)):
+        gnorm = float(jnp.max(jnp.abs(g)))
+        if gnorm < tolerance_grad:
+            is_converge = True
+            break
+        p = -(H @ g)
+        d0 = float(jnp.dot(g, p))
+        if d0 >= 0:  # H lost positive-definiteness: restart on identity
+            H = identity
+            p = -g
+            d0 = float(jnp.dot(g, p))
+        alpha, f_new, g_new, _ok = _strong_wolfe(
+            f_g, x, p, float(f), d0, initial_step_length,
+            int(max_line_search_iters))
+        s = alpha * p
+        if float(jnp.max(jnp.abs(s))) < tolerance_change:
+            is_converge = True
+            x, f, g = x + s, f_new, g_new
+            break
+        x_new = x + s
+        y = g_new - g
+        sy = float(jnp.dot(s, y))
+        if sy > 1e-10:  # curvature ok -> rank-2 BFGS update (N&W 6.17)
+            rho = 1.0 / sy
+            V = identity - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        x, f, g = x_new, f_new, g_new
+    return _pack(is_converge, calls[0], x, f, g, H)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8,
+                   tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only strong_wolfe line search is supported, got "
+            f"{line_search_fn!r}")
+    x, f_g, calls = _prep(objective_func, initial_position, dtype)
+    f, g = f_g(x)
+    s_hist, y_hist, rho_hist = [], [], []
+    gamma = 1.0
+    is_converge = False
+    for _ in range(int(max_iters)):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            is_converge = True
+            break
+        # two-loop recursion (N&W Alg. 7.4) over the last m pairs
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                             reversed(rho_hist)):
+            a = rho * float(jnp.dot(s, q))
+            alphas.append(a)
+            q = q - a * y
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                  reversed(alphas)):
+            b = rho * float(jnp.dot(y, r))
+            r = r + (a - b) * s
+        p = -r
+        d0 = float(jnp.dot(g, p))
+        if d0 >= 0:
+            s_hist, y_hist, rho_hist = [], [], []
+            p, d0 = -g, -float(jnp.dot(g, g))
+        alpha, f_new, g_new, _ok = _strong_wolfe(
+            f_g, x, p, float(f), d0, initial_step_length,
+            int(max_line_search_iters))
+        s = alpha * p
+        if float(jnp.max(jnp.abs(s))) < tolerance_change:
+            is_converge = True
+            x, f, g = x + s, f_new, g_new
+            break
+        y = g_new - g
+        sy = float(jnp.dot(s, y))
+        if sy > 1e-10:
+            s_hist.append(s)
+            y_hist.append(y)
+            rho_hist.append(1.0 / sy)
+            if len(s_hist) > int(history_size):
+                s_hist.pop(0)
+                y_hist.pop(0)
+                rho_hist.pop(0)
+            gamma = sy / float(jnp.dot(y, y))
+        x, f, g = x + s, f_new, g_new
+    return _pack(is_converge, calls[0], x, f, g)

@@ -7,6 +7,7 @@ artifact; AnalysisPredictor's 40-pass pipeline collapses into XLA compilation
 """
 from __future__ import annotations
 
+import enum
 import os
 from typing import Dict, List, Optional
 
@@ -15,13 +16,70 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Config", "create_predictor", "DistConfig", "DistModel",
-           "Predictor", "PredictorPool", "get_version"]
+           "Predictor", "PredictorPool", "get_version", "DataType",
+           "PlaceType", "PrecisionType", "Tensor", "get_trt_compile_version",
+           "get_trt_runtime_version", "get_num_bytes_of_data_type"]
 
 
 def get_version():
     import paddle_tpu
 
     return paddle_tpu.__version__
+
+
+class DataType(enum.Enum):
+    """paddle_infer.DataType (reference: paddle_inference_api.h PaddleDType);
+    FLOAT16/BFLOAT16 added — TPU serving is natively bf16."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+
+
+class PlaceType(enum.Enum):
+    """paddle_infer.PlaceType (reference: paddle_tensor.h).  GPU enums kept
+    for API parity; on this backend everything placed on an accelerator is
+    the TPU via PJRT."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    TPU = 4
+
+
+class PrecisionType(enum.Enum):
+    """paddle_infer.PrecisionType (reference: paddle_analysis_config.h)."""
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2, DataType.BOOL: 1}
+
+
+def get_num_bytes_of_data_type(dtype: "DataType") -> int:
+    """reference: paddle/fluid/inference/api/paddle_tensor.h
+    paddle_infer::GetNumBytesOfDataType."""
+    return _DTYPE_BYTES[DataType(dtype)]
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU: the compile-time engine is XLA.  (0, 0, 0)
+    mirrors the reference's return when built without TRT
+    (paddle/fluid/inference/api/analysis_predictor.cc GetTrtCompileVersion)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
 
 
 class Config:
@@ -101,6 +159,22 @@ class _IOHandle:
     @property
     def shape(self):
         return list(self._array.shape) if self._array is not None else None
+
+    def type(self):
+        if self._array is None:
+            return DataType.FLOAT32
+        name = str(self._array.dtype)
+        return {"float32": DataType.FLOAT32, "int64": DataType.INT64,
+                "int32": DataType.INT32, "uint8": DataType.UINT8,
+                "int8": DataType.INT8, "float16": DataType.FLOAT16,
+                "bfloat16": DataType.BFLOAT16,
+                "bool": DataType.BOOL}.get(name, DataType.FLOAT32)
+
+
+# public name: paddle.inference.Tensor is the reference's ZeroCopyTensor
+# handle type (paddle/fluid/inference/api/paddle_tensor.h) — users touch it
+# via predictor.get_input_handle(); exported so isinstance checks port over.
+Tensor = _IOHandle
 
 
 def _load_exported(config: Config):

@@ -151,6 +151,26 @@ class Orthogonal(Initializer):
             key, shape, jnp.float32)).astype(np_dtype)
 
 
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for transposed-conv upsampling
+    (reference: python/paddle/fluid/initializer.py:830 BilinearInitializer
+    — every output channel gets the same (K, K) interpolation stencil so
+    a Conv2DTranspose with stride=factor upsamples by `factor`)."""
+
+    def _generate(self, shape, np_dtype):
+        if len(shape) < 2:
+            raise ValueError(
+                "Bilinear initializer requires a >=2-D convolution weight")
+        k = shape[-1]
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        idx = np.arange(int(np.prod(shape)), dtype=np.float64)
+        x = idx % shape[-1]
+        y = (idx // shape[-1]) % shape[-2]
+        w = (1 - np.abs(x / f - c)) * (1 - np.abs(y / f - c))
+        return jnp.asarray(w.reshape(shape), np_dtype)
+
+
 class Dirac(Initializer):
     def __init__(self, groups=1, name=None):
         self.groups = groups

@@ -475,3 +475,5 @@ class nn_namespace:
 
 
 nn = nn_namespace
+
+from . import functional  # noqa: E402,F401  (needs the classes above)

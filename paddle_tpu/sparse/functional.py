@@ -1,0 +1,67 @@
+"""paddle.sparse.functional (reference:
+python/paddle/sparse/functional/__init__.py — relu / conv3d / subm_conv3d
+/ max_pool3d).  Thin functional forms over the same sparse-native kernels
+the ``paddle.sparse.nn`` layers use: the layers own parameters, these
+take weight/bias as arguments."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, to_tensor
+from . import Conv3D, MaxPool3D, SubmConv3D
+from . import relu  # noqa: F401  (re-export; already functional)
+
+__all__ = ["relu", "conv3d", "subm_conv3d", "max_pool3d"]
+
+
+def _as_param(v):
+    return v if isinstance(v, Tensor) or v is None else to_tensor(v)
+
+
+def _functional_conv(cls, x, weight, bias, stride, padding, dilation,
+                     groups, data_format):
+    if data_format != "NDHWC":
+        raise ValueError(
+            f"sparse conv3d supports NDHWC only, got {data_format!r} "
+            "(reference kernel layout, "
+            "phi/kernels/sparse/gpu/convolution_kernel.cu)")
+    weight = _as_param(weight)
+    from ..nn.layer.conv import _ConvNd
+
+    _t3 = _ConvNd._tuplize
+    # bypass cls.__init__: it would CREATE parameters; the functional form
+    # runs the same forward over caller-owned weight/bias
+    layer = cls.__new__(cls)
+    from ..nn.layer.layers import Layer as _Layer
+
+    _Layer.__init__(layer)
+    layer.kernel_size = tuple(int(k) for k in weight.shape[:3])
+    layer.stride = _t3(stride, 3)
+    layer.padding = _t3(padding, 3)
+    layer.dilation = _t3(dilation, 3)
+    layer.groups = groups
+    layer.weight = weight
+    layer.bias = _as_param(bias)
+    return layer.forward(x)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC"):
+    """Sparse conv3d; weight layout DHWIO (reference
+    python/paddle/sparse/functional/conv.py conv3d)."""
+    return _functional_conv(Conv3D, x, weight, bias, stride, padding,
+                            dilation, groups, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC"):
+    """Submanifold sparse conv3d: output index set == input index set."""
+    return _functional_conv(SubmConv3D, x, weight, bias, stride, padding,
+                            dilation, groups, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC"):
+    """Sparse max pool over active sites (reference
+    python/paddle/sparse/functional/pooling.py max_pool3d)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    return MaxPool3D(kernel_size, stride, padding)(x)

@@ -31,6 +31,7 @@ from .graph import (  # noqa: F401
     save_inference_model,
     scope_guard,
 )
+from . import sparsity  # noqa: F401
 from .passes import (  # noqa: F401
     apply_build_strategy, apply_pass, get_pass, list_passes, register_pass,
 )

@@ -167,3 +167,957 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                              lambda: build(k + 1))
 
     return build(0)
+
+
+# ---------------------------------------------------------------------------
+# conv / norm family (reference: python/paddle/static/nn/__init__.py
+# re-exporting fluid.layers.*; each creates params then calls the same
+# functional op the dygraph layer uses)
+# ---------------------------------------------------------------------------
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """reference: fluid/layers/nn.py conv2d_transpose."""
+    if filter_size is None:
+        raise ValueError(
+            "filter_size must be given (output_size-driven kernel "
+            "inference is not supported; pass the kernel explicitly)")
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    # transpose-conv weight layout: [in_channels, out_channels/groups, *k]
+    w = _param([in_ch, num_filters // groups, *filter_size],
+               input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    """reference: fluid/layers/nn.py conv3d."""
+    if isinstance(filter_size, int):
+        filter_size = (filter_size,) * 3
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    w = _param([num_filters, in_ch // groups, *filter_size],
+               input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """reference: fluid/layers/nn.py conv3d_transpose."""
+    if filter_size is None:
+        raise ValueError("filter_size must be given")
+    if isinstance(filter_size, int):
+        filter_size = (filter_size,) * 3
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    w = _param([in_ch, num_filters // groups, *filter_size],
+               input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    """reference: fluid/layers/nn.py group_norm."""
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    w = _param([ch], input._value.dtype, param_attr,
+               default=I.Constant(1.0)) if param_attr is not False else None
+    b = _param([ch], input._value.dtype, bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: fluid/layers/nn.py instance_norm."""
+    ch = int(input.shape[1])
+    w = _param([ch], input._value.dtype, param_attr,
+               default=I.Constant(1.0)) if param_attr is not False else None
+    b = _param([ch], input._value.dtype, bias_attr, is_bias=True) \
+        if bias_attr is not False else None
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: fluid/layers/nn.py prelu — mode selects the alpha shape:
+    'all' one scalar, 'channel' per-channel, 'element' per-element."""
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(x.shape[1 if data_format == "NCHW" else -1])]
+    elif mode == "element":
+        shape = [1] + [int(d) for d in x.shape[1:]]
+    else:
+        raise ValueError("mode must be one of 'all', 'channel', 'element'")
+    alpha = _param(shape, x._value.dtype, param_attr,
+                   default=I.Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: fluid/layers/nn.py spectral_norm — creates the u/v power
+    iteration vectors as non-trainable params."""
+    import numpy as np
+
+    h = int(weight.shape[dim])
+    w_dim = int(np.prod([int(d) for i, d in enumerate(weight.shape)
+                         if i != dim]))
+    u = _param([h], weight._value.dtype, None, default=I.Normal(0.0, 1.0))
+    v = _param([w_dim], weight._value.dtype, None, default=I.Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    return F.spectral_norm(weight, u, v, dim=dim, power_iters=power_iters,
+                           eps=eps)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  modulated=True, name=None):
+    """reference: fluid/layers/nn.py deformable_conv (static.nn
+    deform_conv2d) — delegates to the vision op with created params."""
+    from ..vision.ops import deform_conv2d as _dc
+
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    in_ch = int(input.shape[1])
+    w = _param([num_filters, in_ch // groups, *filter_size],
+               input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    if not modulated:
+        mask = None
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    """reference: fluid/layers/nn.py bilinear_tensor_product —
+    out_k = x W_k y^T + b."""
+    d1, d2 = int(x.shape[-1]), int(y.shape[-1])
+    w = _param([size, d1, d2], x._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([size], x._value.dtype, bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: fluid/layers/nn.py data_norm (the pslib CTR
+    normalization): running batch_size/batch_sum/batch_square_sum stats,
+    out = (x - batch_sum/batch_size) * sqrt(batch_size/batch_square_sum).
+    Stats start at the reference defaults (1e4 virtual samples) and
+    ACCUMULATE each training forward (the reference does this in the
+    data_norm grad kernel; here it is a writeback op on the program /
+    an eager in-place update when grads are recording)."""
+    from ..core.tensor import Tensor
+
+    ch = int(input.shape[-1])
+    dt = input._value.dtype
+    bsz = _param([ch], dt, None, default=I.Constant(1e4))
+    bsum = _param([ch], dt, None, default=I.Constant(0.0))
+    bsq = _param([ch], dt, None, default=I.Constant(1e4))
+    for p in (bsz, bsum, bsq):
+        p.stop_gradient = True
+
+    def _fn(v, size, s, sq):
+        mean = s / size
+        scale = jnp.sqrt(size / jnp.maximum(sq, epsilon))
+        return (v - mean) * scale
+
+    from ..core import dispatch
+    from ..core.dispatch import apply
+
+    out = apply("data_norm", _fn, input, bsz, bsum, bsq)
+
+    def _accum(v, size, s, sq):
+        n = float(v.shape[0])
+        return (size + n, s + jnp.sum(v, 0), sq + jnp.sum(v * v, 0))
+
+    if isinstance(input, G.Variable):
+        G.record_writeback_op("data_norm_stats", _accum,
+                              [input, bsz, bsum, bsq], [bsz, bsum, bsq])
+    elif dispatch.is_grad_enabled():
+        with dispatch.no_grad_ctx():
+            nsz, nsum, nsq = _accum(input._value, bsz._value, bsum._value,
+                                    bsq._value)
+            bsz._value, bsum._value, bsq._value = nsz, nsum, nsq
+    if enable_scale_and_shift:
+        scale_w = _param([ch], dt, param_attr, default=I.Constant(1.0))
+        bias = _param([ch], dt, None, is_bias=True)
+        out = out * scale_w + bias
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: fluid/layers/nn.py row_conv — lookahead convolution:
+    out[t] = sum_{i=0..k} x[t+i] * w[i], per channel (DeepSpeech2's
+    streaming-friendly context layer)."""
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = _param([k + 1, d], input._value.dtype, param_attr)
+
+    def _fn(v, wt):
+        # v: [B, T, D]; shift-and-accumulate stays one fused XLA loop
+        out = v * wt[0]
+        for i in range(1, k + 1):
+            shifted = jnp.concatenate(
+                [v[:, i:, :], jnp.zeros_like(v[:, :i, :])], axis=1)
+            out = out + shifted * wt[i]
+        return out
+
+    from ..core.dispatch import apply
+
+    out = apply("row_conv", _fn, input, w)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference: fluid/contrib/layers/sparse_embedding (PS giant-table
+    embedding).  TPU-native: the table is an ordinary (GSPMD-shardable)
+    parameter — 'sparse' admission/eviction policy objects (entry=...)
+    are recorded on the parameter for checkpoint tooling but rows are
+    dense in HBM; shard the vocab axis for >HBM tables."""
+    w = _param(list(size), to_np(dtype), param_attr)
+    if entry is not None:
+        w._entry_attr = getattr(entry, "_to_attr", lambda: str(entry))()
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=True)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference: fluid/layers/nn.py nce — noise-contrastive estimation
+    loss with a uniform/custom negative sampler.  Returns per-example
+    loss [B, 1]."""
+    import numpy as np
+
+    from ..core.dispatch import apply
+    from ..ops import random as rnd
+
+    d = int(input.shape[-1])
+    w = _param([num_total_classes, d], input._value.dtype, param_attr)
+    b = _param([num_total_classes], input._value.dtype, bias_attr,
+               is_bias=True) if bias_attr is not False else None
+    if sampler not in ("uniform", "log_uniform", "custom_dist"):
+        raise ValueError(f"unknown sampler {sampler!r}")
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("custom_dist required for sampler='custom_dist'")
+    key = rnd.next_key()
+    s = int(num_neg_samples)
+
+    import jax
+
+    if sampler == "uniform":
+        neg = jax.random.randint(key, (s,), 0, num_total_classes)
+        logq = jnp.full((s,), -jnp.log(float(num_total_classes)))
+        pos_logq = -jnp.log(float(num_total_classes))
+    elif sampler == "log_uniform":
+        # P(k) ∝ log((k+2)/(k+1)) — the reference's LogUniformSampler
+        ks = np.arange(num_total_classes)
+        p = np.log((ks + 2) / (ks + 1))
+        p /= p.sum()
+        neg = jax.random.choice(key, num_total_classes, (s,), p=jnp.asarray(p))
+        logq = jnp.log(jnp.asarray(p)[neg])
+        pos_logq = None  # gathered per-label below
+        logp_table = jnp.asarray(np.log(p))
+    else:
+        p = np.asarray(custom_dist, np.float64)
+        p /= p.sum()
+        neg = jax.random.choice(key, num_total_classes, (s,), p=jnp.asarray(p))
+        logq = jnp.log(jnp.asarray(p)[neg])
+        pos_logq = None
+        logp_table = jnp.asarray(np.log(p))
+
+    def _fn(v, lab, wt, *maybe_b):
+        bias = maybe_b[0] if maybe_b else None
+        lab1 = lab.reshape(-1)
+        pos_w = wt[lab1]                       # [B, D]
+        pos_logit = jnp.sum(v * pos_w, -1)
+        neg_logit = v @ wt[neg].T              # [B, S]
+        if bias is not None:
+            pos_logit = pos_logit + bias[lab1]
+            neg_logit = neg_logit + bias[neg]
+        plq = pos_logq if pos_logq is not None else logp_table[lab1]
+        # NCE logistic objective (Gutmann & Hyvarinen): subtract log(S*q)
+        pos_score = pos_logit - (jnp.log(float(s)) + plq)
+        neg_score = neg_logit - (jnp.log(float(s)) + logq)
+        loss = (jax.nn.softplus(-pos_score)
+                + jnp.sum(jax.nn.softplus(neg_score), -1))
+        return loss.reshape(-1, 1)
+
+    args = [input, label, w] + ([b] if b is not None else [])
+    return apply("nce", _fn, *args)
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """reference: fluid/layers/nn.py crf_decoding — Viterbi over emissions
+    with the linear_chain_crf transition layout ([num_tags+2, num_tags]:
+    row 0 start scores, row 1 stop scores, rows 2.. the transition
+    matrix).  Returns the argmax tag path [B, T] (padded region zeros);
+    with `label` given, returns the per-position correctness mask like
+    the reference."""
+    import jax
+
+    from ..core.dispatch import apply
+
+    num_tags = int(input.shape[-1])
+    trans = _param([num_tags + 2, num_tags], input._value.dtype, param_attr)
+
+    def _fn(em, w, *rest):
+        start, stop, t = w[0], w[1], w[2:]
+        B, T, C = em.shape
+        lens = rest[0].reshape(B).astype(jnp.int32) if length is not None \
+            else jnp.full((B,), T, jnp.int32)
+        lab = rest[-1] if label is not None else None
+
+        def step(carry, e_t):
+            alpha = carry
+            sc = alpha[:, :, None] + t[None] + e_t[:, None, :]
+            new = jnp.max(sc, 1)
+            return new, (new, jnp.argmax(sc, 1))
+
+        alpha0 = start[None] + em[:, 0]
+        _, (alphas, back) = jax.lax.scan(
+            step, alpha0, jnp.moveaxis(em[:, 1:], 1, 0))
+        # alphas[t] is the score after consuming emission t+1
+        all_alpha = jnp.concatenate([alpha0[None], alphas], 0)  # [T, B, C]
+        final = jnp.take_along_axis(
+            all_alpha, (lens - 1)[None, :, None], 0)[0] + stop[None]
+        lastt = jnp.argmax(final, -1)
+
+        def walk(cur, xs):
+            t_idx, bp_t = xs
+            prev = jnp.take_along_axis(bp_t, cur[:, None], 1)[:, 0]
+            nxt = jnp.where(t_idx == lens - 1, lastt,
+                            jnp.where(t_idx < lens - 1, prev, 0))
+            return nxt, nxt
+
+        ts = jnp.arange(T - 2, -1, -1)
+        _, path_rev = jax.lax.scan(walk, lastt, (ts, back[::-1]))
+        tail = jnp.where(lens - 1 == T - 1, lastt, 0)
+        path = jnp.concatenate([path_rev[::-1].T, tail[:, None]], 1)
+        path = jnp.where(jnp.arange(T)[None] < lens[:, None], path, 0)
+        if lab is not None:  # label -> correctness mask, ref semantics
+            return (path == lab.reshape(B, T)).astype(em.dtype)
+        return path
+
+    extra = [x for x in (length, label) if x is not None]
+    return apply("crf_decoding", _fn, input, trans, *extra)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: python/paddle/fluid/layers/sequence_lod.py)
+#
+# LoD redesign: the reference threads ragged sequences through ops as
+# LoDTensors (flat rows + offset table — a dynamic shape XLA cannot
+# compile).  TPU-native, a ragged batch is the pair the reference's OWN
+# sequence_pad/sequence_unpad convert to and from: padded [B, T, ...] plus
+# lengths [B].  sequence_pad attaches the lengths to the padded Tensor
+# (attr `_seq_lengths`); every sequence_* op reads them (default: full
+# length) and propagates them, so reference pipelines compose unchanged
+# between pad/unpad endpoints.  Static shapes throughout — the padded
+# time axis is the compile-time bound.
+# ---------------------------------------------------------------------------
+
+def _seq_lens(x, default_T=None):
+    lens = getattr(x, "_seq_lengths", None)
+    if lens is not None:
+        return lens._value if hasattr(lens, "_value") else jnp.asarray(lens)
+    T = default_T if default_T is not None else int(x.shape[1])
+    return jnp.full((int(x.shape[0]),), T, jnp.int32)
+
+
+def _with_lens(out, lens):
+    from ..core.tensor import Tensor
+
+    if not isinstance(lens, Tensor):
+        lens = Tensor(jnp.asarray(lens, jnp.int32), stop_gradient=True)
+    out._seq_lengths = lens
+    return out
+
+
+def _time_mask(x_val, lens, upto=None):
+    T = upto if upto is not None else x_val.shape[1]
+    return jnp.arange(T)[None, :] < lens[:, None]
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """reference: sequence_lod.py sequence_pad — ragged in, (padded,
+    lengths) out.  Accepts a list of per-sequence Tensors/arrays (the
+    ragged form) or an already-padded Tensor (passthrough + lengths)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    pv = float(pad_value if not hasattr(pad_value, "numpy")
+               else pad_value.numpy())
+    if isinstance(x, (list, tuple)):
+        rows = [r._value if isinstance(r, Tensor) else jnp.asarray(r)
+                for r in x]
+        T = maxlen or max(int(r.shape[0]) for r in rows)
+        # truncation must also truncate the REPORTED length — every
+        # sequence op masks with it, so a stale length corrupts pooling,
+        # softmax, conv, ... downstream
+        lens = [min(int(r.shape[0]), T) for r in rows]
+        feat = rows[0].shape[1:]
+        out = jnp.full((len(rows), T) + tuple(feat), pv, rows[0].dtype)
+        for i, r in enumerate(rows):
+            out = out.at[i, :lens[i]].set(r[:lens[i]])
+        padded = Tensor(out)
+        lens_t = Tensor(jnp.asarray(lens, jnp.int32), stop_gradient=True)
+        _with_lens(padded, lens_t)
+        return padded, lens_t
+    lens = _seq_lens(x)
+    out = Tensor(jnp.where(_time_mask(x._value, lens)[
+        (...,) + (None,) * (x._value.ndim - 2)], x._value, pv)) \
+        if x._value.ndim > 2 else Tensor(
+            jnp.where(_time_mask(x._value, lens), x._value, pv))
+    lens_t = Tensor(lens, stop_gradient=True)
+    _with_lens(out, lens_t)
+    return out, lens_t
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: sequence_lod.py sequence_unpad — back to ragged: a list
+    of [len_i, ...] Tensors."""
+    from ..core.tensor import Tensor
+
+    lens = length._value if hasattr(length, "_value") else \
+        jnp.asarray(length)
+    return [Tensor(x._value[i, :int(lens[i])])
+            for i in range(int(x.shape[0]))]
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    """softmax over each sequence's valid steps (reference
+    sequence_softmax); padded positions get zero probability."""
+    from ..core.dispatch import apply
+
+    lens = _seq_lens(input)
+
+    def _fn(v):
+        mask = _time_mask(v, lens)
+        if v.ndim > 2:
+            mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        shifted = jnp.where(mask, v, -jnp.inf)
+        e = jnp.exp(shifted - jnp.max(shifted, 1, keepdims=True))
+        e = jnp.where(mask, e, 0.0)
+        return e / jnp.maximum(jnp.sum(e, 1, keepdims=True), 1e-12)
+
+    out = apply("sequence_softmax", _fn, input)
+    return _with_lens(out, lens)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    """reference: sequence_lod.py sequence_pool — masked reduction over
+    the time axis; empty sequences emit pad_value."""
+    from ..core.dispatch import apply
+
+    lens = _seq_lens(input)
+    kind = pool_type.lower()
+
+    def _fn(v):
+        mask = _time_mask(v, lens)
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        n = jnp.maximum(lens, 1).reshape((-1,) + (1,) * (v.ndim - 2))
+        if kind == "sum":
+            out = jnp.sum(jnp.where(m, v, 0), 1)
+        elif kind == "average":
+            out = jnp.sum(jnp.where(m, v, 0), 1) / n
+        elif kind == "sqrt":
+            out = jnp.sum(jnp.where(m, v, 0), 1) / jnp.sqrt(
+                n.astype(v.dtype))
+        elif kind == "max":
+            out = jnp.max(jnp.where(m, v, -jnp.inf), 1)
+        elif kind == "first":
+            out = v[:, 0]
+        elif kind == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), 1)[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        empty = (lens == 0).reshape((-1,) + (1,) * (v.ndim - 2))
+        return jnp.where(empty, pad_value, out)
+
+    return apply("sequence_pool", _fn, input)
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """reference: sequence_lod.py sequence_conv — context-window linear:
+    each step's features are the concat of `filter_size` neighbor rows
+    (window starting at padding_start, default centered), then a dense
+    projection.  Zero rows outside [0, len)."""
+    from ..core.dispatch import apply
+
+    if filter_stride != 1:
+        raise ValueError("sequence_conv supports filter_stride=1 "
+                         "(reference kernel limitation as well)")
+    d = int(input.shape[-1])
+    k = int(filter_size)
+    start = padding_start if padding_start is not None else -((k - 1) // 2)
+    w = _param([k * d, num_filters], input._value.dtype, param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _param([num_filters], input._value.dtype, bias_attr,
+                   is_bias=True)
+    lens = _seq_lens(input)
+
+    def _fn(v, wt, *maybe_b):
+        B, T, D = v.shape
+        mask = _time_mask(v, lens)[..., None]
+        vm = jnp.where(mask, v, 0)
+        cols = []
+        for i in range(k):
+            off = start + i
+            if off < 0:
+                sh = jnp.concatenate(
+                    [jnp.zeros((B, min(-off, T), D), v.dtype),
+                     vm[:, :max(T + off, 0)]], 1)
+            elif off > 0:
+                sh = jnp.concatenate(
+                    [vm[:, min(off, T):],
+                     jnp.zeros((B, min(off, T), D), v.dtype)], 1)
+            else:
+                sh = vm
+            cols.append(sh)
+        ctx = jnp.concatenate(cols, -1)  # [B, T, k*D]
+        out = ctx @ wt
+        if maybe_b:
+            out = out + maybe_b[0]
+        return jnp.where(mask, out, 0)
+
+    args = [input, w] + ([b] if b is not None else [])
+    out = apply("sequence_conv", _fn, *args)
+    if act:
+        out = getattr(F, act)(out)
+    return _with_lens(out, lens)
+
+
+def sequence_concat(input, name=None):
+    """reference: sequence_lod.py sequence_concat — per-ROW concatenation
+    of the valid steps of each input (time-axis splice, not a plain
+    concat: row i of the result is seq_i(x1) ++ seq_i(x2) ++ ...)."""
+    from ..core.dispatch import apply
+
+    xs = list(input)
+    lens_list = [_seq_lens(x) for x in xs]
+    total = sum(int(x.shape[1]) for x in xs)
+    out_lens = sum(lens_list[1:], lens_list[0])
+
+    def _fn(*vals):
+        B = vals[0].shape[0]
+        feat = vals[0].shape[2:]
+        out = jnp.zeros((B, total) + tuple(feat), vals[0].dtype)
+        offs = jnp.zeros((B,), jnp.int32)
+        for v, ln in zip(vals, lens_list):
+            T = v.shape[1]
+            tpos = jnp.arange(T)[None, :]
+            dest = offs[:, None] + tpos             # [B, T]
+            valid = tpos < ln[:, None]
+            dest = jnp.where(valid, dest, total)    # OOB rows drop
+            bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dest.shape)
+            out = out.at[bidx.reshape(-1), dest.reshape(-1)].set(
+                v.reshape((-1,) + tuple(feat)), mode="drop")
+            offs = offs + ln
+        return out
+
+    out = apply("sequence_concat", _fn, *xs)
+    return _with_lens(out, out_lens)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference: sequence_lod.py sequence_slice — per-sequence
+    [offset, offset+length) window."""
+    from ..core.dispatch import apply
+
+    off = (offset._value if hasattr(offset, "_value")
+           else jnp.asarray(offset)).reshape(-1)
+    ln = (length._value if hasattr(length, "_value")
+          else jnp.asarray(length)).reshape(-1)
+    T_out = int(jnp.max(ln))
+
+    def _fn(v):
+        tpos = jnp.arange(T_out)[None, :]
+        src = off[:, None] + tpos
+        src = jnp.clip(src, 0, v.shape[1] - 1)
+        idx = src.reshape((v.shape[0], T_out) + (1,) * (v.ndim - 2))
+        out = jnp.take_along_axis(v, idx, 1)
+        mask = (tpos < ln[:, None]).reshape(
+            (v.shape[0], T_out) + (1,) * (v.ndim - 2))
+        return jnp.where(mask, out, 0)
+
+    out = apply("sequence_slice", _fn, input)
+    return _with_lens(out, ln.astype(jnp.int32))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """reference: sequence_lod.py sequence_expand — repeat each sequence
+    of x per y's lod.  Padded-rep: supported for the dominant case where
+    x holds ONE step per sequence (attention context / beam state); each
+    row broadcasts across y's valid steps."""
+    from ..core.dispatch import apply
+
+    y_lens = _seq_lens(y)
+    Ty = int(y.shape[1])
+    xv_ndim = len(x.shape)
+    if xv_ndim >= 3 and int(x.shape[1]) != 1:
+        raise NotImplementedError(
+            "sequence_expand on multi-step x requires ragged LoD "
+            "semantics; broadcast a one-step x or use sequence_expand_as")
+
+    def _fn(xv):
+        v = xv if xv.ndim >= 3 else xv[:, None]
+        out = jnp.broadcast_to(v, (v.shape[0], Ty) + v.shape[2:])
+        mask = _time_mask(out, y_lens).reshape(
+            (v.shape[0], Ty) + (1,) * (out.ndim - 2))
+        return jnp.where(mask, out, 0)
+
+    out = apply("sequence_expand", _fn, x)
+    return _with_lens(out, y_lens)
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference: sequence_lod.py sequence_expand_as (ref_level 0)."""
+    return sequence_expand(x, y, ref_level=0, name=name)
+
+
+def sequence_reshape(input, new_dim):
+    """reference: sequence_lod.py sequence_reshape — refold each
+    sequence's (len_i * D) values into rows of new_dim."""
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    d = int(input.shape[-1])
+    lens = _seq_lens(input)
+    T = int(input.shape[1])
+    if (T * d) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: T*D={T * d} not divisible by {new_dim}")
+    T_out = T * d // new_dim
+    new_lens = (lens * d) // new_dim
+
+    def _fn(v):
+        B = v.shape[0]
+        flat = jnp.where(_time_mask(v, lens)[..., None], v, 0)
+        return flat.reshape(B, T_out, new_dim)
+
+    out = apply("sequence_reshape", _fn, input)
+    return _with_lens(out, new_lens)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference: sequence_lod.py sequence_scatter — add `updates` into
+    `input` at each sequence's `index` time-positions."""
+    from ..core.dispatch import apply
+
+    idx_lens = _seq_lens(index)
+
+    def _fn(v, idx, upd):
+        B = v.shape[0]
+        ii = idx.reshape(B, -1).astype(jnp.int32)
+        uu = upd.reshape(B, ii.shape[1])
+        valid = jnp.arange(ii.shape[1])[None, :] < idx_lens[:, None]
+        ii = jnp.where(valid, ii, v.shape[1])  # OOB -> dropped
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], ii.shape)
+        return v.at[bidx.reshape(-1), ii.reshape(-1)].add(
+            uu.reshape(-1), mode="drop")
+
+    return apply("sequence_scatter", _fn, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """reference: sequence_lod.py sequence_enumerate — sliding windows of
+    ids: out[b, t] = [x[t], x[t+1], ..., x[t+w-1]], pad past the end."""
+    from ..core.dispatch import apply
+
+    lens = _seq_lens(input)
+
+    def _fn(v):
+        B, T = v.shape[:2]
+        tpos = jnp.arange(T)[None, :, None]
+        offs = jnp.arange(win_size)[None, None, :]
+        src = tpos + offs                            # [1, T, W]
+        gather = jnp.take_along_axis(
+            v[:, :, None] if v.ndim == 2 else v,
+            jnp.broadcast_to(jnp.minimum(src, T - 1), (B, T, win_size)), 1)
+        valid = src < lens[:, None, None]
+        return jnp.where(valid, gather, pad_value)
+
+    out = apply("sequence_enumerate", _fn, input)
+    return _with_lens(out, lens)
+
+
+def sequence_reverse(x, name=None):
+    """reference: sequence_lod.py sequence_reverse — reverse each valid
+    region in place, keep padding at the tail."""
+    from ..core.dispatch import apply
+
+    lens = _seq_lens(x)
+
+    def _fn(v):
+        B, T = v.shape[0], v.shape[1]
+        tpos = jnp.arange(T)[None, :]
+        src = jnp.where(tpos < lens[:, None], lens[:, None] - 1 - tpos, tpos)
+        idx = src.reshape((B, T) + (1,) * (v.ndim - 2))
+        return jnp.take_along_axis(v, idx, 1)
+
+    out = apply("sequence_reverse", _fn, x)
+    return _with_lens(out, lens)
+
+
+# ---------------------------------------------------------------------------
+# py_func / multi_box_head
+# ---------------------------------------------------------------------------
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """reference: fluid/layers/nn.py py_func — run a host Python function
+    as an op.  Eagerly the callback runs on numpy views directly; under a
+    jit trace it lowers to jax.pure_callback with `out`'s shape/dtype as
+    the result signature (host round trip — same data movement as the
+    reference's CPU-pinned py_func op).  backward_func, when given,
+    becomes the custom VJP and receives the REFERENCE CONTRACT
+    (fluid/layers/nn.py py_func_demo): positional args are
+    (inputs..., outputs..., output_grads...), minus any variable listed
+    in skip_vars_in_backward_input; it returns one gradient per input."""
+    import numpy as np
+
+    import jax
+
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    xs = [x] if isinstance(x, Tensor) else list(x)
+    outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+    single = not isinstance(out, (list, tuple))
+    shape_dtypes = [jax.ShapeDtypeStruct(
+        tuple(int(d) for d in o.shape), o._value.dtype) for o in outs]
+
+    def _host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r._value if isinstance(r, Tensor) else r,
+                           sd.dtype).reshape(sd.shape)
+                for r, sd in zip(res, shape_dtypes)]
+
+    def _fn(*vals):
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            res = jax.pure_callback(
+                lambda *a: tuple(_host(*a)), tuple(shape_dtypes), *vals)
+        else:
+            res = tuple(jnp.asarray(r) for r in _host(*vals))
+        return res[0] if single else tuple(res)
+
+    if backward_func is not None:
+        n_in = len(xs)
+        # the reference identifies skipped vars by Variable identity/name;
+        # here positions: inputs occupy [0, n_in), outputs [n_in, n_in+n_out)
+        skip_ids = {id(v) for v in (skip_vars_in_backward_input or [])}
+        skip_pos = set()
+        for pos, v in enumerate(xs + outs):
+            if id(v) in skip_ids:
+                skip_pos.add(pos)
+
+        @jax.custom_vjp
+        def _op(*vals):
+            return _fn(*vals)
+
+        def _fwd(*vals):
+            outs_v = _fn(*vals)
+            flat_outs = (outs_v,) if single else tuple(outs_v)
+            return outs_v, (vals, flat_outs)
+
+        def _bwd(saved, ct):
+            ins_v, outs_v = saved
+            cts = (ct,) if single else tuple(ct)
+            # reference arg order: inputs, outputs, output grads — with
+            # skip_vars_in_backward_input removed from the first two groups
+            bargs = [v for pos, v in enumerate(ins_v + outs_v)
+                     if pos not in skip_pos] + list(cts)
+
+            def _hostb(*a):
+                res = backward_func(*[np.asarray(v) for v in a])
+                res = res if isinstance(res, (list, tuple)) else [res]
+                return [np.asarray(
+                    r._value if isinstance(r, Tensor) else r).reshape(
+                        ins_v[i].shape).astype(ins_v[i].dtype)
+                    for i, r in enumerate(res)]
+
+            in_sds = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in ins_v]
+            grads = jax.pure_callback(
+                lambda *a: tuple(_hostb(*a)), tuple(in_sds), *bargs)
+            return tuple(grads[:n_in])
+
+        _op.defvjp(_fwd, _bwd)
+        result = apply("py_func", _op, *xs)
+    else:
+        result = apply("py_func", _fn, *xs, _differentiable=False)
+    return result
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference: fluid/layers/detection.py multi_box_head — the SSD
+    prediction head: per feature map, a loc conv (priors*4 channels), a
+    conf conv (priors*num_classes), and the prior-box grid.  Returns
+    (mbox_locs [B, P, 4], mbox_confs [B, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    import math
+
+    import numpy as np
+
+    from .. import ops
+    from ..core.tensor import Tensor
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes, max_sizes = [], []
+        step_r = int(math.floor(max_ratio - min_ratio) / (n_maps - 2)) \
+            if n_maps > 2 else 0
+        ratios = list(range(int(min_ratio), int(max_ratio) + 1,
+                            max(step_r, 1)))
+        min_sizes = [base_size * 0.10] + [base_size * r / 100.
+                                          for r in ratios[:n_maps - 1]]
+        max_sizes = [base_size * 0.20] + [base_size * (r + step_r) / 100.
+                                          for r in ratios[:n_maps - 1]]
+    img_h = int(image.shape[2])
+    img_w = int(image.shape[3])
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        maxs = (max_sizes[i] if isinstance(max_sizes[i], (list, tuple))
+                else [max_sizes[i]]) if max_sizes else []
+        ars = aspect_ratios[i] if isinstance(
+            aspect_ratios[i], (list, tuple)) else [aspect_ratios[i]]
+        full_ars = [1.0]
+        for ar in ars:
+            if ar != 1.0:
+                full_ars.append(ar)
+                if flip:
+                    full_ars.append(1.0 / ar)
+        if len(maxs) > len(mins):
+            raise ValueError(
+                f"max_sizes ({len(maxs)}) must pair 1:1 with min_sizes "
+                f"({len(mins)})")
+        num_priors = len(mins) * len(full_ars) + len(maxs)
+
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        sw = steps[i] if steps else (step_w[i] if step_w else img_w / fw)
+        sh = steps[i] if steps else (step_h[i] if step_h else img_h / fh)
+        # prior grid (host numpy: static per-graph constants)
+        cx = (np.arange(fw) + offset) * sw
+        cy = (np.arange(fh) + offset) * sh
+        cxg, cyg = np.meshgrid(cx, cy)
+        pri = []
+        for j, m in enumerate(mins):
+            for ar in full_ars:
+                bw, bh = m * math.sqrt(ar) / 2, m / math.sqrt(ar) / 2
+                pri.append((bw, bh))
+            # max sizes pair 1:1 with min sizes (SSD prior_box contract);
+            # a nested maxs loop would emit len(mins)*len(maxs) boxes and
+            # overflow the num_priors channel budget above
+            if j < len(maxs):
+                s = math.sqrt(m * maxs[j]) / 2
+                pri.append((s, s))
+        grid = np.stack([cxg, cyg], -1).reshape(-1, 1, 2)  # [fh*fw, 1, 2]
+        wh = np.asarray(pri).reshape(1, -1, 2)             # [1, P, 2]
+        mins_xy = (grid - wh) / np.asarray([img_w, img_h])
+        maxs_xy = (grid + wh) / np.asarray([img_w, img_h])
+        box = np.concatenate([mins_xy, maxs_xy], -1).reshape(-1, 4)
+        if clip:
+            box = np.clip(box, 0.0, 1.0)
+        boxes_all.append(box.astype(np.float32))
+        vars_all.append(np.tile(np.asarray(variance, np.float32),
+                                (box.shape[0], 1)))
+
+        loc = conv2d(feat, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        B = int(feat.shape[0])
+        loc = ops.reshape(ops.transpose(loc, [0, 2, 3, 1]), [B, -1, 4])
+        conf = ops.reshape(ops.transpose(conf, [0, 2, 3, 1]),
+                           [B, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+
+    mbox_locs = ops.concat(locs, axis=1)
+    mbox_confs = ops.concat(confs, axis=1)
+    boxes = Tensor(jnp.asarray(np.concatenate(boxes_all, 0)),
+                   stop_gradient=True)
+    variances = Tensor(jnp.asarray(np.concatenate(vars_all, 0)),
+                       stop_gradient=True)
+    return mbox_locs, mbox_confs, boxes, variances

@@ -1,0 +1,94 @@
+"""paddle.static.sparsity (reference:
+python/paddle/static/sparsity/__init__.py re-exporting
+fluid.contrib.sparsity — ASP 2:4 structured pruning for static graphs).
+
+One ASP engine for both modes: the mask math lives in
+``paddle_tpu.incubate.asp`` (compute_mask_2_4 / check_sparsity); this
+module adds the static-graph entry points and the excluded-layer
+registry the reference keeps in its ASPHelper."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..incubate import asp as _asp
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+# reference ASPHelper.__excluded_layers: per-Program (keyed by id; None =
+# the implicit default program) name lists
+_EXCLUDED: Dict[int, List[str]] = {}
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference: fluid/contrib/sparsity/utils.py
+    calculate_density)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def set_excluded_layers(main_program=None, param_names=()):
+    """Mark parameter names ASP must not prune (reference:
+    sparsity/asp.py set_excluded_layers)."""
+    _EXCLUDED.setdefault(id(main_program), [])
+    _EXCLUDED[id(main_program)].extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    if main_program is None:
+        _EXCLUDED.clear()
+    else:
+        _EXCLUDED.pop(id(main_program), None)
+
+
+def _is_excluded(name, main_program=None) -> bool:
+    names = _EXCLUDED.get(id(main_program), []) + _EXCLUDED.get(id(None), [])
+    return any(name and name.startswith(n) for n in names if n)
+
+
+def decorate(optimizer):
+    """Wrap the optimizer so masks are re-applied after each step
+    (reference: sparsity/asp.py decorate -> OptimizerWithSparsityGuarantee).
+    Same wrapper as the dygraph path."""
+    return _asp.decorate(optimizer)
+
+
+def prune_model(model_or_program=None, main_program=None, n=2, m=4,
+                mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable weight (reference:
+    sparsity/asp.py prune_model).  Accepts a dygraph Layer (delegates to
+    incubate.asp) or a static Program (prunes its parameters, honoring
+    the excluded-layer registry)."""
+    target = model_or_program if model_or_program is not None \
+        else main_program
+    if target is not None and hasattr(target, "named_parameters"):
+        return _asp.prune_model(target, n=n, m=m, mask_algo=mask_algo,
+                                with_mask=with_mask)
+    # static Program path: prune its recorded parameters (create_parameter
+    # records (param, init_fn) pairs on the program's startup actions)
+    from . import graph as G
+
+    prog = target or G.default_main_program()
+    pruned = {}
+    seen = set()
+    params = []
+    for entry in getattr(prog, "_startup_actions", []):
+        p = entry[0]
+        if id(p) not in seen:
+            seen.add(id(p))
+            params.append(p)
+    for p in params:
+        name = getattr(p, "name", "")
+        arr = np.asarray(p._value)
+        if arr.ndim != 2 or arr.shape[-1] % m or _is_excluded(name, prog):
+            continue
+        mask = _asp.compute_mask_2_4(arr)
+        import jax.numpy as jnp
+
+        p._value = jnp.asarray(arr * mask)
+        if with_mask:
+            p._asp_mask = mask
+        pruned[name or f"param_{id(p)}"] = float(mask.mean())
+    return pruned

@@ -16,11 +16,21 @@ from typing import Callable, Dict
 _PALLAS_OPS: Dict[str, Callable] = {}
 
 
+def get_build_directory(verbose=False):
+    """Default extension build dir (reference:
+    python/paddle/utils/cpp_extension/extension_utils.py get_build_directory
+    — honors PADDLE_EXTENSION_DIR, else a per-user cache dir)."""
+    root = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    if verbose:
+        print(f"paddle_tpu extensions build dir: {root}")
+    return root
+
+
 def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
          build_directory=None, verbose=False):
     """Compile C++ sources into a shared lib and load with ctypes."""
-    build_dir = build_directory or os.path.join(
-        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    build_dir = build_directory or get_build_directory()
     os.makedirs(build_dir, exist_ok=True)
     so_path = os.path.join(build_dir, f"lib{name}.so")
     srcs = [sources] if isinstance(sources, str) else list(sources)

@@ -324,9 +324,92 @@ class RandomRotation(BaseTransform):
         return ndimage.rotate(arr, angle, reshape=False, order=1)
 
 
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference:
+    python/paddle/vision/transforms/transforms.py RandomErasing — scale is
+    the erased-area fraction range, ratio the aspect-ratio range, value a
+    number / per-channel sequence / 'random')."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        if not (isinstance(scale, (tuple, list)) and len(scale) == 2):
+            raise ValueError("scale must be a (lo, hi) sequence")
+        if not (isinstance(ratio, (tuple, list)) and len(ratio) == 2):
+            raise ValueError("ratio must be a (lo, hi) sequence")
+        if scale[0] > scale[1] or ratio[0] > ratio[1]:
+            raise ValueError("scale/ratio ranges must be (lo, hi)")
+        if not 0 <= prob <= 1:
+            raise ValueError("prob must be in [0, 1]")
+        if isinstance(value, str) and value != "random":
+            raise ValueError("value must be a number, a sequence, or "
+                             "'random'")
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _get_params(self, img_h, img_w, channels):
+        area = img_h * img_w
+        import math as _math
+
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = _math.exp(random.uniform(_math.log(self.ratio[0]),
+                                              _math.log(self.ratio[1])))
+            h = int(round(_math.sqrt(target * aspect)))
+            w = int(round(_math.sqrt(target / aspect)))
+            if 0 < h <= img_h and 0 < w <= img_w:
+                top = random.randint(0, img_h - h)
+                left = random.randint(0, img_w - w)
+                if self.value == "random":
+                    v = np.random.standard_normal(
+                        (h, w, channels)).astype(np.float32)
+                elif isinstance(self.value, (list, tuple)):
+                    v = np.asarray(self.value, np.float32).reshape(1, 1, -1)
+                else:
+                    v = np.float32(self.value)
+                return top, left, h, w, v
+        return None  # no valid region found; return the image unchanged
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        params = self._get_params(arr.shape[0], arr.shape[1], arr.shape[2])
+        if params is None:
+            return img
+        top, left, h, w, v = params
+        return erase(img, top, left, h, w, v, inplace=self.inplace)
+
+
 # ---------------------------------------------------------------------------
 # functional API (reference: python/paddle/vision/transforms/functional.py)
 # ---------------------------------------------------------------------------
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Fill img[i:i+h, j:j+w] with v (reference functional.erase).
+
+    Accepts HWC ndarrays, CHW Tensors, or anything _as_hwc understands;
+    v broadcasts over the erased (h, w, C) region."""
+    if isinstance(img, Tensor):  # CHW tensor path, stays a Tensor
+        import jax.numpy as jnp
+
+        arr = img._value
+        vv = np.asarray(v, np.float32)
+        if vv.ndim == 1:          # per-channel fill
+            vv = vv.reshape(-1, 1, 1)
+        elif vv.ndim == 3:        # (h, w, C) patch -> (C, h, w)
+            vv = vv.transpose(2, 0, 1)
+        patch = jnp.broadcast_to(jnp.asarray(vv),
+                                 (arr.shape[0], h, w)).astype(arr.dtype)
+        out = arr.at[:, i:i + h, j:j + w].set(patch)
+        if inplace:
+            img._value = out
+            return img
+        return Tensor(out)
+    arr = _as_hwc(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = np.broadcast_to(
+        np.asarray(v, out.dtype), (h, w, out.shape[2]))
+    return out
 
 def pad(img, padding, fill=0, padding_mode="constant"):
     """Pad an HWC image (functional form of the Pad transform)."""

@@ -1,0 +1,777 @@
+"""Round-4 submodule API-surface parity (VERDICT r3 follow-through).
+
+The reference's submodule ``__all__`` lists had 17 modules with missing
+names after round 3; these tests pin every family added to close them:
+fleet data generators/datasets, entry attrs, distributed.passes,
+group_sharded_parallel, cost_model, BFGS/L-BFGS, static.nn long tail
+(convs/norms/nce/crf/sequence ops), static.sparsity, sparse.functional,
+inference enums, Bilinear init, RandomErasing, FusedMultiTransformer.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+class TestQuickWins:
+    def test_incubate_autograd_reexport(self):
+        from paddle_tpu.incubate import autograd as ia
+
+        assert ia.vjp is paddle.autograd.vjp
+        assert ia.Hessian is paddle.autograd.Hessian
+
+    def test_get_build_directory(self, monkeypatch):
+        from paddle_tpu.utils.cpp_extension import get_build_directory
+
+        monkeypatch.setenv("PADDLE_EXTENSION_DIR", "/tmp/ext_dir_test")
+        assert get_build_directory() == "/tmp/ext_dir_test"
+
+    def test_bilinear_initializer(self):
+        # factor-2 upsampling kernel: rows outer([.25,.75,.75,.25])
+        init = paddle.nn.initializer.Bilinear()
+        w = np.asarray(init._generate((3, 1, 4, 4), np.float32))
+        r = np.array([0.25, 0.75, 0.75, 0.25])
+        assert np.allclose(w[0, 0], np.outer(r, r))
+        assert np.allclose(w[0], w[1])  # identical per channel
+        assert abs(w[0, 0].sum() - 4.0) < 1e-5  # factor**2 energy
+
+    def test_erase_and_random_erasing(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.ones((8, 8, 3), np.uint8) * 7
+        out = T.erase(img, 2, 3, 2, 2, 0)
+        assert out[2:4, 3:5].sum() == 0 and out[0, 0, 0] == 7
+        t = paddle.to_tensor(np.ones((3, 8, 8), np.float32))
+        out_t = T.erase(t, 1, 1, 3, 3, np.zeros(3, np.float32))
+        assert float(out_t.numpy()[:, 1:4, 1:4].sum()) == 0
+        assert float(out_t.numpy().sum()) == 3 * 64 - 27
+        o = T.RandomErasing(prob=1.0)(
+            np.random.rand(16, 16, 3).astype(np.float32))
+        assert o.shape == (16, 16, 3)
+        # prob=0 is the identity
+        src = np.random.rand(8, 8, 3).astype(np.float32)
+        assert T.RandomErasing(prob=0.0)(src) is src
+
+    def test_inference_enums(self):
+        import paddle_tpu.inference as infer
+
+        assert infer.get_num_bytes_of_data_type(infer.DataType.FLOAT32) == 4
+        assert infer.get_num_bytes_of_data_type(infer.DataType.BFLOAT16) == 2
+        assert infer.get_trt_compile_version() == (0, 0, 0)
+        assert infer.PrecisionType.Int8.value == 1
+        h = infer.Tensor("x")
+        h.copy_from_cpu(np.zeros((2, 2), np.int64))
+        assert h.type() in (infer.DataType.INT64, infer.DataType.INT32)
+
+    def test_sparse_functional(self):
+        import paddle_tpu.sparse as sp
+
+        x = np.zeros((1, 6, 6, 6, 2), np.float32)
+        x[0, 1, 1, 1] = 1
+        x[0, 3, 4, 2] = 2
+        nz = np.nonzero(x.sum(-1))
+        st = sp.sparse_coo_tensor(np.array(nz), x[nz], shape=x.shape)
+        w = paddle.to_tensor(np.random.RandomState(0).rand(
+            3, 3, 3, 2, 4).astype(np.float32))
+        y = sp.functional.conv3d(st, w, stride=2, padding=1)
+        # functional form must equal the layer with the same weight
+        layer = sp.nn.Conv3D(2, 4, 3, stride=2, padding=1, bias_attr=False)
+        layer.weight._value = w._value
+        y_layer = layer(st)
+        assert np.allclose(np.asarray(y.to_dense().numpy()),
+                           np.asarray(y_layer.to_dense().numpy()), atol=1e-5)
+        y2 = sp.functional.subm_conv3d(st, w, padding=1)
+        assert tuple(y2.shape) == (1, 6, 6, 6, 4)
+        y3 = sp.functional.max_pool3d(st, 2)
+        assert tuple(y3.shape) == (1, 3, 3, 3, 2)
+
+
+class TestFleetDataPipeline:
+    def test_multi_slot_generator_protocol(self):
+        from paddle_tpu.distributed.fleet import (MultiSlotDataGenerator,
+                                                  MultiSlotStringDataGenerator)
+
+        g = MultiSlotDataGenerator()
+        s = g._gen_str([("words", [1926, 8, 17]), ("label", [1])])
+        assert s == "3 1926 8 17 1 1\n"
+        assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+        g2 = MultiSlotStringDataGenerator()
+        assert g2._gen_str([("w", ["a", "b"]), ("l", ["1"])]) == "2 a b 1 1\n"
+        with pytest.raises(ValueError):
+            g._gen_str("not-a-list")
+
+    def _write_file(self, d, n=7):
+        path = os.path.join(d, "part-0")
+        with open(path, "w") as fh:
+            for i in range(n):
+                fh.write(f"3 {i} {i + 1} {i + 2} 1 {i % 2}\n")
+        return path
+
+    class _Var:
+        def __init__(self, name, dtype):
+            self.name, self.dtype = name, dtype
+
+    def test_queue_dataset(self):
+        from paddle_tpu.distributed import QueueDataset
+
+        with tempfile.TemporaryDirectory() as d:
+            f = self._write_file(d)
+            ds = QueueDataset()
+            ds.init(batch_size=3, use_var=[self._Var("words", "int64"),
+                                           self._Var("label", "int64")])
+            ds.set_filelist([f])
+            batches = list(ds)
+            assert sum(b["words"].shape[0] for b in batches) == 7
+            assert batches[0]["words"].shape == (3, 3)
+            assert batches[0]["words"].dtype == np.int64
+            assert list(batches[0]["words"][1]) == [1, 2, 3]
+
+    def test_in_memory_dataset_shuffle_cycle(self):
+        from paddle_tpu.distributed import InMemoryDataset
+
+        with tempfile.TemporaryDirectory() as d:
+            f = self._write_file(d)
+            ds = InMemoryDataset()
+            ds.init(batch_size=4, use_var=[self._Var("words", "int64"),
+                                           self._Var("label", "int64")])
+            ds.set_filelist([f])
+            ds.load_into_memory()
+            assert ds.get_memory_data_size() == 7
+            ds.local_shuffle()
+            assert ds.get_shuffle_data_size() == 7
+            got = sorted(int(r[0][0]) for r in ds._memory)
+            assert got == list(range(7))  # shuffle permutes, not drops
+            ds.slots_shuffle(["words"])
+            ds.release_memory()
+            assert ds.get_memory_data_size() == 0
+
+    def test_in_memory_dataset_pipe_command(self):
+        from paddle_tpu.distributed import InMemoryDataset
+
+        with tempfile.TemporaryDirectory() as d:
+            raw = os.path.join(d, "raw.txt")
+            with open(raw, "w") as fh:
+                fh.write("ignored\nignored\n")
+            ds = InMemoryDataset()
+            # pipe replaces file content entirely — proves the subprocess
+            # path runs (the reference pipes through a data_generator)
+            ds.init(batch_size=2, use_var=[self._Var("w", "int64")],
+                    pipe_command="printf '1 11\\n1 22\\n'")
+            ds.set_filelist([raw])
+            ds.load_into_memory()
+            vals = sorted(int(r[0][0]) for r in ds._memory)
+            assert vals == [11, 22]
+
+    def test_entry_attrs(self):
+        from paddle_tpu.distributed import (CountFilterEntry,
+                                            ProbabilityEntry, ShowClickEntry)
+
+        assert ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+        assert CountFilterEntry(10)._to_attr() == "count_filter_entry:10"
+        assert ShowClickEntry("s", "c")._to_attr() == "show_click_entry:s:c"
+        with pytest.raises(ValueError):
+            ProbabilityEntry(1.5)
+        with pytest.raises(ValueError):
+            CountFilterEntry(-1)
+
+    def test_fleet_role_and_util(self):
+        from paddle_tpu.distributed import fleet
+
+        assert fleet.Role.WORKER == 1 and fleet.Role.SERVER == 2
+        u = fleet.UtilBase()
+        files = [f"f{i}" for i in range(5)]
+        assert u.get_file_shard(files) == files  # world=1 keeps all
+        with pytest.raises(TypeError):
+            u.get_file_shard("not-a-list")
+        out = u.all_reduce(np.asarray([1.0, 2.0]))
+        assert np.allclose(out, [1.0, 2.0])  # world=1 identity
+        assert fleet.Fleet is type(fleet.fleet)
+
+    def test_distributed_infer_shim(self):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+
+        di = DistributedInfer()
+        assert di.get_dist_infer_program() is None
+
+
+class TestDistributedPassesAndSharding:
+    def test_pass_manager_applies(self):
+        import paddle_tpu.distributed.passes as dp
+
+        with pytest.raises(KeyError):
+            dp.new_pass("no_such_pass")
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 4], "float32")
+                y = snn.fc(x, 3)
+                _dead = paddle.add(y, y)  # unused -> dead op  # noqa: F841
+            pm = dp.PassManager([dp.new_pass("eliminate_dead_ops")])
+            ctx = pm.apply([main])
+            assert ctx.get_attr("eliminate_dead_ops.num_changed") is not None
+            assert pm.names == ["eliminate_dead_ops"]
+        finally:
+            paddle.disable_static()
+
+    def test_group_sharded_parallel_levels(self):
+        from paddle_tpu.distributed import (group_sharded_parallel,
+                                            save_group_sharded_model)
+        from paddle_tpu.distributed.mesh import reset_mesh
+        from paddle_tpu.distributed.sharding import get_sharding_spec
+
+        reset_mesh()
+        try:
+            model = paddle.nn.Linear(16, 8)
+            opt = paddle.optimizer.AdamW(0.01,
+                                         parameters=model.parameters())
+            with pytest.raises(ValueError):
+                group_sharded_parallel(model, opt, "bogus")
+            with pytest.raises(NotImplementedError):
+                group_sharded_parallel(model, opt, "p_g_os", offload=True)
+            m2, o2, sc = group_sharded_parallel(model, opt, "p_g_os")
+            spec = get_sharding_spec(m2.weight)
+            assert spec is not None and "sharding" in str(spec)
+            assert sc is None
+            with tempfile.TemporaryDirectory() as d:
+                save_group_sharded_model(m2, d, o2)
+                assert sorted(os.listdir(d)) == ["model.pdopt",
+                                                 "model.pdparams"]
+            # os level: slots shard, params stay replicated
+            reset_mesh()
+            model2 = paddle.nn.Linear(16, 8)
+            opt2 = paddle.optimizer.AdamW(0.01,
+                                          parameters=model2.parameters())
+            group_sharded_parallel(model2, opt2, "os")
+            assert getattr(model2.weight, "_zero_opt_spec", None) is not None
+            assert getattr(model2.weight, "_zero_grad_spec", None) is None
+        finally:
+            reset_mesh()
+
+
+class TestCostModel:
+    def test_profile_and_table(self):
+        cm = paddle.cost_model.CostModel()
+        startup, main = cm.build_program()
+        try:
+            r = cm.profile_measure(startup, main, device="cpu")
+            assert r["time"] > 0 and r["op_count"] >= 3
+        finally:
+            paddle.disable_static()
+        entry = cm.get_static_op_time("softmax")
+        assert entry["flops_per_element"] == 5.0
+        bwd = cm.get_static_op_time("softmax", forward=False)
+        assert bwd["flops_per_element"] == 10.0
+        with pytest.raises(ValueError):
+            cm.get_static_op_time(None)
+        with pytest.raises(ValueError):
+            cm.get_static_op_time("no_such_op")
+
+
+class TestBFGS:
+    def test_bfgs_quadratic(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+
+        def quad(x):
+            return paddle.sum((x - paddle.to_tensor(target)) ** 2)
+
+        ok, n, x, f, g, H = minimize_bfgs(quad, np.zeros(3, np.float32))
+        assert bool(ok.numpy())
+        assert np.allclose(x.numpy(), target, atol=1e-4)
+        assert float(f.numpy()) < 1e-7
+        assert int(n.numpy()) > 0
+        # H stays a symmetric PD estimate (exact I/2 needs the full
+        # direction set; a quadratic converges before exploring it)
+        Hn = H.numpy()
+        assert np.allclose(Hn, Hn.T, atol=1e-5)
+        assert (np.linalg.eigvalsh(Hn) > 0).all()
+
+    def test_lbfgs_rosenbrock(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+
+        def rosen(x):
+            a = x[1:] - x[:-1] ** 2
+            b = 1.0 - x[:-1]
+            return paddle.sum(100.0 * a * a) + paddle.sum(b * b)
+
+        ok, n, x, f, g = minimize_lbfgs(rosen, np.zeros(4, np.float32),
+                                        max_iters=200)
+        assert np.allclose(x.numpy(), np.ones(4), atol=1e-2)
+        assert float(f.numpy()) < 1e-5
+
+    def test_bad_line_search_rejected(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        with pytest.raises(NotImplementedError):
+            minimize_bfgs(lambda x: paddle.sum(x), np.zeros(2, np.float32),
+                          line_search_fn="armijo")
+
+
+class TestStaticNNLongTail:
+    def _exec(self, build, feeds):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                fetches = build(static)
+            exe = static.Executor()
+            exe.run(startup)
+            return exe.run(main, feed=feeds, fetch_list=list(fetches))
+        finally:
+            paddle.disable_static()
+
+    def test_conv_and_norm_delegates(self):
+        x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+
+        def build(static):
+            xv = static.data("x", [2, 3, 8, 8], "float32")
+            return (snn.conv2d_transpose(xv, 4, filter_size=2, stride=2),
+                    snn.group_norm(xv, groups=3),
+                    snn.instance_norm(xv),
+                    snn.prelu(xv, mode="channel"))
+
+        o = self._exec(build, {"x": x})
+        assert o[0].shape == (2, 4, 16, 16)
+        assert o[1].shape == (2, 3, 8, 8)
+        # instance norm: per-(N, C) maps are standardized
+        assert abs(o[2][0, 0].mean()) < 1e-4
+        assert abs(o[2][0, 0].std() - 1.0) < 1e-2
+
+    def test_bilinear_and_data_norm_and_row_conv(self):
+        a = np.random.RandomState(1).rand(4, 5).astype(np.float32)
+        b = np.random.RandomState(2).rand(4, 7).astype(np.float32)
+        s = np.random.RandomState(3).rand(3, 5, 4).astype(np.float32)
+
+        def build(static):
+            av = static.data("a", [4, 5], "float32")
+            bv = static.data("b", [4, 7], "float32")
+            sv = static.data("s", [3, 5, 4], "float32")
+            return (snn.bilinear_tensor_product(av, bv, size=6),
+                    snn.data_norm(av),
+                    snn.row_conv(sv, 2))
+
+        o = self._exec(build, {"a": a, "b": b, "s": s})
+        assert o[0].shape == (4, 6)
+        # data_norm defaults: mean 0, scale sqrt(1e4/1e4)=1 -> identity
+        assert np.allclose(o[1], a, atol=1e-5)
+        assert o[2].shape == (3, 5, 4)
+
+    def test_nce_and_crf(self):
+        ft = np.random.RandomState(0).rand(4, 16).astype(np.float32)
+        lbl = np.random.RandomState(1).randint(0, 20, (4, 1))
+        em = np.random.RandomState(2).rand(2, 6, 5).astype(np.float32)
+
+        def build(static):
+            fv = static.data("ft", [4, 16], "float32")
+            lv = static.data("lbl", [4, 1], "int64")
+            ev = static.data("em", [2, 6, 5], "float32")
+            return (snn.nce(fv, lv, 20, num_neg_samples=5),
+                    snn.crf_decoding(ev, param_attr=None))
+
+        o = self._exec(build, {"ft": ft, "lbl": lbl, "em": em})
+        assert o[0].shape == (4, 1) and (o[0] > 0).all()
+        assert o[1].shape == (2, 6)
+        assert o[1].min() >= 0 and o[1].max() < 5
+
+    def test_crf_decoding_matches_brute_force(self):
+        rng = np.random.RandomState(7)
+        em = rng.rand(1, 4, 3).astype(np.float32)
+        w = rng.rand(5, 3).astype(np.float32)
+
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            from paddle_tpu.nn.layer.layers import ParamAttr
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                ev = static.data("em", [1, 4, 3], "float32")
+                path = snn.crf_decoding(
+                    ev, param_attr=ParamAttr(
+                        initializer=paddle.nn.initializer.Assign(w)))
+            exe = static.Executor()
+            exe.run(startup)
+            got = exe.run(main, feed={"em": em}, fetch_list=[path])[0]
+        finally:
+            paddle.disable_static()
+
+        # brute force over all 3^4 paths
+        start, stop, trans = w[0], w[1], w[2:]
+        best, best_score = None, -np.inf
+        import itertools
+
+        for p in itertools.product(range(3), repeat=4):
+            sc = start[p[0]] + em[0, 0, p[0]] + stop[p[-1]]
+            for t in range(1, 4):
+                sc += trans[p[t - 1], p[t]] + em[0, t, p[t]]
+            if sc > best_score:
+                best, best_score = p, sc
+        assert list(got[0]) == list(best)
+
+    def test_sequence_ops_padded_lengths(self):
+        rows = [np.arange(6, dtype=np.float32).reshape(3, 2),
+                np.ones((1, 2), np.float32) * 9]
+        padded, lens = snn.sequence_pad(rows, 0.0)
+        assert padded.shape == [2, 3, 2]
+        assert list(lens.numpy()) == [3, 1]
+
+        p = snn.sequence_pool(padded, "average")
+        assert np.allclose(p.numpy(), [[2.0, 3.0], [9.0, 9.0]])
+        assert np.allclose(snn.sequence_last_step(padded).numpy(),
+                           [[4, 5], [9, 9]])
+        assert np.allclose(snn.sequence_first_step(padded).numpy(),
+                           [[0, 1], [9, 9]])
+        s = snn.sequence_pool(padded, "sum")
+        assert np.allclose(s.numpy(), [[6.0, 9.0], [9.0, 9.0]])
+        sq = snn.sequence_pool(padded, "sqrt")
+        assert np.allclose(sq.numpy()[0], [6.0 / np.sqrt(3), 9 / np.sqrt(3)])
+
+        rev = snn.sequence_reverse(padded)
+        assert np.allclose(rev.numpy()[0], [[4, 5], [2, 3], [0, 1]])
+        assert np.allclose(rev.numpy()[1, 0], [9, 9])
+        assert np.allclose(rev.numpy()[1, 1:], 0)  # padding stays at tail
+
+        cc = snn.sequence_concat([padded, padded])
+        assert np.allclose(cc.numpy()[0, :3], padded.numpy()[0])
+        assert np.allclose(cc.numpy()[0, 3:6], padded.numpy()[0])
+        assert np.allclose(cc.numpy()[1, :2], [[9, 9], [9, 9]])
+        assert np.allclose(cc.numpy()[1, 2:], 0)
+        assert list(cc._seq_lengths.numpy()) == [6, 2]
+
+        sl = snn.sequence_slice(padded, np.array([[1], [0]]),
+                                np.array([[2], [1]]))
+        assert np.allclose(sl.numpy()[0, :2], [[2, 3], [4, 5]])
+        assert np.allclose(sl.numpy()[1, 0], [9, 9])
+
+        ex = snn.sequence_expand(
+            paddle.to_tensor(np.array([[1.0, 1.0], [2.0, 2.0]], "f")),
+            padded)
+        assert np.allclose(ex.numpy()[0], [[1, 1]] * 3)
+        assert np.allclose(ex.numpy()[1], [[2, 2], [0, 0], [0, 0]])
+
+        rs = snn.sequence_reshape(padded, 1)
+        assert rs.shape == [2, 6, 1]
+        assert list(rs._seq_lengths.numpy()) == [6, 2]
+
+        en = snn.sequence_enumerate(
+            paddle.to_tensor(np.array([[1, 2, 3], [4, 0, 0]])), 2)
+        assert np.allclose(en.numpy()[0], [[1, 2], [2, 3], [3, 0]])
+        assert np.allclose(en.numpy()[1, 0], [4, 0])
+
+        sm = snn.sequence_softmax(padded)
+        assert np.allclose(sm.numpy().sum(1)[0], 1.0, atol=1e-5)
+
+        scat = snn.sequence_scatter(
+            paddle.to_tensor(np.zeros((2, 4), np.float32)),
+            paddle.to_tensor(np.array([[1, 2], [0, 3]])),
+            paddle.to_tensor(np.array([[5.0, 6.0], [7.0, 8.0]], "f")))
+        assert np.allclose(scat.numpy(), [[0, 5, 6, 0], [7, 0, 0, 8]])
+
+        unp = snn.sequence_unpad(padded, lens)
+        assert len(unp) == 2 and unp[0].shape == [3, 2] \
+            and unp[1].shape == [1, 2]
+
+    def test_sequence_conv_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 4, 2).astype(np.float32)
+
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            from paddle_tpu.nn.layer.layers import ParamAttr
+
+            w = rng.rand(6, 3).astype(np.float32)
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                xv = static.data("x", [1, 4, 2], "float32")
+                y = snn.sequence_conv(
+                    xv, 3, filter_size=3, bias_attr=False,
+                    param_attr=ParamAttr(
+                        initializer=paddle.nn.initializer.Assign(w)))
+            exe = static.Executor()
+            exe.run(startup)
+            got = exe.run(main, feed={"x": x}, fetch_list=[y])[0]
+        finally:
+            paddle.disable_static()
+        # manual: context [x[t-1], x[t], x[t+1]] @ w, zero outside
+        xp = np.concatenate([np.zeros((1, 1, 2), np.float32), x,
+                             np.zeros((1, 1, 2), np.float32)], 1)
+        ctx = np.concatenate([xp[:, 0:4], xp[:, 1:5], xp[:, 2:6]], -1)
+        assert np.allclose(got, ctx @ w, atol=1e-5)
+
+    def test_py_func_forward_and_grad(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [3, 4], "float32")
+                out_v = static.data("o", [3, 4], "float32")
+                y = snn.py_func(lambda a: a * 2.0 + 1.0, x, out_v)
+            exe = static.Executor()
+            exe.run(startup)
+            got = exe.run(main, feed={"x": np.ones((3, 4), "f")},
+                          fetch_list=[y])[0]
+            assert np.allclose(got, 3.0)
+        finally:
+            paddle.disable_static()
+
+    def test_py_func_backward_reference_contract(self):
+        # backward_func gets (x, out, dout) — the reference py_func_demo
+        # signature — and drives the gradient
+        seen = {}
+
+        def fwd(a):
+            return a * a
+
+        def bwd(a, out, dout):
+            seen["shapes"] = (a.shape, out.shape, dout.shape)
+            return 2.0 * a * dout
+
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        x.stop_gradient = False
+        out_proto = paddle.to_tensor(np.zeros(4, np.float32))
+        y = snn.py_func(fwd, x, out_proto, backward_func=bwd)
+        loss = paddle.sum(y)
+        loss.backward()
+        assert seen["shapes"] == ((4,), (4,), (4,))
+        assert np.allclose(x.grad.numpy(), 2.0 * np.arange(4))
+
+    def test_data_norm_accumulates_stats(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                a = static.data("a", [4, 5], "float32")
+                out = snn.data_norm(a)
+            exe = static.Executor()
+            exe.run(startup)
+            feed = {"a": np.ones((4, 5), np.float32)}
+            exe.run(main, feed=feed, fetch_list=[out])
+            # stats params live on the startup actions; find batch_size
+            params = [p for p, _ in main._startup_actions]
+            sizes = [p for p in params
+                     if np.allclose(np.asarray(p._value), 1e4 + 4)]
+            assert sizes, "batch_size did not accumulate the batch"
+        finally:
+            paddle.disable_static()
+
+    def test_multi_box_head_shapes(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                img = static.data("img", [1, 3, 32, 32], "float32")
+                f1 = static.data("f1", [1, 8, 8, 8], "float32")
+                f2 = static.data("f2", [1, 8, 4, 4], "float32")
+                locs, confs, boxes, vars_ = snn.multi_box_head(
+                    [f1, f2], img, base_size=32, num_classes=5,
+                    aspect_ratios=[[2.0], [2.0]],
+                    min_sizes=[8.0, 16.0], max_sizes=[16.0, 24.0])
+            exe = static.Executor()
+            exe.run(startup)
+            o = exe.run(main, feed={
+                "img": np.zeros((1, 3, 32, 32), "f"),
+                "f1": np.random.rand(1, 8, 8, 8).astype("f"),
+                "f2": np.random.rand(1, 8, 4, 4).astype("f")},
+                fetch_list=[locs, confs])
+        finally:
+            paddle.disable_static()
+        # priors per cell: 1 min * 3 ars + 1 max = 4; (64+16) cells * 4
+        assert o[0].shape == (1, 320, 4)
+        assert o[1].shape == (1, 320, 5)
+        assert boxes.shape == [320, 4] and vars_.shape == [320, 4]
+
+
+class TestStaticSparsity:
+    def test_density_and_prune_dygraph(self):
+        from paddle_tpu.static import sparsity
+
+        w = paddle.to_tensor(np.random.rand(8, 8).astype("f") + 0.1)
+        assert sparsity.calculate_density(w) == 1.0
+        lin = paddle.nn.Linear(8, 8)
+        sparsity.prune_model(lin)
+        d = sparsity.calculate_density(lin.weight)
+        assert abs(d - 0.5) < 1e-6
+        from paddle_tpu.incubate.asp import check_sparsity
+
+        assert check_sparsity(np.asarray(lin.weight.numpy()))
+
+    def test_prune_static_program_with_exclusions(self):
+        from paddle_tpu.static import sparsity
+
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+            from paddle_tpu.nn.layer.layers import ParamAttr
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2, 8], "float32")
+                h = snn.fc(x, 8, weight_attr=ParamAttr(name="fc_w"))
+                _ = snn.fc(h, 4, weight_attr=ParamAttr(name="skip_w"))
+            from paddle_tpu.static.graph import default_main_program
+
+            sparsity.reset_excluded_layers()
+            sparsity.set_excluded_layers(main, ["skip_w"])
+            pruned = sparsity.prune_model(main_program=main)
+            assert "fc_w" in pruned and "skip_w" not in pruned
+            assert abs(pruned["fc_w"] - 0.5) < 1e-6
+            sparsity.reset_excluded_layers()
+        finally:
+            paddle.disable_static()
+
+
+class TestFusedMultiTransformer:
+    @staticmethod
+    def _causal_mask(T):
+        m = np.where(np.tril(np.ones((T, T), bool)), 0.0, -1e30)
+        return paddle.to_tensor(m[None, None].astype("f"))
+
+    def test_parameters_registered(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        m = FusedMultiTransformer(8, 2, 16, num_layers=1)
+        # 12 weight groups per layer must all reach parameters()/state_dict
+        assert len(m.parameters()) == 12
+        assert len(m.state_dict()) == 12
+
+    def test_forward_and_decode_parity(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        m = FusedMultiTransformer(32, 4, 64, num_layers=2)
+        m.eval()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(2, 5, 32).astype("f"))
+        step = paddle.to_tensor(rng.rand(2, 1, 32).astype("f"))
+        y = m(x, attn_mask=self._causal_mask(5))
+        assert y.shape == [2, 5, 32]
+        # full-sequence CAUSAL forward == prefill + one decode step
+        # (causality comes from the caller's mask, like the reference op)
+        full = paddle.to_tensor(
+            np.concatenate([x.numpy(), step.numpy()], 1))
+        ref = m(full, attn_mask=self._causal_mask(6))
+        caches = [paddle.to_tensor(np.zeros((2, 2, 4, 16, 8), "f"))
+                  for _ in range(2)]
+        _, caches = m(x, attn_mask=self._causal_mask(5), caches=caches)
+        assert float(np.abs(caches[0].numpy()[:, :, :, 5:]).sum()) == 0
+        dec, caches = m(step, caches=caches, time_step=5)
+        err = float(np.abs(ref.numpy()[:, -1:] - dec.numpy()).max())
+        assert err < 1e-5, err
+
+    def test_no_mask_is_bidirectional(self):
+        # reference contract: no attn_mask -> NO implicit causal mask;
+        # position 0 must see position 1 (outputs differ from causal run)
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        m = FusedMultiTransformer(16, 2, 32, num_layers=1)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).rand(1, 4, 16).astype("f"))
+        bidir = m(x)
+        causal = m(x, attn_mask=self._causal_mask(4))
+        assert not np.allclose(bidir.numpy()[:, 0], causal.numpy()[:, 0])
+
+    def test_functional_name_exists(self):
+        from paddle_tpu.incubate.nn import functional as FI
+
+        assert callable(FI.fused_multi_transformer)
+
+    def test_trans_qkvw_false_layout(self):
+        # [3, D, H, hd] layout must read head dims from axes 2/3 and
+        # match the transposed-weight run numerically
+        from paddle_tpu.incubate.nn import functional as FI
+
+        rng = np.random.RandomState(3)
+        D, H, hd, dff = 8, 2, 4, 16
+        qkv_t = rng.rand(3, H, hd, D).astype("f")     # trans layout
+        qkv_nt = np.transpose(qkv_t, (0, 3, 1, 2)).copy()
+        ow = rng.rand(D, D).astype("f")
+        w1 = rng.rand(D, dff).astype("f")
+        w2 = rng.rand(dff, D).astype("f")
+        ones = np.ones(D, "f")
+        zeros = np.zeros(D, "f")
+        x = paddle.to_tensor(rng.rand(2, 4, D).astype("f"))
+
+        def run(qkvw, trans):
+            t = paddle.to_tensor
+            out = FI.fused_multi_transformer(
+                x, [t(ones)], [t(zeros)], [t(qkvw)], None, [t(ow)], None,
+                [t(ones)], [t(zeros)], [t(w1)], None, [t(w2)], None,
+                trans_qkvw=trans)
+            return out.numpy()
+
+        a = run(qkv_t, True)
+        b = run(qkv_nt, False)
+        assert a.shape == (2, 4, 8)
+        assert np.allclose(a, b, atol=1e-5)
+
+    def test_per_element_none_bias_alignment(self):
+        # qkv_biases=[b0, None]: packer and consumer must skip the SAME
+        # slot — a mismatch shifts every later weight by one
+        from paddle_tpu.incubate.nn import functional as FI
+
+        rng = np.random.RandomState(4)
+        D, H, hd, dff = 8, 2, 4, 16
+        t = paddle.to_tensor
+
+        def mk(*shape):
+            return t(rng.rand(*shape).astype("f"))
+
+        ones = [t(np.ones(D, "f"))] * 2
+        zeros = [t(np.zeros(D, "f"))] * 2
+        x = t(rng.rand(1, 3, D).astype("f"))
+        out = FI.fused_multi_transformer(
+            x, ones, zeros, [mk(3, H, hd, D), mk(3, H, hd, D)],
+            [mk(3, H, hd), None],  # per-element None
+            [mk(D, D), mk(D, D)], None, ones, zeros,
+            [mk(D, dff), mk(D, dff)], None, [mk(dff, D), mk(dff, D)], None)
+        assert out.shape == [1, 3, 8]
+
+    def test_multi_box_head_multi_min_sizes(self):
+        # per-cell priors: 2 mins * 3 ars + 1 paired max = 7; boxes and
+        # conv channels must agree (review r4: nested maxs loop overflowed)
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                img = static.data("img", [1, 3, 32, 32], "float32")
+                f1 = static.data("f1", [1, 8, 4, 4], "float32")
+                locs, confs, boxes, _ = snn.multi_box_head(
+                    [f1], img, base_size=32, num_classes=3,
+                    aspect_ratios=[[2.0]],
+                    min_sizes=[[16.0, 24.0]], max_sizes=[[32.0]])
+            exe = static.Executor()
+            exe.run(startup)
+            o = exe.run(main, feed={
+                "img": np.zeros((1, 3, 32, 32), "f"),
+                "f1": np.random.rand(1, 8, 4, 4).astype("f")},
+                fetch_list=[locs])
+        finally:
+            paddle.disable_static()
+        assert o[0].shape[1] == boxes.shape[0] == 16 * 7
+
+    def test_sequence_pad_maxlen_truncates_lengths(self):
+        rows = [np.ones((5, 2), np.float32), np.ones((2, 2), np.float32)]
+        padded, lens = snn.sequence_pad(rows, 0.0, maxlen=3)
+        assert padded.shape == [2, 3, 2]
+        assert list(lens.numpy()) == [3, 2]  # truncated length reported
+        avg = snn.sequence_pool(padded, "average")
+        assert np.allclose(avg.numpy(), 1.0)

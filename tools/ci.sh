@@ -10,6 +10,13 @@ export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 # the ambient env often pins an accelerator platform, so override it.
 export JAX_PLATFORMS=${CI_JAX_PLATFORMS:-cpu}
 export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
+if [ "${JAX_PLATFORMS}" = "cpu" ]; then
+  # the accelerator tunnel's sitecustomize registers its PJRT plugin at
+  # INTERPRETER startup whenever this var is set, and a dead tunnel then
+  # hangs every python process before main() — JAX_PLATFORMS=cpu is not
+  # enough, the registration itself blocks.  CPU CI must not touch it.
+  unset PALLAS_AXON_POOL_IPS
+fi
 
 echo "== unit + integration tests =="
 python -m pytest tests/ -q
