@@ -175,6 +175,71 @@ def _cvt_while(cond_fn, body_fn, args, names=(), n_stores=None):
     return vals
 
 
+def _range_cond(i, stop, step):
+    """Loop-continue predicate for a lowered for-range: ``i < stop`` for
+    positive step, ``i > stop`` for negative; sign-folded when the step
+    itself is a traced value."""
+    if _is_tensorish(step):
+        return (i - stop) * step < 0
+    return i < stop if step > 0 else i > stop
+
+
+def _cvt_for_range(start, stop, step, body_fn, prior, args, names=(),
+                   n_stores=None):
+    """Runtime half of the for-range rewrite (reference:
+    convert_operators.py convert_range semantics).
+
+    Plain-int bounds run a REAL python ``for`` — loop-var binding (last
+    iterated value; the prior binding survives an empty range), step=0
+    ValueError, and iteration order are exactly eager Python's, so
+    converting a function that never sees a Tensor bound changes nothing.
+    A traced bound lowers to XLA While via jit.while_loop (one
+    executable for every trip count; forward-only like the while
+    rewrite).  Returns ``(loop_var, *carried)``."""
+    if not any(_is_tensorish(v) for v in (start, stop, step)):
+        vals = tuple(args)
+        i = prior
+        for i in range(start, stop, step):
+            out = body_fn(i, *vals)
+            vals = out if isinstance(out, tuple) else (out,)
+        return (i,) + vals
+    if not _is_tensorish(step) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if any(args[i] is _UNDEF for i in range(n_stores or 0)):
+        undef = [n for n, a in zip(names, args) if a is _UNDEF]
+        raise ValueError(
+            "dy2static for-range over a Tensor bound: every loop-carried "
+            f"variable must be initialized before the loop: {undef}")
+    from . import while_loop
+
+    op_idx = [i for i, a in enumerate(args) if _is_operand(a)]
+
+    def merge(real):
+        full = list(args)
+        for i, v in zip(op_idx, real):
+            full[i] = v
+        return full
+
+    def c2(i, *real):
+        return _range_cond(i, stop, step)
+
+    def b2(i, *real):
+        out = body_fn(i, *merge(real))
+        out = out if isinstance(out, tuple) else (out,)
+        return (i + step,) + tuple(out[k] for k in op_idx)
+
+    state = while_loop(c2, b2, [start] + [args[i] for i in op_idx])
+    i_fin, real_out = state[0], state[1:]
+    res = list(args)
+    for i, v in zip(op_idx, real_out):
+        res[i] = v
+    # loop var after the loop: the last ITERATED value.  i_fin overshoots
+    # by one step; with zero trips this leaves start-step, where eager
+    # python would keep the prior binding — a data-dependent trip count
+    # cannot reproduce that statically, so prefer the arithmetic value.
+    return (i_fin - step,) + tuple(res)
+
+
 class _Unsupported(Exception):
     pass
 
@@ -310,9 +375,11 @@ def _loaded_names(nodes):
 
 
 class _Rewriter(ast.NodeTransformer):
-    def __init__(self, global_names=(), local_names=(), free_names=()):
+    def __init__(self, global_names=(), local_names=(), free_names=(),
+                 range_shadowed=False):
         self.counter = 0
         self.changed = False
+        self.range_shadowed = range_shadowed
         import builtins
 
         # reads of globals/builtins/free variables stay closed over;
@@ -423,6 +490,71 @@ class _Rewriter(ast.NodeTransformer):
         self.changed = True
         return [_undef_guard(n) for n in carried] + [c_fn, b_fn, call]
 
+    def visit_For(self, node):
+        """``for i in range(...)`` rewrites into ``_cvt_for_range``, whose
+        RUNTIME dispatch keeps exact Python semantics (loop-var binding,
+        empty ranges, step=0 ValueError) when every bound is a plain int
+        and lowers to XLA While only when a bound is a traced Tensor
+        (reference: dygraph_to_static loop_transformer + convert_range).
+        Everything else (iterating lists, tensors with static leading
+        dim, enumerate, zip, shadowed ``range``) is left untouched."""
+        self.generic_visit(node)
+        if self.range_shadowed:
+            return node  # a user `range` binding: name-match is unsound
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return node
+        try:
+            _check_supported(node.body)
+        except _Unsupported:
+            return node
+        tgt = node.target.id
+        stores = _assigned_names(node.body)
+        if tgt in stores:
+            # `for i ...: i = ...` — body rebinding of the loop var has
+            # observable post-loop semantics the closure drop would lose
+            return node
+        # evaluate the range arguments LEFT-TO-RIGHT (python call-arg
+        # order; side-effecting bounds must see each other's effects)
+        arg_ns = [self._fresh("rng") for _ in it.args]
+        setup = [ast.Assign(targets=[_name(n, ast.Store())], value=a)
+                 for n, a in zip(arg_ns, it.args)]
+        if len(arg_ns) == 1:
+            start, stop, step = ast.Constant(value=0), \
+                _name(arg_ns[0], ast.Load()), ast.Constant(value=1)
+        elif len(arg_ns) == 2:
+            start, stop, step = _name(arg_ns[0], ast.Load()), \
+                _name(arg_ns[1], ast.Load()), ast.Constant(value=1)
+        else:
+            start, stop, step = [_name(n, ast.Load()) for n in arg_ns]
+        carried = [n for n in self._carried(stores, node.body) if n != tgt]
+        b_name = self._fresh("forbody")
+        b_fn = _make_fn(b_name, [tgt] + carried, list(node.body),
+                        _ret_tuple(carried))
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in [tgt] + carried],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name(_HELPERS, ast.Load()),
+                                   attr="_cvt_for_range", ctx=ast.Load()),
+                args=[start, stop, step,
+                      _name(b_name, ast.Load()),
+                      _name(tgt, ast.Load()),
+                      ast.Tuple(elts=[_name(n, ast.Load())
+                                      for n in carried], ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in carried], ctx=ast.Load()),
+                      ast.Constant(value=len(stores))],
+                keywords=[]))
+        self.changed = True
+        return (setup + [_undef_guard(n) for n in [tgt] + carried]
+                + [b_fn, call])
+
 
 def convert_function(fn):
     """Return a control-flow-converted clone of ``fn``, or ``fn`` itself
@@ -457,7 +589,12 @@ def convert_function(fn):
     fdef.decorator_list = []
     rw = _Rewriter(global_names=raw.__globals__.keys(),
                    local_names=raw.__code__.co_varnames,
-                   free_names=raw.__code__.co_freevars)
+                   free_names=raw.__code__.co_freevars,
+                   # a module-global, local, or closed-over `range`
+                   # binding makes the name-based for-range match unsound
+                   range_shadowed=("range" in raw.__globals__
+                                   or "range" in raw.__code__.co_varnames
+                                   or "range" in raw.__code__.co_freevars))
     # visit the body statements, not fdef itself — visit_FunctionDef
     # guards NESTED defs only
     new_body = []

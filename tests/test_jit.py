@@ -490,6 +490,124 @@ class TestDy2StaticAST:
         out = g(paddle.to_tensor(np.zeros((1, 2), np.float32)))
         assert float(out.sum().numpy()) >= 10.0
 
+    def test_for_range_tensor_bound_compiles(self):
+        """``for i in range(tensor_n)`` desugars to the while rewrite —
+        ONE executable serves every trip count (XLA While, not unrolled
+        retraces; reference: dygraph_to_static loop_transformer)."""
+        def f(x, n):
+            acc = paddle.zeros_like(x)
+            for i in range(n):
+                acc = acc + x * (i + 1)
+            return acc
+
+        st = jit.to_static(f)
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(
+            st(x, paddle.to_tensor(np.int32(4))).numpy(), 10.0)
+        np.testing.assert_allclose(
+            st(x, paddle.to_tensor(np.int32(2))).numpy(), 3.0)
+        assert len(st._cache) == 1
+
+    def test_for_range_start_step_variants(self):
+        def g(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(1, n, 2):
+                s = s + i
+            return s
+
+        def down(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(n, 0, -1):
+                s = s + i
+            return s
+
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(
+            jit.to_static(g)(x, paddle.to_tensor(np.int32(6))).numpy(),
+            float(sum(range(1, 6, 2))))
+        np.testing.assert_allclose(
+            jit.to_static(down)(x, paddle.to_tensor(np.int32(5))).numpy(),
+            float(sum(range(5, 0, -1))))
+
+    def test_for_python_range_still_unrolls(self):
+        # static trip count keeps plain-trace semantics (no rewrite cost,
+        # and `break` etc. stay legal there)
+        def h(x):
+            for i in range(3):
+                x = x * 2.0
+            return x
+
+        out = jit.to_static(h)(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 8.0)
+
+    def test_for_range_python_semantics_preserved(self):
+        """Plain-int ranges run a REAL python for inside the converter —
+        loop-var binding, empty-range prior binding, step=0 ValueError,
+        and bound-evaluation order are exactly eager's (review r4)."""
+        def overshoot(x):
+            for i in range(3):
+                x = x + 1.0
+            return x * i  # last ITERATED value (2), not last+step (3)
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(
+            jit.to_static(overshoot)(x).numpy(), overshoot(x).numpy())
+
+        def empty_prior(x):
+            i = 99
+            for i in range(0):
+                x = x + 1.0
+            return x + i  # prior binding survives the empty range
+
+        np.testing.assert_allclose(
+            jit.to_static(empty_prior)(x).numpy(), 100.0)
+
+        def stepzero(x):
+            for i in range(1, 5, 0):
+                x = x + 1.0
+            return x
+
+        with pytest.raises(ValueError):
+            jit.to_static(stepzero)(x)
+
+        order = []
+
+        def s1():
+            order.append("start")
+            return 0
+
+        def s2():
+            order.append("stop")
+            return 2
+
+        def sidefx(x):
+            for i in range(s1(), s2()):
+                x = x + 1.0
+            return x
+
+        jit.to_static(sidefx)(x)
+        assert order == ["start", "stop"]
+
+    def test_for_shadowed_range_untouched(self):
+        def shadowed(x):
+            range = lambda n: [10.0]  # noqa: E731,A001
+            for i in range(2):
+                x = x + i
+            return x
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(
+            jit.to_static(shadowed)(x).numpy(), 11.0)
+
+    def test_for_over_list_untouched(self):
+        def f(x):
+            for m in [1.0, 2.0, 3.0]:
+                x = x * m
+            return x
+
+        out = jit.to_static(f)(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 6.0)
+
     def test_side_effecting_python_while_condition(self):
         """The python-bool path must not re-evaluate a side-effecting
         condition for the first test (an extra call would silently skip
