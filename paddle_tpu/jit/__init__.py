@@ -24,8 +24,10 @@ reference's static control-flow ops (fluid/layers/control_flow.py While:1024).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
+import types
 from typing import Any, Dict, List
 
 import jax
@@ -442,6 +444,26 @@ def _collect_captured_params(fn, seen=None, depth=0):
             _collect_from_value(cell.cell_contents, seen, depth)
         except ValueError:  # empty cell
             continue
+    # module-global tensors/layers the code references by NAME (a
+    # module-level ``lin = nn.Linear(...)`` used inside the body is just
+    # as load-bearing as a closure cell).  Only true LOAD_GLOBAL names
+    # count — co_names also holds ATTRIBUTE names, and `h.w` must not
+    # promote an unrelated module-global `w`.
+    code = getattr(fn, "__code__", None)
+    glob = getattr(fn, "__globals__", None)
+    if code is not None and glob is not None:
+        import dis
+
+        codes = [code]  # incl. nested defs (their refs live in their
+        while codes:    # own code objects inside co_consts)
+            c = codes.pop()
+            for ins in dis.get_instructions(c):
+                if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
+                    v = glob.get(ins.argval)
+                    if isinstance(v, (Tensor, Layer)):
+                        _collect_from_value(v, seen, depth)
+            codes.extend(k for k in c.co_consts
+                         if isinstance(k, types.CodeType))
     return seen
 
 
@@ -456,8 +478,29 @@ def _collect_from_value(v, seen, depth):
     elif isinstance(v, (list, tuple)) and len(v) <= 64:
         for e in v:
             _collect_from_value(e, seen, depth)
-    elif callable(v) and getattr(v, "__closure__", None):
+    elif callable(v) and (getattr(v, "__closure__", None)
+                          or getattr(v, "__code__", None)):
         _collect_captured_params(v, seen, depth + 1)
+
+
+@contextlib.contextmanager
+def _substituted(captured, vals):
+    """Temporarily rebind each captured Tensor's ``_value`` (functional
+    substitution during a control-flow trace) with no grad recording —
+    the dispatched outer op owns differentiation.  ONE implementation
+    shared by cond/scan/while so the substitution protocol cannot
+    drift between them."""
+    from ..core.dispatch import no_grad_ctx
+
+    saved = [t._value for t in captured]
+    try:
+        for t, v in zip(captured, vals):
+            t._value = v
+        with no_grad_ctx():
+            yield
+    finally:
+        for t, s in zip(captured, saved):
+            t._value = s
 
 
 def _tape_cond(pred, true_fn, false_fn, operands, op_name="jit_cond"):
@@ -466,7 +509,7 @@ def _tape_cond(pred, true_fn, false_fn, operands, op_name="jit_cond"):
     any differentiable tensors the branches capture by closure (those
     are auto-promoted to operands and functionally substituted during
     the branch trace).  Shared by jit.cond and the dy2static if-rewrite."""
-    from ..core.dispatch import apply, no_grad_ctx
+    from ..core.dispatch import apply
 
     captured = list({**_collect_captured_params(true_fn),
                      **_collect_captured_params(false_fn)}.values())
@@ -476,15 +519,8 @@ def _tape_cond(pred, true_fn, false_fn, operands, op_name="jit_cond"):
         def run(branch):
             def inner(packed):
                 raw_ops, caps = packed
-                saved = [t._value for t in captured]
-                try:
-                    for t, v in zip(captured, caps):
-                        t._value = v
-                    with no_grad_ctx():  # the outer vjp differentiates
-                        res = _unwrap_tree(branch(*_wrap_tree(raw_ops)))
-                finally:
-                    for t, s in zip(captured, saved):
-                        t._value = s
+                with _substituted(captured, caps):
+                    res = _unwrap_tree(branch(*_wrap_tree(raw_ops)))
                 flat, td = jax.tree_util.tree_flatten(res)
                 if not out_td:
                     out_td.append(td)
@@ -506,13 +542,64 @@ def cond(pred, true_fn, false_fn, *operands):
 
 
 def while_loop(cond_fn, body_fn, loop_vars):
-    """Functional while lowered to XLA While (reference: while_loop:1167)."""
-    raw = tuple(_unwrap_tree(v) for v in loop_vars)
-    out = jax.lax.while_loop(
-        lambda vs: _as_raw(cond_fn(*_wrap_tree(vs))),
-        lambda vs: tuple(_unwrap_tree(body_fn(*_wrap_tree(vs)))),
-        raw)
-    return _wrap_tree(out)
+    """Functional while lowered to XLA While (reference: while_loop:1167).
+
+    Forward-only by backend design: XLA While has no static trip count,
+    so reverse mode cannot stage the per-iteration residuals.  The loop
+    rides the tape as ONE op whose vjp RAISES — backward through it is a
+    loud NotImplementedError instead of silently-zero gradients (the
+    reference's static While IS differentiable via a while_grad stack,
+    so silence here would be silently-wrong training math).  Captured
+    layer weights are promoted to operands exactly so that backward
+    finds the op and fails loudly even when no explicit loop var
+    requires grad."""
+    from ..core.dispatch import apply
+
+    captured = list({**_collect_captured_params(cond_fn),
+                     **_collect_captured_params(body_fn)}.values())
+    meta = []
+
+    @jax.custom_vjp
+    def _run(loop_raw, cap_vals):
+        def with_caps(fn, vs, caps):
+            with _substituted(captured, caps):
+                return fn(*_wrap_tree(vs))
+
+        def run_body(st):
+            res = with_caps(body_fn, st[0], st[1])
+            if not isinstance(res, (tuple, list)):
+                res = (res,)  # single loop var: body may return it bare
+            return tuple(_unwrap_tree(tuple(res))), st[1]
+
+        out, _ = jax.lax.while_loop(
+            lambda st: _as_raw(with_caps(cond_fn, st[0], st[1])),
+            run_body, (tuple(loop_raw), tuple(cap_vals)))
+        return out
+
+    def _fwd(loop_raw, cap_vals):
+        return _run(loop_raw, cap_vals), None
+
+    def _bwd(res, ct):
+        raise NotImplementedError(
+            "reverse-mode gradient through jit.while_loop (or a "
+            "dy2static while / for-range over a Tensor bound) is not "
+            "supported: XLA While has no static trip count to stage "
+            "residuals over.  Use a python-int loop bound (unrolls at "
+            "trace time), jit.scan over a fixed length, or run the loop "
+            "under paddle.no_grad().")
+
+    _run.defvjp(_fwd, _bwd)
+
+    def _fn(loop_vals, cap_vals):
+        out = _run(tuple(loop_vals), tuple(cap_vals))
+        flat, td = jax.tree_util.tree_flatten(out)
+        if not meta:
+            meta.append(td)
+        return tuple(flat)
+
+    out = apply("jit_while", _fn, list(loop_vars), list(captured))
+    out = out if isinstance(out, tuple) else (out,)
+    return jax.tree_util.tree_unflatten(meta[0], list(out))
 
 
 def scan(f, init, xs):
@@ -528,15 +615,8 @@ def scan(f, init, xs):
 
     def _fn(init_raw, xs_raw, cap_vals):
         def body(c, x):
-            saved = [t._value for t in captured]
-            try:
-                for t, v in zip(captured, cap_vals):
-                    t._value = v
-                with no_grad_ctx():  # the outer vjp owns differentiation
-                    new_c, y = f(_wrap_tree(c), _wrap_tree(x))
-            finally:
-                for t, s in zip(captured, saved):
-                    t._value = s
+            with _substituted(captured, cap_vals):
+                new_c, y = f(_wrap_tree(c), _wrap_tree(x))
             return _unwrap_tree(new_c), _unwrap_tree(y)
 
         carry, ys = jax.lax.scan(body, init_raw, xs_raw)

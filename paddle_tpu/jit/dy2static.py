@@ -31,7 +31,8 @@ import ast
 import inspect
 import textwrap
 
-__all__ = ["convert_function", "_cvt_ifelse", "_cvt_while"]
+__all__ = ["convert_function", "_cvt_ifelse", "_cvt_while",
+           "_cvt_for_range"]
 
 _HELPERS = "__paddle_tpu_dy2static_helpers__"
 
@@ -498,6 +499,11 @@ class _Rewriter(ast.NodeTransformer):
         (reference: dygraph_to_static loop_transformer + convert_range).
         Everything else (iterating lists, tensors with static leading
         dim, enumerate, zip, shadowed ``range``) is left untouched."""
+        # user-level stores, captured BEFORE generic_visit: inner
+        # if/while rewrites fabricate tuple-assign stores of every name
+        # they carry (including read-only ones like this loop's var),
+        # which would spuriously trip the rebinding bail below
+        stores = _assigned_names(node.body)
         self.generic_visit(node)
         if self.range_shadowed:
             return node  # a user `range` binding: name-match is unsound
@@ -506,14 +512,14 @@ class _Rewriter(ast.NodeTransformer):
         it = node.iter
         if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
                 and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3):
+                and 1 <= len(it.args) <= 3
+                and not any(isinstance(a, ast.Starred) for a in it.args)):
             return node
         try:
             _check_supported(node.body)
         except _Unsupported:
             return node
         tgt = node.target.id
-        stores = _assigned_names(node.body)
         if tgt in stores:
             # `for i ...: i = ...` — body rebinding of the loop var has
             # observable post-loop semantics the closure drop would lose

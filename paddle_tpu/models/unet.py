@@ -169,12 +169,18 @@ class UNet2DConditionModel(nn.Layer):
 
         self.conv_norm_out = nn.GroupNorm(min(g, ch[0]), ch[0])
         self.conv_out = nn.Conv2D(ch[0], config.out_channels, 3, padding=1)
+        if config.dtype != "float32":
+            self.astype(config.dtype)
 
     def forward(self, sample, timestep, encoder_hidden_states):
         cfg = self.config
+        # sinusoid computed in f32 for precision, then cast to whatever
+        # dtype the weights actually hold (cfg.dtype, a later .bfloat16()
+        # or .half() — all routes change the parameter dtype)
+        wdt = self.time_embed[0].weight._value.dtype
         temb = apply("timestep_embed",
                      lambda t: timestep_embedding(
-                         t, cfg.block_out_channels[0]),
+                         t, cfg.block_out_channels[0]).astype(wdt),
                      timestep, _differentiable=False)
         temb = self.time_embed(temb)
 

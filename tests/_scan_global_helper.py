@@ -1,0 +1,28 @@
+"""Helper module: a MODULE-GLOBAL layer used inside a jit.scan body.
+
+Closure-cell capture cannot see `_lin` (it is a global of the body
+function, not a cell); _collect_captured_params must scan referenced
+globals or backward silently misses the weights.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+_lin = nn.Linear(4, 4)
+
+
+def _body(c, x):
+    return paddle.tanh(_lin(c) + x), c
+
+
+def run_scan_and_grad():
+    xs = paddle.to_tensor(
+        np.random.RandomState(0).randn(3, 2, 4).astype(np.float32))
+    init = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    carry, _ = jit.scan(_body, init, xs)
+    carry.square().mean().backward()
+    g = _lin.weight.grad
+    out = None if g is None else float(g.abs().sum().numpy())
+    _lin.clear_gradients()
+    return out

@@ -46,6 +46,23 @@ class TestUNet:
         out.mean().backward()
         assert unet.conv_in.weight.grad is not None
 
+    def test_bfloat16_config(self):
+        # cfg.dtype="bfloat16" (the SDXL bench config) must cast weights
+        # AND the f32 sinusoid timestep embedding; regression for the TPU
+        # bench failure "conv_general_dilated requires ... same dtypes"
+        import jax.numpy as jnp
+
+        from paddle_tpu.models import UNet2DConditionModel, UNetConfig
+
+        unet = UNet2DConditionModel(UNetConfig.tiny(dtype="bfloat16"))
+        unet.eval()
+        sample = paddle.to_tensor(jnp.asarray(r(1, 4, 8, 8), jnp.bfloat16))
+        t = paddle.to_tensor(np.array([10], "int32"))
+        ctx = paddle.to_tensor(jnp.asarray(r(1, 4, 32), jnp.bfloat16))
+        out = jit.to_static(lambda s, t, c: unet(s, t, c))(sample, t, ctx)
+        assert out.shape == [1, 4, 8, 8]
+        assert "bfloat16" in str(out.dtype)
+
     def test_serving_export(self, tmp_path):
         from paddle_tpu.inference import Config, create_predictor
         from paddle_tpu.models import UNet2DConditionModel, UNetConfig
