@@ -436,6 +436,16 @@ def run_bench():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4) if mfu is not None else None,
     }
+    if os.environ.get("BENCH_EXTRAS", "1") == "0":
+        # sweep experiments only move the headline; skipping the extras
+        # keeps each run ~5 min so a whole flash-block sweep fits inside
+        # one tunnel-up window (the flaky tunnel is the scarce resource)
+        _emit(headline)
+        print(f"# extras skipped (BENCH_EXTRAS=0); model="
+              f"{n_params/1e6:.1f}M batch={batch} seq={seq} "
+              f"step_time={dt/steps*1000:.1f}ms backend={backend}",
+              file=sys.stderr)
+        return
     extra = {}
     emit_lock = threading.Lock()
     emitted = []
@@ -459,8 +469,12 @@ def run_bench():
         os._exit(0)
 
     # generous: 5 extras, two of which compile full models on TPU — this
-    # guards against HANGS (dead tunnel), not slow-but-healthy phases
-    watchdog = threading.Timer(900.0 if on_tpu else 480.0, _watchdog_fire)
+    # guards against HANGS (dead tunnel), not slow-but-healthy phases.
+    # BENCH_EXTRAS_BUDGET lets the experiment queue afford all five
+    # configs through a slow tunnel (driver runs keep the default).
+    extras_budget = float(os.environ.get(
+        "BENCH_EXTRAS_BUDGET", 900.0 if on_tpu else 480.0))
+    watchdog = threading.Timer(extras_budget, _watchdog_fire)
     watchdog.daemon = True
     watchdog.start()
     try:
