@@ -62,6 +62,34 @@ def _is_arrayish(v):
         hasattr(v, "aval") and hasattr(v, "dtype"))
 
 
+@functools.lru_cache(maxsize=4096)
+def _code_global_names(code) -> tuple:
+    """Names a code object (incl. NESTED code objects) reads via
+    LOAD_GLOBAL/LOAD_NAME.  A layer referenced only inside a local
+    helper (`def body(i, acc): return i+1, acc+lin(x)`) is just as
+    load-bearing as one named at the top level — missing it silently
+    discards its weight updates AND leaks the trace tracer into the
+    live param.  LOAD_GLOBAL only (co_names also holds attribute names,
+    which must not pull in unrelated same-named globals).  Memoized per
+    code object: callers run per jit.cond/while_loop/scan invocation."""
+    import dis
+
+    names, codes = [], [code]
+    while codes:
+        c = codes.pop()
+        for ins in dis.get_instructions(c):
+            if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
+                names.append(ins.argval)
+        codes.extend(k for k in c.co_consts
+                     if isinstance(k, types.CodeType))
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return tuple(out)
+
+
 def _referenced_objects(obj):
     """Objects a function can reach: bound self, closure cells, and the
     module globals its code names.  This is how the trace discovers which
@@ -75,7 +103,7 @@ def _referenced_objects(obj):
     code = getattr(fn, "__code__", None)
     if code is not None:
         g = getattr(fn, "__globals__", {})
-        for name in code.co_names:
+        for name in _code_global_names(code):
             if name in g:
                 out.append(g[name])
         for cell in (fn.__closure__ or ()):
@@ -446,24 +474,15 @@ def _collect_captured_params(fn, seen=None, depth=0):
             continue
     # module-global tensors/layers the code references by NAME (a
     # module-level ``lin = nn.Linear(...)`` used inside the body is just
-    # as load-bearing as a closure cell).  Only true LOAD_GLOBAL names
-    # count — co_names also holds ATTRIBUTE names, and `h.w` must not
-    # promote an unrelated module-global `w`.
+    # as load-bearing as a closure cell); _code_global_names scans
+    # LOAD_GLOBALs of the body's (possibly nested) code objects.
     code = getattr(fn, "__code__", None)
     glob = getattr(fn, "__globals__", None)
     if code is not None and glob is not None:
-        import dis
-
-        codes = [code]  # incl. nested defs (their refs live in their
-        while codes:    # own code objects inside co_consts)
-            c = codes.pop()
-            for ins in dis.get_instructions(c):
-                if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
-                    v = glob.get(ins.argval)
-                    if isinstance(v, (Tensor, Layer)):
-                        _collect_from_value(v, seen, depth)
-            codes.extend(k for k in c.co_consts
-                         if isinstance(k, types.CodeType))
+        for nm in _code_global_names(code):
+            v = glob.get(nm)
+            if isinstance(v, (Tensor, Layer)):
+                _collect_from_value(v, seen, depth)
     return seen
 
 
@@ -541,23 +560,75 @@ def cond(pred, true_fn, false_fn, *operands):
     return _tape_cond(pred, true_fn, false_fn, operands)
 
 
-def while_loop(cond_fn, body_fn, loop_vars):
+def while_loop(cond_fn, body_fn, loop_vars, maximum_trip_count=None):
     """Functional while lowered to XLA While (reference: while_loop:1167).
 
-    Forward-only by backend design: XLA While has no static trip count,
-    so reverse mode cannot stage the per-iteration residuals.  The loop
-    rides the tape as ONE op whose vjp RAISES — backward through it is a
-    loud NotImplementedError instead of silently-zero gradients (the
-    reference's static While IS differentiable via a while_grad stack,
-    so silence here would be silently-wrong training math).  Captured
-    layer weights are promoted to operands exactly so that backward
-    finds the op and fails loudly even when no explicit loop var
-    requires grad."""
+    Without ``maximum_trip_count``, forward-only by backend design: XLA
+    While has no static trip count, so reverse mode cannot stage the
+    per-iteration residuals.  The loop rides the tape as ONE op whose
+    vjp RAISES — backward through it is a loud NotImplementedError
+    instead of silently-zero gradients (the reference's static While IS
+    differentiable via a while_grad stack, so silence here would be
+    silently-wrong training math).  Captured layer weights are promoted
+    to operands exactly so that backward finds the op and fails loudly
+    even when no explicit loop var requires grad.
+
+    With ``maximum_trip_count=N`` the loop lowers to a bounded
+    ``lax.scan`` of length N with a predicate mask — fully reverse-
+    differentiable (the TPU-native analog of the reference's
+    while_grad stack, which stages residuals dynamically).  Semantics:
+    the state stops updating once the predicate goes false; if the
+    predicate is still true after N trips the loop TRUNCATES at N (pick
+    N as a real upper bound).  Cost is N body evaluations regardless of
+    the dynamic trip count."""
     from ..core.dispatch import apply
 
     captured = list({**_collect_captured_params(cond_fn),
                      **_collect_captured_params(body_fn)}.values())
     meta = []
+
+    if maximum_trip_count is not None:
+        n = int(maximum_trip_count)
+        if n < 0:
+            raise ValueError("maximum_trip_count must be >= 0")
+
+        def _fn_bounded(loop_vals, cap_vals):
+            # canonicalize so both lax.cond branches produce identical
+            # avals (python-int loop vars would come back weakly typed
+            # from one branch and strongly from the other)
+            init = tuple(jnp.asarray(v) for v in loop_vals)
+
+            def run_body(state):
+                with _substituted(captured, cap_vals):
+                    res = body_fn(*_wrap_tree(state))
+                if not isinstance(res, (tuple, list)):
+                    res = (res,)
+                new = tuple(_unwrap_tree(tuple(res)))
+                return tuple(jnp.asarray(v).astype(s.dtype)
+                             for v, s in zip(new, state))
+
+            def step(state, _):
+                with _substituted(captured, cap_vals):
+                    pred = _as_raw(cond_fn(*_wrap_tree(state)))
+                # lax.cond, NOT a jnp.where mask: the untaken branch's
+                # vjp never runs, so a body that would produce inf/NaN
+                # on the frozen post-termination state (e.g. t/(n-i))
+                # cannot poison gradients with 0*inf — the classic
+                # where-NaN trap — and masked-out iterations skip the
+                # body's FLOPs at runtime too.
+                return jax.lax.cond(pred, run_body, lambda st: st,
+                                    state), None
+
+            final, _ = jax.lax.scan(step, init, None, length=n)
+            flat, td = jax.tree_util.tree_flatten(final)
+            if not meta:
+                meta.append(td)
+            return tuple(flat)
+
+        out = apply("jit_while_bounded", _fn_bounded, list(loop_vars),
+                    list(captured))
+        out = out if isinstance(out, tuple) else (out,)
+        return jax.tree_util.tree_unflatten(meta[0], list(out))
 
     @jax.custom_vjp
     def _run(loop_raw, cap_vals):
@@ -584,9 +655,11 @@ def while_loop(cond_fn, body_fn, loop_vars):
             "reverse-mode gradient through jit.while_loop (or a "
             "dy2static while / for-range over a Tensor bound) is not "
             "supported: XLA While has no static trip count to stage "
-            "residuals over.  Use a python-int loop bound (unrolls at "
-            "trace time), jit.scan over a fixed length, or run the loop "
-            "under paddle.no_grad().")
+            "residuals over.  Use jit.while_loop(..., "
+            "maximum_trip_count=N) (bounded scan, differentiable), a "
+            "python-int loop bound (unrolls at trace time), jit.scan "
+            "over a fixed length, or run the loop under "
+            "paddle.no_grad().")
 
     _run.defvjp(_fwd, _bwd)
 

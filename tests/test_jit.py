@@ -576,6 +576,78 @@ class TestDy2StaticAST:
 
         assert fwd(x, paddle.to_tensor(np.int32(2))).shape == [2, 4]
 
+    def test_bounded_while_loop_differentiable(self):
+        """maximum_trip_count=N lowers to a masked lax.scan — fully
+        reverse-differentiable (TPU-native analog of the reference's
+        while_grad stack); state freezes when the predicate goes false,
+        truncates at N otherwise."""
+        w = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        x = paddle.to_tensor(np.ones(3, np.float32) * 2.0,
+                             stop_gradient=False)
+        i, acc = jit.while_loop(
+            lambda i, a: i < 3, lambda i, a: (i + 1, a + w * x),
+            [paddle.to_tensor(np.int32(0)), paddle.zeros([3])],
+            maximum_trip_count=8)
+        assert int(i.numpy()) == 3
+        acc.sum().backward()
+        np.testing.assert_allclose(w.grad.numpy(), 18.0)   # 3 * sum(x)
+        np.testing.assert_allclose(x.grad.numpy(), 1.5)    # 3 * w
+
+        i, = jit.while_loop(lambda i: i < 100, lambda i: i + 1,
+                            [paddle.to_tensor(np.int32(0))],
+                            maximum_trip_count=5)
+        assert int(i.numpy()) == 5  # truncation at the bound
+
+    def test_bounded_while_no_nan_through_masked_iters(self):
+        """The bound lowers to scan-of-cond, NOT a jnp.where mask: a body
+        producing inf on the frozen post-termination state (t/0 here)
+        must not poison gradients via the 0*inf where-NaN trap."""
+        t = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        n = paddle.to_tensor(np.int32(3))
+        _, acc = jit.while_loop(
+            lambda i, a: i < n,
+            lambda i, a: (i + 1, a + t / (n - i).astype("float32")),
+            [paddle.to_tensor(np.int32(0)),
+             paddle.to_tensor(np.float32(0.0))],
+            maximum_trip_count=6)
+        acc.backward()
+        g = float(t.grad.numpy())
+        assert np.isfinite(g)
+        np.testing.assert_allclose(g, 1 / 3 + 1 / 2 + 1.0, rtol=1e-6)
+
+    def test_bounded_while_trains_under_to_static(self):
+        """The whole train step — bounded while + backward + optimizer —
+        compiles and WEIGHT UPDATES PERSIST.  Regression: layers
+        referenced only inside a nested body fn were invisible to
+        to_static's state discovery (top-level co_names only), so their
+        updates were silently discarded and call 2 crashed on the leaked
+        trace tracer (review r4 verify drive)."""
+        lin = nn.Linear(4, 4)
+        opt = Adam(learning_rate=0.05, parameters=lin.parameters())
+        w_before = lin.weight.numpy().copy()
+
+        @jit.to_static
+        def step(x, n):
+            def body(i, acc):
+                return i + 1, acc + lin(x)  # lin ONLY in the nested fn
+
+            _, acc = jit.while_loop(lambda i, a: i < n, body,
+                                    [paddle.to_tensor(np.int32(0)),
+                                     paddle.zeros_like(x)],
+                                    maximum_trip_count=6)
+            loss = (acc * acc).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype(np.float32))
+        n = paddle.to_tensor(np.int32(3))
+        losses = [float(step(x, n).numpy()) for _ in range(15)]
+        assert losses[-1] < losses[0], losses
+        assert not np.allclose(lin.weight.numpy(), w_before)
+
     def test_scan_module_global_weights_get_grads(self):
         """Capture collection must see MODULE-GLOBAL layers too (not just
         closure cells): a script-level `lin = nn.Linear(...)` used inside
