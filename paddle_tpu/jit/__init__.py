@@ -224,10 +224,14 @@ class StaticFunction:
     """Compiled callable with an input-spec cache (the ConcreteProgram cache
     analog, reference: program_translator.py)."""
 
-    def __init__(self, fn, input_spec=None, **unused):
+    def __init__(self, fn, input_spec=None, loop_max_trips=None, **unused):
         self._fn = fn
         self._traced_fn = None  # dy2static-converted clone, built lazily
         self._input_spec = input_spec
+        # bound for tensor-condition python loops: lowers them to the
+        # differentiable bounded while (scan-of-cond) so reference-style
+        # training scripts with data-dependent loops work end to end
+        self._loop_max_trips = loop_max_trips
         self._cache: Dict[Any, Any] = {}
         self._bound_cache: Dict[int, "StaticFunction"] = {}
         self._layers = None
@@ -255,7 +259,8 @@ class StaticFunction:
         bound = self._bound_cache.get(id(instance))
         if bound is None:
             bound = StaticFunction(self._fn.__get__(instance, owner),
-                                   self._input_spec)
+                                   self._input_spec,
+                                   loop_max_trips=self._loop_max_trips)
             self._bound_cache[id(instance)] = bound
         return bound
 
@@ -301,7 +306,13 @@ class StaticFunction:
         lrs = np.asarray([opt.get_lr() for opt in state.optimizers],
                          np.float32)
         rng_key = np.asarray(rnd.default_generator().next_key())
-        return entry.run(state, dyn_vals, lrs, rng_key)
+        from .dy2static import _LOOP_MAX_TRIPS
+
+        _LOOP_MAX_TRIPS.append(self._loop_max_trips)
+        try:
+            return entry.run(state, dyn_vals, lrs, rng_key)
+        finally:
+            _LOOP_MAX_TRIPS.pop()
 
     # ----- parity helpers
     @property
@@ -401,16 +412,24 @@ class _TracedLR(float):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """Decorator/wrapper compiling a function or Layer to XLA."""
+              backend=None, loop_max_trips=None, **kwargs):
+    """Decorator/wrapper compiling a function or Layer to XLA.
+
+    ``loop_max_trips=N`` bounds tensor-condition python loops (dy2static
+    while / for-range over a Tensor) so they lower to the differentiable
+    bounded while (scan-of-cond) instead of forward-only XLA While —
+    training scripts with data-dependent loops then work unchanged."""
     if isinstance(function, Layer):
-        function.forward = StaticFunction(function.forward, input_spec)
+        function.forward = StaticFunction(function.forward, input_spec,
+                                          loop_max_trips=loop_max_trips)
         return function
     if function is not None:
-        return StaticFunction(function, input_spec)
+        return StaticFunction(function, input_spec,
+                              loop_max_trips=loop_max_trips)
 
     def deco(fn):
-        return to_static(fn, input_spec, build_strategy, backend, **kwargs)
+        return to_static(fn, input_spec, build_strategy, backend,
+                         loop_max_trips=loop_max_trips, **kwargs)
     return deco
 
 

@@ -36,6 +36,13 @@ __all__ = ["convert_function", "_cvt_ifelse", "_cvt_while",
 
 _HELPERS = "__paddle_tpu_dy2static_helpers__"
 
+# ambient loop bound (stack: nested to_static calls may differ), set by
+# to_static(loop_max_trips=N) for the duration of a call: tensor-bound
+# while/for-range lower to the BOUNDED differentiable while_loop
+# (scan-of-cond) instead of forward-only XLA While — reference scripts
+# that train through data-dependent python loops work with one kwarg.
+_LOOP_MAX_TRIPS = [None]
+
 
 def _is_tensorish(x):
     from ..core.tensor import Tensor
@@ -160,7 +167,8 @@ def _cvt_while(cond_fn, body_fn, args, names=(), n_stores=None):
             out = out if isinstance(out, tuple) else (out,)
             return tuple(out[i] for i in op_idx)
 
-        real_out = while_loop(c2, b2, [args[i] for i in op_idx])
+        real_out = while_loop(c2, b2, [args[i] for i in op_idx],
+                              maximum_trip_count=_LOOP_MAX_TRIPS[-1])
         res = list(args)
         for i, v in zip(op_idx, real_out):
             res[i] = v
@@ -229,7 +237,8 @@ def _cvt_for_range(start, stop, step, body_fn, prior, args, names=(),
         out = out if isinstance(out, tuple) else (out,)
         return (i + step,) + tuple(out[k] for k in op_idx)
 
-    state = while_loop(c2, b2, [start] + [args[i] for i in op_idx])
+    state = while_loop(c2, b2, [start] + [args[i] for i in op_idx],
+                       maximum_trip_count=_LOOP_MAX_TRIPS[-1])
     i_fin, real_out = state[0], state[1:]
     res = list(args)
     for i, v in zip(op_idx, real_out):

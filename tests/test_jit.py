@@ -545,6 +545,50 @@ class TestDy2StaticAST:
         out = jit.to_static(f)(x, paddle.to_tensor(np.int32(4)))
         np.testing.assert_allclose(out.numpy(), float(sum(range(4))))
 
+    def test_loop_max_trips_trains_through_python_loops(self):
+        """to_static(loop_max_trips=N): reference-style training scripts
+        with data-dependent python loops (for-range over a Tensor, while
+        over a Tensor condition) become differentiable — the dy2static
+        rewrite lowers them to the bounded while (scan-of-cond)."""
+        lin = nn.Linear(4, 4)
+        opt = Adam(learning_rate=0.05, parameters=lin.parameters())
+
+        @jit.to_static(loop_max_trips=8)
+        def step(x, n):
+            acc = paddle.zeros_like(x)
+            for i in range(n):
+                acc = acc + lin(x)
+            loss = (acc * acc).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype(np.float32))
+        n = paddle.to_tensor(np.int32(3))
+        losses = [float(step(x, n).numpy()) for _ in range(15)]
+        assert losses[-1] < losses[0], losses
+
+        lin2 = nn.Linear(4, 4)
+        opt2 = Adam(learning_rate=0.05, parameters=lin2.parameters())
+
+        @jit.to_static(loop_max_trips=6)
+        def step2(x):
+            acc = paddle.zeros_like(x)
+            k = paddle.to_tensor(np.float32(0))
+            while paddle.sum(k) < 3.0:
+                acc = acc + lin2(x)
+                k = k + 1.0
+            loss = (acc * acc).mean()
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            return loss
+
+        losses2 = [float(step2(x).numpy()) for _ in range(15)]
+        assert losses2[-1] < losses2[0], losses2
+
     def test_while_loop_backward_raises_loudly(self):
         """XLA While has no static trip count — reverse mode CANNOT work.
         The reference's static While IS differentiable (while_grad
