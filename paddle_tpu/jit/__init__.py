@@ -516,6 +516,9 @@ def _collect_from_value(v, seen, depth):
     elif isinstance(v, (list, tuple)) and len(v) <= 64:
         for e in v:
             _collect_from_value(e, seen, depth)
+    elif isinstance(v, dict) and len(v) <= 64:
+        for e in v.values():
+            _collect_from_value(e, seen, depth)
     elif callable(v) and (getattr(v, "__closure__", None)
                           or getattr(v, "__code__", None)):
         _collect_captured_params(v, seen, depth + 1)

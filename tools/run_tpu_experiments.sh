@@ -45,6 +45,15 @@ run() {
 # (20260731T0316Z) already exists in-tree for cross-stamp comparison.
 run batch16 BENCH_BATCH=16 BENCH_EXTRAS=0
 run autotune FLAGS_use_autotune=1 BENCH_EXTRAS=0
+# preserve the on-chip tile search results in-tree (evidence + lets the
+# winning configs be promoted to static defaults later)
+AUTOTUNE_CACHE="${PADDLE_TPU_CACHE_DIR:-$HOME/.cache/paddle_tpu}/autotune.json"
+if [ -f "${AUTOTUNE_CACHE}" ]; then
+  cp "${AUTOTUNE_CACHE}" "BENCH_LOCAL_${STAMP}_autotune_cache.json"
+  git add "BENCH_LOCAL_${STAMP}_autotune_cache.json"
+  git commit -q -m "bench: autotune cache snapshot (${STAMP})" \
+    -- "BENCH_LOCAL_${STAMP}_autotune_cache.json" || true
+fi
 run flash_q512k512 FLAGS_flash_block_q=512 FLAGS_flash_block_k=512 BENCH_EXTRAS=0
 run flash_q128k512 FLAGS_flash_block_q=128 FLAGS_flash_block_k=512 BENCH_EXTRAS=0
 run flash_q256k1024 FLAGS_flash_block_q=256 FLAGS_flash_block_k=1024 BENCH_EXTRAS=0
