@@ -82,10 +82,23 @@ class PyLayerContext:
 
     @property
     def saved_tensor(self):
-        return self._saved
+        # the reference API is a METHOD (`(x,) = ctx.saved_tensor()`,
+        # /root/reference/python/paddle/autograd/py_layer.py:91) but
+        # attribute-style access is a common user mistake the property
+        # form also served — a callable tuple satisfies both.
+        return _SavedTensors(self._saved)
 
-    def saved_tensors(self):
-        return self._saved
+    @property
+    def saved_tensors(self):  # torch-style alias (property there)
+        return _SavedTensors(self._saved)
+
+
+class _SavedTensors(tuple):
+    """Tuple of saved tensors that can also be CALLED (reference's
+    ``ctx.saved_tensor()`` method form)."""
+
+    def __call__(self):
+        return tuple(self)
 
 
 class _PyLayerMeta(type):

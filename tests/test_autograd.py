@@ -185,6 +185,29 @@ class TestPyLayer:
         y.backward()
         np.testing.assert_allclose(x.grad.numpy(), [12.0])
 
+    def test_saved_tensor_is_callable_like_reference(self):
+        """The reference API is a METHOD — `(x,) = ctx.saved_tensor()`
+        (/root/reference/python/paddle/autograd/py_layer.py:91); the
+        attribute form also keeps working, and torch-style
+        `ctx.saved_tensors` is a property alias."""
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()       # reference method form
+                (x2,) = ctx.saved_tensor        # attribute form
+                (x3,) = ctx.saved_tensors       # torch-style property
+                assert x is x2 is x3
+                return grad * 2.0 * x
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        Sq.apply(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
     def test_pylayer_multi_output(self):
         class SplitOp(PyLayer):
             @staticmethod
