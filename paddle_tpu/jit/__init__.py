@@ -292,7 +292,15 @@ class StaticFunction:
         dyn_vals = [flat[i] for i in dyn_idx]
         static_flat = [None if i in dyn_idx else v for i, v in enumerate(flat)]
 
-        key = (_spec_key(static_flat, treedef, dyn_vals), state.signature())
+        # train/eval mode is part of the program (dropout identity, BN
+        # statistics source), not a traced value — a .eval() flip after
+        # compilation must select/build a different executable, or the
+        # train-mode program keeps running silently
+        mode_key = tuple(sl.training
+                         for layer in self._layers
+                         for sl in layer.sublayers(include_self=True))
+        key = (_spec_key(static_flat, treedef, dyn_vals), state.signature(),
+               mode_key)
         entry = self._cache.get(key)
         if entry is None:
             entry = _CompiledEntry(self._trace_target(), state, treedef,

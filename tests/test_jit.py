@@ -545,6 +545,27 @@ class TestDy2StaticAST:
         out = jit.to_static(f)(x, paddle.to_tensor(np.int32(4)))
         np.testing.assert_allclose(out.numpy(), float(sum(range(4))))
 
+    def test_eval_mode_flip_selects_new_executable(self):
+        """train/eval is part of the program: a .eval() after compiling
+        in train mode must not keep running the train-mode executable
+        (dropout kept dropping — review r4 composition probe)."""
+        drop = nn.Dropout(0.5)
+
+        @jit.to_static
+        def f(x):
+            return drop(x)
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(16, 16).astype(np.float32))
+        a = f(x).numpy()
+        drop.eval()
+        c = f(x).numpy()
+        np.testing.assert_allclose(c, x.numpy())  # identity in eval
+        drop.train()
+        b = f(x).numpy()
+        assert not np.allclose(a, b)  # fresh mask per train call
+        assert len(f._cache) >= 2  # distinct executables per mode
+
     def test_loop_max_trips_trains_through_python_loops(self):
         """to_static(loop_max_trips=N): reference-style training scripts
         with data-dependent python loops (for-range over a Tensor, while
