@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 _py_slice = slice  # builtin, captured before the paddle `slice` op shadows it
+_py_all = all      # ditto for the paddle `all` reduction
 
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -44,7 +45,18 @@ def _unwrap_index(idx):
     if isinstance(idx, tuple):
         return tuple(_unwrap_index(i) for i in idx)
     if isinstance(idx, list):
-        return [_unwrap_index(i) for i in idx]
+        vals = [_unwrap_index(i) for i in idx]
+        # reference semantics: a list index is a FANCY index (gather) —
+        # `x[[0, 2]]` selects rows 0 and 2.  jax rejects raw non-tuple
+        # sequences, so materialize as an array; a list containing
+        # slices/None/... falls back to tuple (numpy-deprecated form).
+        if _py_all(v is not None and v is not Ellipsis
+                   and not isinstance(v, _py_slice) for v in vals):
+            try:
+                return np.asarray(vals)
+            except (ValueError, TypeError):
+                pass
+        return tuple(vals)
     if isinstance(idx, _py_slice):
         def iv(v):
             if isinstance(v, Tensor):

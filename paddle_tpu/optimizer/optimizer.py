@@ -11,6 +11,8 @@ inline into the whole-step program.
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 from typing import Dict, List, Optional
 
 import jax
@@ -278,20 +280,33 @@ class Optimizer:
                   enumerate(self._collect_params_grads())}
         for acc_name, store in self._accumulators.items():
             for pid, arr in store.items():
-                state[f"{acc_name}_{params.get(pid, pid)}"] = Tensor(arr)
+                # SNAPSHOT semantics: jnp.array copies into a fresh
+                # buffer — the live slot array gets DONATED by the next
+                # compiled step, and a reference to it would turn into
+                # "Array has been deleted" at save time
+                state[f"{acc_name}_{params.get(pid, pid)}"] = Tensor(
+                    jnp.array(arr))
         state["@step"] = self._step_count
+        # the DEVICE step counter drives bias correction (adam rules use
+        # _global_state['step'] + 1); restoring only _step_count would
+        # silently restart the correction schedule — the resume
+        # trajectory then diverges from the uninterrupted one
+        state["@global_step"] = int(np.asarray(self._global_state["step"]))
         if self._lr_scheduler is not None:
             state["LR_Scheduler"] = self._lr_scheduler.state_dict()
         return state
 
     def set_state_dict(self, state):
         self._step_count = int(state.get("@step", 0))
+        self._global_state["step"] = jnp.asarray(
+            int(state.get("@global_step", state.get("@step", 0))),
+            jnp.int64 if jnp.asarray(
+                self._global_state["step"]).dtype == jnp.int64
+            else jnp.int32)
         params = {name_i: p for name_i, (p, _, _) in
                   enumerate(self._collect_params_grads())}
-        for acc_name, store in self._accumulators.items():
-            pass
         for key, value in state.items():
-            if key in ("@step",):
+            if key in ("@step", "@global_step"):
                 continue
             if key == "LR_Scheduler" and self._lr_scheduler is not None:
                 self._lr_scheduler.set_state_dict(value)

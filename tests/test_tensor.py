@@ -73,6 +73,22 @@ class TestIndexing:
         idx = paddle.to_tensor([1, 3, 5])
         np.testing.assert_array_equal(x[idx].numpy(), [1, 3, 5])
 
+    def test_list_fancy_index(self):
+        """Reference idiom: a LIST index is a gather — `x[[0, 2]]` picks
+        rows 0 and 2 (jax itself rejects raw list indices; the index
+        layer must materialize them), and gradients scatter back."""
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+        np.testing.assert_array_equal(x[[0, 2]].numpy(),
+                                      x.numpy()[[0, 2]])
+        np.testing.assert_array_equal(x[[2, 0], [1, 3]].numpy(),
+                                      x.numpy()[[2, 0], [1, 3]])
+        t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                             stop_gradient=False)
+        (t[[0, 2]] ** 2).sum().backward()
+        expect = np.zeros((3, 4), np.float32)
+        expect[[0, 2]] = 2 * t.numpy()[[0, 2]]
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
     def test_bool_mask_getitem(self):
         x = paddle.to_tensor(np.arange(4).astype(np.float32))
         # boolean masks are data-dependent: allowed eagerly
