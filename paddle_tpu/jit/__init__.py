@@ -236,6 +236,9 @@ class StaticFunction:
         self._bound_cache: Dict[int, "StaticFunction"] = {}
         self._layers = None
         self._optimizers = None
+        self._mode_layers = None
+        self._state = None
+        self._state_version = -1
         functools.update_wrapper(self, fn, updated=[])
 
     def _trace_target(self):
@@ -282,7 +285,16 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         if self._layers is None:
             self._discover(args, kwargs)
-        state = _State(self._layers, self._optimizers)
+        if self._state is None or \
+                self._state_version != Layer._structure_version:
+            # param/buffer handle lists are stable until SOME layer
+            # mutates structurally (cheap global int compare); the
+            # VALUES are read through the handles each call (read()),
+            # and opt slots are re-walked in signature()/opt_slots()
+            self._state = _State(self._layers, self._optimizers)
+            self._state_version = Layer._structure_version
+            self._mode_layers = None  # sublayer list may have changed
+        state = self._state
 
         raw_tree = jax.tree_util.tree_map(
             lambda x: x._value if isinstance(x, Tensor) else x, (args, kwargs),
@@ -295,10 +307,13 @@ class StaticFunction:
         # train/eval mode is part of the program (dropout identity, BN
         # statistics source), not a traced value — a .eval() flip after
         # compilation must select/build a different executable, or the
-        # train-mode program keeps running silently
-        mode_key = tuple(sl.training
-                         for layer in self._layers
-                         for sl in layer.sublayers(include_self=True))
+        # train-mode program keeps running silently.  The sublayer LIST
+        # is cached (stable per discovery); the flags are read per call.
+        if self._mode_layers is None:
+            self._mode_layers = [sl for layer in self._layers
+                                 for sl in layer.sublayers(
+                                     include_self=True)]
+        mode_key = tuple(sl.training for sl in self._mode_layers)
         key = (_spec_key(static_flat, treedef, dyn_vals), state.signature(),
                mode_key)
         entry = self._cache.get(key)
@@ -313,7 +328,9 @@ class StaticFunction:
         # values are treated as replicated (same on every process)
         lrs = np.asarray([opt.get_lr() for opt in state.optimizers],
                          np.float32)
-        rng_key = np.asarray(rnd.default_generator().next_key())
+        # host-derived key data (counter XOR seed): no traced op per call
+        # — and identically replicated across multi-controller processes
+        rng_key = rnd.default_generator().next_key_data()
         from .dy2static import _LOOP_MAX_TRIPS
 
         _LOOP_MAX_TRIPS.append(self._loop_max_trips)

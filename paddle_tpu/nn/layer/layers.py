@@ -27,6 +27,12 @@ class HookRemoveHelper:
 
 
 class Layer:
+    # global structural version: bumped whenever ANY layer gains a
+    # parameter/sublayer/buffer, so jit.to_static can cheaply invalidate
+    # its cached state-handle lists (int compare per call; rebuilds are
+    # rare post-construction)
+    _structure_version = 0
+
     def __init__(self, name_scope: Optional[str] = None, dtype=None):
         self.training = True
         self._dtype = dtype or get_default_dtype()
@@ -47,6 +53,7 @@ class Layer:
         if isinstance(value, Parameter):
             if params is None:
                 raise RuntimeError("call Layer.__init__ before assigning parameters")
+            Layer._structure_version += 1
             params[name] = value
             for d in (layers, buffers):
                 if d is not None:
@@ -55,6 +62,7 @@ class Layer:
         elif isinstance(value, Layer):
             if layers is None:
                 raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            Layer._structure_version += 1
             layers[name] = value
             for d in (params, buffers):
                 if d is not None:
@@ -82,6 +90,7 @@ class Layer:
         for store in ("_parameters", "_sub_layers", "_buffers"):
             d = self.__dict__.get(store)
             if d is not None and name in d:
+                Layer._structure_version += 1
                 del d[name]
                 return
         object.__delattr__(self, name)
@@ -119,6 +128,7 @@ class Layer:
         return Tensor(jnp.zeros((), to_np(dtype or self._dtype)), name=name)
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        Layer._structure_version += 1
         if parameter is None:
             self._parameters[name] = None
         else:
@@ -126,10 +136,12 @@ class Layer:
         return parameter
 
     def add_sublayer(self, name: str, sublayer: "Layer"):
+        Layer._structure_version += 1
         self._sub_layers[str(name)] = sublayer
         return sublayer
 
     def register_buffer(self, name: str, tensor: Tensor, persistable: bool = True):
+        Layer._structure_version += 1
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)

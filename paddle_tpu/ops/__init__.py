@@ -48,14 +48,17 @@ def _unwrap_index(idx):
         vals = [_unwrap_index(i) for i in idx]
         # reference semantics: a list index is a FANCY index (gather) —
         # `x[[0, 2]]` selects rows 0 and 2.  jax rejects raw non-tuple
-        # sequences, so materialize as an array; a list containing
-        # slices/None/... falls back to tuple (numpy-deprecated form).
+        # sequences, so materialize as an array.  Tensor/tracer elements
+        # must STACK (np.asarray raises TracerArrayConversionError under
+        # a trace, and a tuple fallback would silently turn the gather
+        # into multi-axis indexing); only a list containing slices/
+        # None/... falls back to tuple (numpy-deprecated form).
         if _py_all(v is not None and v is not Ellipsis
                    and not isinstance(v, _py_slice) for v in vals):
             try:
                 return np.asarray(vals)
             except (ValueError, TypeError):
-                pass
+                return jnp.stack([jnp.asarray(v) for v in vals])
         return tuple(vals)
     if isinstance(idx, _py_slice):
         def iv(v):

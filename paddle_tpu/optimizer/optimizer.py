@@ -300,9 +300,7 @@ class Optimizer:
         self._step_count = int(state.get("@step", 0))
         self._global_state["step"] = jnp.asarray(
             int(state.get("@global_step", state.get("@step", 0))),
-            jnp.int64 if jnp.asarray(
-                self._global_state["step"]).dtype == jnp.int64
-            else jnp.int32)
+            jnp.int32)
         params = {name_i: p for name_i, (p, _, _) in
                   enumerate(self._collect_params_grads())}
         for key, value in state.items():
@@ -316,7 +314,11 @@ class Optimizer:
                 p = params[int(idx)]
             except (ValueError, KeyError):
                 continue
-            arr = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+            # jnp.array COPIES: aliasing the checkpoint's buffer into a
+            # live slot would let the next compiled step donate (delete)
+            # it out from under the caller's state dict
+            arr = jnp.array(value._value if isinstance(value, Tensor)
+                            else value)
             self._accumulators.setdefault(name, {})[id(p)] = arr
 
 

@@ -545,6 +545,40 @@ class TestDy2StaticAST:
         out = jit.to_static(f)(x, paddle.to_tensor(np.int32(4)))
         np.testing.assert_allclose(out.numpy(), float(sum(range(4))))
 
+    def test_rng_state_replays_compiled_randomness(self):
+        """get/set_rng_state must capture the (seed, counter) pair that
+        drives compiled-program step keys — restoring only the eager
+        split chain silently broke dropout replay (review r4)."""
+        drop = nn.Dropout(0.5)
+
+        @jit.to_static
+        def f(x):
+            return drop(x)
+
+        x = paddle.to_tensor(np.ones((16, 16), np.float32))
+        st = paddle.get_rng_state()
+        a = f(x).numpy()
+        paddle.set_rng_state(st)
+        b = f(x).numpy()
+        c = f(x).numpy()
+        np.testing.assert_allclose(a, b)
+        assert not np.allclose(b, c)
+
+    def test_tracer_list_gather_matches_eager(self):
+        """x[[i, j]] with Tensor indices: the gather semantics must
+        survive tracing (np.asarray raises on tracers; a tuple fallback
+        silently became multi-axis x[i, j] — review r4)."""
+        def g(x, i, j):
+            return x[[i, j]]
+
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        i = paddle.to_tensor(np.int32(0))
+        j = paddle.to_tensor(np.int32(2))
+        eager = g(x, i, j).numpy()
+        comp = jit.to_static(g)(x, i, j).numpy()
+        assert eager.shape == (2, 4)
+        np.testing.assert_allclose(eager, comp)
+
     def test_eval_mode_flip_selects_new_executable(self):
         """train/eval is part of the program: a .eval() after compiling
         in train mode must not keep running the train-mode executable
