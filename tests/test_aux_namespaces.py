@@ -663,14 +663,17 @@ class TestConv3DNative:
             out.block_until_ready()
 
         native(); dense_path()  # warm
-        t0 = time.perf_counter()
-        for _ in range(5):
-            native()
-        t_nat = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for _ in range(5):
-            dense_path()
-        t_dense = time.perf_counter() - t0
+        # best-of-3 alternating: wall-clock comparisons are noisy under
+        # a loaded box (full parallel suite) — one slow scheduling slice
+        # must not fail the structural claim
+        t_nat = min(
+            (lambda t0: ([native() for _ in range(5)],
+                         time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(3))
+        t_dense = min(
+            (lambda t0: ([dense_path() for _ in range(5)],
+                         time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(3))
         assert t_nat < t_dense * 1.2, (t_nat, t_dense)
 
 
