@@ -888,6 +888,11 @@ class Executor:
             program = default_main_program()
         if isinstance(program, CompiledProgramWrapper):
             program = program._program
+        if isinstance(program, LoadedInferenceProgram):
+            # reference contract: the program returned by
+            # load_inference_model runs through exe.run(prog, feed,
+            # fetch_list=fetch_targets) like any other program
+            return program.run(feed or {})
         feed = feed or {}
         fetch_list = fetch_list or []
         if not isinstance(fetch_list, (list, tuple)):

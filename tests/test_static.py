@@ -307,6 +307,11 @@ class TestStaticInferenceModel:
         loaded, feed_names, fetch_names = static.load_inference_model(path, exe)
         out = loaded.run({"x": xv})[0]
         np.testing.assert_allclose(out, ref, rtol=1e-5)
+        # the REFERENCE calling convention: the loaded program runs
+        # through exe.run like any other program (review r4 probe)
+        out2 = exe.run(loaded, feed={feed_names[0]: xv},
+                       fetch_list=fetch_names)[0]
+        np.testing.assert_allclose(out2, ref, rtol=1e-5)
 
     def test_dropout_and_bn_training(self, static_mode):
         main, startup = static.Program(), static.Program()
