@@ -173,7 +173,7 @@ def _moe_bench(on_tpu: bool):
         batch, seq, steps, warmup = 8, 512, 10, 3
     else:
         d_model, d_hidden, experts = 32, 64, 4
-        batch, seq, steps, warmup = 2, 16, 3, 1
+        batch, seq, steps, warmup = 2, 16, 10, 2
     moe = MoELayer(d_model=d_model, d_hidden=d_hidden, num_experts=experts,
                    top_k=2)
     opt = AdamW(1e-4, parameters=moe.parameters())
@@ -232,7 +232,7 @@ def _unet_bench(on_tpu: bool):
     def denoise(lat, ts, ctx):
         return model(lat, ts, ctx)
 
-    steps, warmup = (10, 3) if on_tpu else (3, 1)
+    steps, warmup = (10, 3) if on_tpu else (10, 2)
     for _ in range(warmup):
         out = denoise(lat, ts, ctx)
     out._value.block_until_ready()
@@ -256,7 +256,7 @@ def _resnet_bench(on_tpu: bool):
     if on_tpu:
         batch, hw, steps, warmup = 64, 224, 10, 3
     else:
-        batch, hw, steps, warmup = 2, 64, 3, 1
+        batch, hw, steps, warmup = 2, 64, 8, 2
     model = resnet50(num_classes=100)
     opt = Momentum(learning_rate=0.1, momentum=0.9,
                    parameters=model.parameters())
@@ -306,7 +306,7 @@ def _bert_dp_bench(on_tpu: bool):
     else:
         cfg = BertConfig.tiny()
         # batch must divide over dp whatever the virtual device count is
-        batch, seq, steps, warmup = dp * max(1, 8 // dp), 16, 3, 1
+        batch, seq, steps, warmup = dp * max(1, 8 // dp), 16, 10, 2
 
     strategy = DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp}
