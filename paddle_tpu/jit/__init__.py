@@ -335,6 +335,24 @@ class StaticFunction:
 
         _LOOP_MAX_TRIPS.append(self._loop_max_trips)
         try:
+            if entry._param_mutated is None:
+                entry.probe_trace(state, dyn_vals, lrs, rng_key)
+            if entry._param_mutated is False and \
+                    getattr(entry, "_out_all_arrays", False) and \
+                    dispatch.is_grad_enabled():
+                orig_flat = jax.tree_util.tree_flatten(
+                    (args, kwargs),
+                    is_leaf=lambda x: isinstance(x, Tensor))[0]
+                dyn_objs = [orig_flat[i] for i in dyn_idx]
+                if any(not p.stop_gradient for p in state.params) or any(
+                        isinstance(o, Tensor) and not o.stop_gradient
+                        for o in dyn_objs):
+                    # forward-only wrap under grad recording: the
+                    # reference's canonical `@to_static` ON THE MODEL
+                    # with backward outside — the compiled call must be
+                    # externally differentiable
+                    return entry.run_diff(state, dyn_objs, dyn_vals,
+                                          lrs, rng_key)
             return entry.run(state, dyn_vals, lrs, rng_key)
         finally:
             _LOOP_MAX_TRIPS.pop()
@@ -359,6 +377,14 @@ class _CompiledEntry:
         self._pre_slot_ids = [(id(s), k) for s, k in state_example.opt_slots()]
         self._new_slot_handles = []  # [(store, key)] discovered at trace time
         self._out_template = None
+        # None until the first trace; False = the program leaves params
+        # untouched (forward-only wrap) so external backward must work
+        self._param_mutated = None
+        self._nodonate = None
+        self._diff_impl = None
+        self._bwd_exec = None
+        self._lowered = None
+        self._compiled = None
 
         entry = self
 
@@ -396,12 +422,22 @@ class _CompiledEntry:
                 n_pb = len(state.params) + len(state.buffers)
                 cur = state.read()
                 new_state = cur[:n_pb] + known_vals + new_vals
+                # identity check on tracers: a param the program never
+                # touched passes through as the SAME tracer object —
+                # learned here so __call__ can route forward-only wraps
+                # through the externally-differentiable path
+                n_p = len(state.params)
+                entry._param_mutated = any(
+                    c is not s for c, s in zip(cur[:n_p], state_vals[:n_p]))
 
                 out_raw = jax.tree_util.tree_map(
                     lambda x: x._value if isinstance(x, Tensor) else x, out,
                     is_leaf=lambda x: isinstance(x, Tensor))
                 entry._out_template = jax.tree_util.tree_structure(
                     out_raw, is_leaf=lambda x: x is None)
+                entry._out_all_arrays = all(
+                    _is_arrayish(leaf) or hasattr(leaf, "aval")
+                    for leaf in jax.tree_util.tree_flatten(out_raw)[0])
             finally:
                 rnd.set_trace_key_provider(prev_provider)
                 for opt, prev in zip(state.optimizers, prev_lrs):
@@ -410,13 +446,24 @@ class _CompiledEntry:
                 state.write(orig_vals, slots=pre_slots)
             return out_raw, new_state
 
+        self._jax_fn = jax_fn
         self._jitted = jax.jit(jax_fn, donate_argnums=(0,))
 
     def run(self, state, dyn_vals, lrs, rng_key):
         self._live_state = state
         n_known = (len(state.params) + len(state.buffers)
                    + len(self._pre_slot_ids))
-        out_raw, new_state = self._jitted(state.read(), dyn_vals, lrs, rng_key)
+        if self._compiled is None and self._lowered is not None:
+            try:
+                self._compiled = self._lowered.compile()
+            except Exception:  # noqa: BLE001
+                self._lowered = None  # fall back to the plain jit call
+        if self._compiled is not None:
+            out_raw, new_state = self._compiled(
+                state.read(), list(dyn_vals), lrs, rng_key)
+        else:
+            out_raw, new_state = self._jitted(state.read(), dyn_vals, lrs,
+                                              rng_key)
         pre_slots = [(s, k) for s, k in state.opt_slots()
                      if (id(s), k) in set(self._pre_slot_ids)]
         state.write(new_state[:n_known], slots=pre_slots)
@@ -424,6 +471,163 @@ class _CompiledEntry:
             store[k] = v
         return jax.tree_util.tree_map(
             lambda v: Tensor(v) if _is_arrayish(v) else v, out_raw)
+
+    def probe_trace(self, state, dyn_vals, lrs, rng_key):
+        """Abstractly trace once (no execution) so _param_mutated and the
+        output template are known before choosing an execution path."""
+        self._live_state = state
+        pre = set(self._pre_slot_ids)
+        try:
+            # the SAME lowering later compiles into the standard path's
+            # executable — the python body must trace exactly once per
+            # entry (user code may have python-side effects, e.g.
+            # gradient-merge step counters; a second trace desyncs them)
+            self._lowered = self._jitted.lower(
+                state.read(), list(dyn_vals), lrs, rng_key)
+        except Exception:  # noqa: BLE001 — let the real call surface it
+            self._param_mutated = True
+        finally:
+            # optimizer slots materialized during the ABSTRACT trace hold
+            # tracers (nothing executed, so nothing wrote real values) —
+            # delete the VALUES; _new_slot_handles is kept so run()'s
+            # writeback recreates the entries from the compiled program's
+            # concrete outputs
+            for s, k in list(state.opt_slots()):
+                if (id(s), k) not in pre:
+                    del s[k]
+
+    def _ensure_diff(self, state):
+        if self._diff_impl is not None:
+            return
+        _register_diff_dispatch()
+
+        jax_fn = self._jax_fn
+        self._n_params = len(state.params)
+        self._nodonate = jax.jit(jax_fn)
+
+        def _flat_out(sv, dv, lrs, key):
+            out_raw, _ns = jax_fn(sv, dv, lrs, key)
+            return tuple(jax.tree_util.tree_flatten(out_raw)[0])
+
+        @jax.jit
+        def _bwd(pv, rest, dv, lrs, key, ct):
+            # recompute-based vjp (one extra forward at backward time);
+            # jitted, so the linearization compiles ONCE per signature
+            _, vjp = jax.vjp(
+                lambda p, d: _flat_out(list(p) + list(rest), list(d),
+                                       lrs, key), tuple(pv), tuple(dv))
+            return vjp(tuple(ct))
+
+        self._bwd_exec = _bwd
+        self._diff_impl = _to_static_diff_impl
+
+    def run_diff(self, state, dyn_objs, dyn_vals, lrs, rng_key):
+        """Externally-differentiable execution for programs that leave
+        params untouched (the reference's canonical `@to_static` on the
+        MODEL, backward outside).  The compiled forward rides the tape
+        as ONE op; grads reach params and differentiable inputs via a
+        cached jitted recompute-vjp.  Buffer/slot mutations (BN stats)
+        still write back."""
+        from ..core.dispatch import apply
+
+        self._live_state = state
+        self._ensure_diff(state)
+        dyn_wrapped = [
+            d if isinstance(d, Tensor) else Tensor(jnp.asarray(v),
+                                                   stop_gradient=True)
+            for d, v in zip(dyn_objs, dyn_vals)]
+        lr_t = Tensor(jnp.asarray(lrs), stop_gradient=True)
+        key_t = Tensor(jnp.asarray(rng_key), stop_gradient=True)
+        _DIFF_ENTRY_STACK.append(self)
+        try:
+            out = apply("to_static_call", self._diff_impl,
+                        list(state.params), dyn_wrapped, lr_t, key_t)
+        finally:
+            _DIFF_ENTRY_STACK.pop()
+        out = out if isinstance(out, tuple) else (out,)
+        new_state = self._diff_new_state
+        n_known = (len(state.params) + len(state.buffers)
+                   + len(self._pre_slot_ids))
+        pre_slots = [(s, k) for s, k in state.opt_slots()
+                     if (id(s), k) in set(self._pre_slot_ids)]
+        # params are untouched by definition of this path: write back
+        # buffers + slots only, keeping param objects bound to the tape.
+        # When apply bypassed the rule (AMP cast, no-grad raw path inside
+        # a vjp trace), new_state leaves may be tracers of a trace we
+        # don't own — skip those writebacks rather than poison live state.
+        n_p = len(state.params)
+        buf_and_slots = new_state[n_p:n_known]
+
+        def _safe(old, v):
+            return old if isinstance(v, jax.core.Tracer) and not isinstance(
+                old, jax.core.Tracer) else v
+
+        for b, v in zip(state.buffers, buf_and_slots[:len(state.buffers)]):
+            b._value = _safe(b._value, v)
+        for (s, k), v in zip(pre_slots, buf_and_slots[len(state.buffers):]):
+            s[k] = _safe(s[k], v)
+        for (store, k), v in zip(self._new_slot_handles,
+                                 new_state[n_known:]):
+            if not isinstance(v, jax.core.Tracer):
+                store[k] = v
+        return jax.tree_util.tree_unflatten(self._diff_out_td, list(out))
+
+
+# ---- shared dispatch for externally-differentiable compiled calls.
+# ONE registry entry total (registered lazily); the active _CompiledEntry
+# rides a stack around the apply() call, so entries are never pinned by
+# the module-global registry and the rule scan stays O(1).
+_DIFF_ENTRY_STACK: List["_CompiledEntry"] = []
+_DIFF_REGISTERED = []
+
+
+def _to_static_diff_impl(params, dyn, lrs, key):
+    """Fallback executable for apply() paths that bypass the eager-vjp
+    rule (AMP-cast dispatch, raw no-grad calls, vjp re-trace): runs the
+    non-donating compiled program directly.  Under an outer jax trace it
+    simply inlines."""
+    entry = _DIFF_ENTRY_STACK[-1]
+    n_p = entry._n_params
+    sv = entry._live_state.read()
+    out_raw, new_state = entry._nodonate(
+        list(params) + sv[n_p:], list(dyn), lrs, key)
+    entry._diff_new_state = new_state
+    flat, td = jax.tree_util.tree_flatten(out_raw)
+    entry._diff_out_td = td
+    return tuple(flat)
+
+
+def _to_static_diff_rule(vals, attrs):
+    # vals: flattened [*params, *dyn, lrs_arr, key_arr] raw values
+    entry = _DIFF_ENTRY_STACK[-1]
+    n_p = entry._n_params
+    nd = len(vals) - n_p - 2
+    pv, dv = vals[:n_p], vals[n_p:n_p + nd]
+    lrs_v, key_v = vals[-2], vals[-1]
+    sv = entry._live_state.read()
+    out_raw, new_state = entry._nodonate(
+        list(pv) + sv[n_p:], list(dv), lrs_v, key_v)
+    entry._diff_new_state = new_state
+    flat, td = jax.tree_util.tree_flatten(out_raw)
+    entry._diff_out_td = td
+    rest = tuple(sv[n_p:])
+    bwd = entry._bwd_exec
+
+    def vjp_all(ct):
+        ct_t = tuple(ct) if isinstance(ct, (tuple, list)) else (ct,)
+        gp, gd = bwd(tuple(pv), rest, tuple(dv), lrs_v, key_v, ct_t)
+        return tuple(gp) + tuple(gd) + (None, None)
+
+    return tuple(flat), vjp_all
+
+
+def _register_diff_dispatch():
+    if not _DIFF_REGISTERED:
+        from ..core import dispatch as _d
+
+        _d.register_eager_vjp("to_static_call", _to_static_diff_impl,
+                              _to_static_diff_rule, allow_containers=True)
+        _DIFF_REGISTERED.append(True)
 
 
 class _TracedLR(float):

@@ -571,6 +571,70 @@ class _Rewriter(ast.NodeTransformer):
                 + [b_fn, call])
 
 
+_RET = "__to_static_ret__"  # deliberately NOT a __dy2st_ name: it must be
+# visible to _assigned_names so the if-rewrite carries it
+
+
+def _count_returns(node):
+    n = 0
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, nd):  # nested defs own their returns
+            return
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Return(self, nd):
+            nonlocal n
+            n += 1
+
+    V().visit(node)
+    return n
+
+
+def _hoist_early_returns(stmts):
+    """Rewrite TAIL-POSITION early returns into if/else assignment form
+    so the if-rewriter can convert them (reference:
+    dygraph_to_static/return_transformer.py handles the general case;
+    this covers the overwhelmingly common model pattern)::
+
+        if c:              if c:
+            return A   ->      __to_static_ret__ = A
+        S                  else:
+        return B               S
+                               __to_static_ret__ = B
+                           return __to_static_ret__
+
+    Applied recursively; bails (leaves statements untouched) whenever a
+    branch has non-tail returns."""
+    out = list(stmts)
+    for s in out:
+        if isinstance(s, ast.If):
+            s.body = _hoist_early_returns(s.body)
+            if s.orelse:
+                s.orelse = _hoist_early_returns(s.orelse)
+    for i, s in enumerate(out):
+        if isinstance(s, ast.If) and not s.orelse and out[i + 1:] and \
+                s.body and isinstance(s.body[-1], ast.Return):
+            s.orelse = _hoist_early_returns(out[i + 1:])
+            out = out[:i + 1]
+            break
+    if out and isinstance(out[-1], ast.If):
+        s = out[-1]
+        if (s.orelse and s.body
+                and isinstance(s.body[-1], ast.Return)
+                and isinstance(s.orelse[-1], ast.Return)
+                and _count_returns(s) == 2):
+            for branch in (s.body, s.orelse):
+                ret = branch[-1]
+                branch[-1] = ast.Assign(
+                    targets=[_name(_RET, ast.Store())],
+                    value=ret.value if ret.value is not None
+                    else ast.Constant(value=None))
+            out.append(ast.Return(value=_name(_RET, ast.Load())))
+    return out
+
+
 def convert_function(fn):
     """Return a control-flow-converted clone of ``fn``, or ``fn`` itself
     when the pass does not apply (no rewritable statements, no source,
@@ -612,6 +676,7 @@ def convert_function(fn):
                                    or "range" in raw.__code__.co_freevars))
     # visit the body statements, not fdef itself — visit_FunctionDef
     # guards NESTED defs only
+    fdef.body = _hoist_early_returns(fdef.body)
     new_body = []
     for s in fdef.body:
         r = rw.visit(s)

@@ -545,6 +545,57 @@ class TestDy2StaticAST:
         out = jit.to_static(f)(x, paddle.to_tensor(np.int32(4)))
         np.testing.assert_allclose(out.numpy(), float(sum(range(4))))
 
+    def test_forward_wrapped_model_trains_with_external_backward(self):
+        """The reference's CANONICAL to_static usage: wrap the MODEL
+        (forward only), call backward + optimizer OUTSIDE.  The compiled
+        call must be externally differentiable — it previously returned
+        node-less tensors and silently trained at exactly zero update
+        (review r4).  Early returns on a tensor condition convert too."""
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                if paddle.sum(x) > 0.0:
+                    return self.lin(x) * 2.0
+                return self.lin(x)
+
+        m = jit.to_static(Gate())
+        opt = Adam(learning_rate=0.05, parameters=m.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        losses = []
+        for _ in range(15):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+
+        # input grads flow through a wrapped plain function as well
+        @jit.to_static
+        def f(t):
+            return paddle.tanh(t) * 3.0
+
+        t = paddle.to_tensor(np.array([0.5, -0.2], np.float32),
+                             stop_gradient=False)
+        f(t).sum().backward()
+        np.testing.assert_allclose(
+            t.grad.numpy(), 3.0 * (1 - np.tanh(t.numpy()) ** 2), rtol=1e-5)
+
+    def test_forward_wrap_updates_bn_buffers(self):
+        """Buffer mutations (BN running stats) still write back on the
+        externally-differentiable path."""
+        bnm = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+        g = jit.to_static(lambda t: bnm(t))
+        rm0 = bnm[1]._mean.numpy().copy()
+        xb = paddle.to_tensor(np.random.RandomState(0)
+                              .randn(8, 4).astype(np.float32))
+        g(xb).sum().backward()
+        assert not np.allclose(rm0, bnm[1]._mean.numpy())
+        assert bnm[0].weight.grad is not None
+
     def test_rng_state_replays_compiled_randomness(self):
         """get/set_rng_state must capture the (seed, counter) pair that
         drives compiled-program step keys — restoring only the eager
