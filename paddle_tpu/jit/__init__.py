@@ -971,6 +971,18 @@ def save(layer, path, input_spec=None, **configs):
     else:
         fn = layer
         state = {}
+    if isinstance(fn, StaticFunction):
+        fn = fn._trace_target()
+    else:
+        # the export trace needs the same dy2static pass as to_static:
+        # a tensor-condition `if`/loop in forward must lower to XLA
+        # Cond/While, not hit a trace-time bool conversion
+        from . import dy2static
+
+        try:
+            fn = dy2static.convert_function(fn)
+        except Exception:  # noqa: BLE001 — fall back to the raw fn
+            pass
     if input_spec is None:
         raise ValueError("jit.save requires input_spec")
 

@@ -206,6 +206,30 @@ class TestSaveLoad:
         np.testing.assert_allclose(np.asarray(out_loaded._value), out_ref,
                                    rtol=1e-5, atol=1e-6)
 
+    def test_jit_save_converts_tensor_control_flow(self, tmp_path):
+        """jit.save must run the same dy2static pass as to_static: a
+        tensor-condition early return in forward previously hit a
+        trace-time bool conversion during export (review r4)."""
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                if paddle.sum(x) > 0.0:
+                    return self.lin(x) * 2.0
+                return self.lin(x)
+
+        m = Gate()
+        m.eval()
+        path = str(tmp_path / "gate")
+        jit.save(m, path, input_spec=[jit.InputSpec([2, 4], "float32")])
+        loaded = jit.load(path)
+        for sign in (1.0, -1.0):
+            x = paddle.to_tensor(np.full((2, 4), sign, np.float32))
+            np.testing.assert_allclose(loaded(x).numpy(), m(x).numpy(),
+                                       rtol=1e-5)
+
     def test_optimizer_state_save_load(self, tmp_path):
         net = nn.Linear(2, 2)
         opt = Adam(0.01, parameters=net.parameters())
