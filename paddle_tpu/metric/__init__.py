@@ -46,8 +46,15 @@ class Accuracy(Metric):
         pred_np = _np(pred)
         label_np = _np(label)
         idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
-        if label_np.ndim == pred_np.ndim:  # one-hot
-            label_np = label_np.argmax(-1)
+        if label_np.ndim == pred_np.ndim:
+            if label_np.shape[-1] == pred_np.shape[-1] \
+                    and label_np.shape[-1] > 1:
+                label_np = label_np.argmax(-1)  # one-hot
+            else:
+                # [N, 1] integer labels (the reference's standard layout,
+                # metrics.py:180): a trailing 1 is NOT one-hot — argmax
+                # would flatten every label to class 0
+                label_np = label_np[..., 0]
         correct = idx == label_np[..., None]
         return Tensor(jnp.asarray(correct.astype(np.float32)))
 

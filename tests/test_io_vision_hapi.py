@@ -161,6 +161,20 @@ class TestMetrics:
         acc.update(correct)
         assert acc.accumulate() == pytest.approx(0.5)
 
+    def test_accuracy_label_layouts(self):
+        """[N, 1] integer labels — the reference's STANDARD layout — must
+        not be mistaken for one-hot (argmax flattened every label to
+        class 0: review r4 found evaluate reporting 0.5 acc at 0.03
+        loss).  [N] ints and true one-hot give the same number."""
+        logits = paddle.to_tensor(np.array(
+            [[0.1, 2.0], [3.0, 0.2], [0.5, 1.5]], np.float32))
+        for lab in (np.array([[1], [0], [0]], np.int64),
+                    np.array([1, 0, 0], np.int64),
+                    np.array([[0, 1], [1, 0], [1, 0]], np.float32)):
+            acc = Accuracy()
+            acc.update(acc.compute(logits, paddle.to_tensor(lab)))
+            assert acc.accumulate() == pytest.approx(2 / 3), lab.shape
+
     def test_precision_recall(self):
         p = Precision()
         p.update(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 1]))
