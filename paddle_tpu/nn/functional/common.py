@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import apply, is_grad_enabled
 from ...core.dtype import to_np
@@ -124,13 +125,76 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     return apply("normalize", _norm, _t(x))
 
 
+def _resize_taps(in_size, out_size, align_corners, cubic):
+    """(idx [out, T] int32, w [out, T] f32): separable interpolation taps
+    matching the reference/torch coordinate rules — align_corners=True
+    maps i -> i*(in-1)/(out-1); False uses half-pixel centers; bicubic is
+    the Keys kernel with a=-0.75 (jax.image uses a=-0.5, which silently
+    diverges from every torch/paddle-trained vision model)."""
+    i = np.arange(out_size, dtype=np.float64)
+    if align_corners and out_size > 1:
+        c = i * ((in_size - 1) / (out_size - 1))
+    else:
+        c = (i + 0.5) * (in_size / out_size) - 0.5
+    i0 = np.floor(c)
+    f = c - i0
+    if cubic:
+        a = -0.75
+
+        def k(d):
+            d = np.abs(d)
+            return np.where(
+                d <= 1, ((a + 2) * d - (a + 3)) * d * d + 1,
+                np.where(d < 2, ((a * d - 5 * a) * d + 8 * a) * d - 4 * a,
+                         0.0))
+
+        offs = np.arange(-1, 3)
+        idx = i0[:, None] + offs[None, :]
+        w = k(f[:, None] - offs[None, :])
+    else:
+        offs = np.arange(0, 2)
+        idx = i0[:, None] + offs[None, :]
+        w = np.stack([1.0 - f, f], axis=1)
+    idx = np.clip(idx, 0, in_size - 1).astype(np.int32)
+    return jnp.asarray(idx), jnp.asarray(w.astype(np.float32))
+
+
+def _resize_axis(v, axis, out_size, align_corners, cubic):
+    idx, w = _resize_taps(v.shape[axis], out_size, align_corners, cubic)
+    v0 = jnp.moveaxis(v, axis, 0)
+    g = v0[idx]  # [out, T, ...rest]
+    wb = w.astype(g.dtype).reshape(w.shape + (1,) * (g.ndim - 2))
+    return jnp.moveaxis((g * wb).sum(axis=1), 0, axis)
+
+
+def _adaptive_mean_axis(v, axis, out_size):
+    in_size = v.shape[axis]
+    if in_size % out_size == 0:
+        k = in_size // out_size
+        v0 = jnp.moveaxis(v, axis, 0)
+        v0 = v0.reshape((out_size, k) + v0.shape[1:]).mean(axis=1)
+        return jnp.moveaxis(v0, 0, axis)
+    # torch adaptive rule: window i = [floor(i*in/out), ceil((i+1)*in/out))
+    v0 = jnp.moveaxis(v, axis, 0)
+    pieces = []
+    for i in range(out_size):
+        s = (i * in_size) // out_size
+        e = -(-((i + 1) * in_size) // out_size)
+        pieces.append(v0[s:e].mean(axis=0))
+    return jnp.moveaxis(jnp.stack(pieces, axis=0), 0, axis)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    """nearest / bilinear / bicubic / trilinear / area resize via jax.image."""
+    """nearest / linear / bilinear / bicubic / trilinear / area resize
+    with EXACT reference coordinate semantics (align_corners both ways,
+    a=-0.75 bicubic, adaptive-mean area)."""
     def _interp(v):
         is_nchw = data_format[1] == "C"
-        spatial = v.shape[2:] if is_nchw else v.shape[1:-1]
+        spatial_axes = (tuple(range(2, v.ndim)) if is_nchw
+                        else tuple(range(1, v.ndim - 1)))
+        spatial = tuple(v.shape[a] for a in spatial_axes)
         if size is not None:
             out_spatial = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
                                 for s in (size if isinstance(size, (list, tuple))
@@ -140,17 +204,20 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 else [scale_factor] * len(spatial)
             out_spatial = tuple(int(round(d * float(f)))
                                 for d, f in zip(spatial, sf))
-        if is_nchw:
-            out_shape = v.shape[:2] + out_spatial
-        else:
-            out_shape = (v.shape[0],) + out_spatial + (v.shape[-1],)
-        method = {"nearest": "nearest", "bilinear": "bilinear",
-                  "bicubic": "bicubic", "trilinear": "trilinear",
-                  "linear": "linear", "area": "linear"}[mode]
         if mode == "nearest":
-            return jax.image.resize(v, out_shape, method="nearest")
-        # jax.image.resize matches align_corners=False (half-pixel centers)
-        return jax.image.resize(v, out_shape, method=method).astype(v.dtype)
+            out_shape = list(v.shape)
+            for a, o in zip(spatial_axes, out_spatial):
+                out_shape[a] = o
+            return jax.image.resize(v, tuple(out_shape), method="nearest")
+        if mode == "area":
+            for a, o in zip(spatial_axes, out_spatial):
+                v = _adaptive_mean_axis(v, a, o)
+            return v
+        cubic = mode == "bicubic"
+        dt = v.dtype
+        for a, o in zip(spatial_axes, out_spatial):
+            v = _resize_axis(v, a, o, align_corners, cubic)
+        return v.astype(dt)
     return apply("interpolate", _interp, _t(x))
 
 

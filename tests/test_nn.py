@@ -262,6 +262,28 @@ class TestDropoutInterp:
         assert F.interpolate(x, size=[6, 6], mode="bilinear").shape == \
             [1, 2, 6, 6]
 
+    def test_interpolate_matches_torch_semantics(self):
+        """The reference's coordinate rules are torch's: align_corners
+        both ways, the a=-0.75 bicubic kernel (jax.image uses a=-0.5),
+        and adaptive-mean 'area' — mismatches silently degrade every
+        ported vision model (review r4: maxdiff up to 0.97)."""
+        import torch
+        import torch.nn.functional as TF
+
+        xv = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        xp, xt = paddle.to_tensor(xv), torch.tensor(xv)
+        for kw in (dict(size=(15, 15), mode="bilinear", align_corners=True),
+                   dict(scale_factor=2, mode="bilinear",
+                        align_corners=False),
+                   dict(size=(16, 16), mode="bicubic", align_corners=False),
+                   dict(size=(11, 11), mode="bicubic", align_corners=True),
+                   dict(size=(4, 4), mode="area"),
+                   dict(size=(3, 3), mode="area")):
+            ours = F.interpolate(xp, **kw).numpy()
+            ref = TF.interpolate(xt, **kw).numpy()
+            np.testing.assert_allclose(ours, ref, atol=2e-4,
+                                       err_msg=str(kw))
+
     def test_pixel_shuffle(self):
         x = paddle.to_tensor(r(1, 8, 2, 2))
         assert F.pixel_shuffle(x, 2).shape == [1, 2, 4, 4]
