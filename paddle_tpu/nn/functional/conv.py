@@ -105,8 +105,14 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     channel_last = data_format[-1] == "C"
     spatial = "DHW"[3 - n:]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
-    # paddle transpose-conv weight layout: [in_channels, out_channels/groups, *k]
-    dn = (lhs_spec, "IO" + spatial, lhs_spec)
+    # paddle transpose-conv weight layout: [in_channels, out_channels/groups,
+    # *k].  transpose_kernel=True makes lax.conv_transpose the exact
+    # GRADIENT of a forward conv (kernel spatially flipped + IO swapped),
+    # matching reference/torch semantics — so the spec below describes the
+    # FORWARD kernel being transposed ("OI...": dim0 = lhs channels after
+    # the swap).  Without it the kernel is applied unflipped and every
+    # transpose-conv output silently diverges.
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
 
     def _fn(v, w, *maybe_b):
         if isinstance(pads, str):
@@ -127,14 +133,16 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             outs = [
                 jax.lax.conv_transpose(
                     vg, wg, strides=strides, padding=pad_cfg,
-                    rhs_dilation=dilations, dimension_numbers=dn)
+                    rhs_dilation=dilations, dimension_numbers=dn,
+                    transpose_kernel=True)
                 for vg, wg in zip(v_groups, w_groups)
             ]
             out = jnp.concatenate(outs, axis=ci_axis)
         else:
             out = jax.lax.conv_transpose(
                 v, w, strides=strides, padding=pad_cfg,
-                rhs_dilation=dilations, dimension_numbers=dn)
+                rhs_dilation=dilations, dimension_numbers=dn,
+                transpose_kernel=True)
         if maybe_b:
             b = maybe_b[0]
             shape = [1] * out.ndim

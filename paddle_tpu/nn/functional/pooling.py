@@ -38,6 +38,20 @@ def _pool_pads(padding, n):
     raise ValueError(f"bad padding {padding}")
 
 
+def _ceil_extras(in_sizes, window, strides, pads):
+    """Right-edge padding extension implementing ceil_mode: the last
+    partial window is included, but (reference/torch rule) a window that
+    would START beyond input+left-pad is dropped."""
+    extras = []
+    for size, k, s, (pl, pr) in zip(in_sizes, window, strides, pads):
+        eff = size + pl + pr
+        out = -(-(eff - k) // s) + 1  # ceil
+        if (out - 1) * s >= size + pl:
+            out -= 1
+        extras.append(max(0, (out - 1) * s + k - eff))
+    return extras
+
+
 def _reduce_window(v, init, op, window, strides, pads, channel_last, n):
     if channel_last:
         dims = (1,) + window + (1,)
@@ -60,9 +74,14 @@ def _max_pool(x, kernel_size, stride, padding, ceil_mode, data_format, n,
     channel_last = data_format[-1] == "C"
 
     def _fn(v):
+        p = pads
+        if ceil_mode and not isinstance(p, str):
+            sizes = v.shape[1:-1] if channel_last else v.shape[2:]
+            extras = _ceil_extras(sizes, window, strides, p)
+            p = [(pl, pr + e) for (pl, pr), e in zip(p, extras)]
         out = _reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
                              else jnp.iinfo(v.dtype).min,
-                             jax.lax.max, window, strides, pads, channel_last, n)
+                             jax.lax.max, window, strides, p, channel_last, n)
         return out.astype(v.dtype)
     out = apply(f"max_pool{n}d", _fn, _t(x))
     if return_mask:
@@ -105,15 +124,40 @@ def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
     channel_last = data_format[-1] == "C"
 
     def _fn(v):
+        p = pads
+        extras = None
+        if ceil_mode and not isinstance(p, str):
+            sizes = v.shape[1:-1] if channel_last else v.shape[2:]
+            extras = _ceil_extras(sizes, window, strides, p)
+            p = [(pl, pr + e) for (pl, pr), e in zip(pads, extras)]
         summed = _reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window,
-                                strides, pads, channel_last, n)
+                                strides, p, channel_last, n)
         if divisor_override:
             denom = float(divisor_override)
             out = summed / denom
-        elif exclusive and not isinstance(pads, str):
+        elif exclusive and not isinstance(p, str):
             ones = jnp.ones_like(v, jnp.float32)
-            denom = _reduce_window(ones, 0.0, jax.lax.add, window, strides, pads,
+            denom = _reduce_window(ones, 0.0, jax.lax.add, window, strides, p,
                                    channel_last, n)
+            out = summed / denom
+        elif extras is not None and any(extras):
+            # include-pad + ceil: base pads COUNT in the divisor but the
+            # ceil extension does not (reference divisor rule) — count
+            # via ones extended by base pads as ones
+            ones = jnp.ones_like(v, jnp.float32)
+            if channel_last:
+                base = [(0, 0)] + [(pl, pr) for pl, pr in pads] + [(0, 0)]
+                ext = ((0, 0),) + tuple((0, e) for e in extras) + ((0, 0),)
+                dims = (1,) + window + (1,)
+                strd = (1,) + strides + (1,)
+            else:
+                base = [(0, 0), (0, 0)] + [(pl, pr) for pl, pr in pads]
+                ext = ((0, 0), (0, 0)) + tuple((0, e) for e in extras)
+                dims = (1, 1) + window
+                strd = (1, 1) + strides
+            ones = jnp.pad(ones, base, constant_values=1.0)
+            denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd,
+                                          ext)
             out = summed / denom
         else:
             out = summed / float(np.prod(window))

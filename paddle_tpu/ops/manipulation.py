@@ -455,14 +455,17 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         if len(p) == 2 * nd:
             width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
         else:
-            # paddle NCHW convention: pad applies to last len(p)//2 spatial dims,
-            # ordered (left, right, top, bottom, ...) from the last dim inward
+            # paddle convention: pair 0 = (left, right) on the LAST
+            # spatial dim (W), pair 1 = (top, bottom) on H, pair 2 =
+            # (front, back) on D — i.e. pairs assign from the last dim
+            # INWARD (reference common.py:1187 and its circular-pad doc
+            # example; forward assignment silently transposed H/W pads)
             width = [(0, 0)] * nd
             npairs = len(p) // 2
-            if data_format.endswith("HWC") or data_format in ("NLC", "NHWC", "NDHWC"):
-                dims = list(range(1, 1 + npairs))
+            if data_format in ("NLC", "NHWC", "NDHWC"):
+                dims = list(range(nd - 2, nd - 2 - npairs, -1))
             else:
-                dims = list(range(nd - npairs, nd))
+                dims = list(range(nd - 1, nd - 1 - npairs, -1))
             for i, d in enumerate(dims):
                 width[d] = (p[2 * i], p[2 * i + 1])
         jmode = {"constant": "constant", "reflect": "reflect",

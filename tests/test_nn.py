@@ -262,6 +262,37 @@ class TestDropoutInterp:
         assert F.interpolate(x, size=[6, 6], mode="bilinear").shape == \
             [1, 2, 6, 6]
 
+    def test_pool_pad_convt_match_torch_semantics(self):
+        """Three review-r4 oracle finds: pad pairs assign from the LAST
+        dim inward (ours transposed H/W), ceil_mode was ignored, and
+        conv_transpose applied the kernel unflipped (lax default)."""
+        import torch
+        import torch.nn.functional as TF
+
+        rng = np.random.RandomState(0)
+        xv = rng.randn(2, 3, 9, 9).astype(np.float32)
+        xp, xt = paddle.to_tensor(xv), torch.tensor(xv)
+        for m in ("constant", "reflect", "replicate", "circular"):
+            np.testing.assert_allclose(
+                F.pad(xp, [1, 2, 2, 1], mode=m).numpy(),
+                TF.pad(xt, (1, 2, 2, 1), mode=m).numpy(), atol=1e-6,
+                err_msg=f"pad {m}")
+        np.testing.assert_allclose(
+            F.max_pool2d(xp, 2, stride=2, ceil_mode=True).numpy(),
+            TF.max_pool2d(xt, 2, stride=2, ceil_mode=True).numpy())
+        np.testing.assert_allclose(
+            F.avg_pool2d(xp, 2, stride=2, ceil_mode=True,
+                         exclusive=False).numpy(),
+            TF.avg_pool2d(xt, 2, stride=2, ceil_mode=True,
+                          count_include_pad=True).numpy(),
+            rtol=1e-5, atol=1e-6)
+        w = rng.randn(3, 4, 3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.conv2d_transpose(xp, paddle.to_tensor(w), stride=2,
+                               padding=1, output_padding=1).numpy(),
+            TF.conv_transpose2d(xt, torch.tensor(w), stride=2, padding=1,
+                                output_padding=1).numpy(), atol=1e-4)
+
     def test_interpolate_matches_torch_semantics(self):
         """The reference's coordinate rules are torch's: align_corners
         both ways, the a=-0.75 bicubic kernel (jax.image uses a=-0.5),
