@@ -173,7 +173,7 @@ def _moe_bench(on_tpu: bool):
         batch, seq, steps, warmup = 8, 512, 10, 3
     else:
         d_model, d_hidden, experts = 32, 64, 4
-        batch, seq, steps, warmup = 2, 16, 10, 2
+        batch, seq, steps, warmup = 2, 16, 25, 3
     moe = MoELayer(d_model=d_model, d_hidden=d_hidden, num_experts=experts,
                    top_k=2)
     opt = AdamW(1e-4, parameters=moe.parameters())
@@ -306,7 +306,7 @@ def _bert_dp_bench(on_tpu: bool):
     else:
         cfg = BertConfig.tiny()
         # batch must divide over dp whatever the virtual device count is
-        batch, seq, steps, warmup = dp * max(1, 8 // dp), 16, 10, 2
+        batch, seq, steps, warmup = dp * max(1, 8 // dp), 16, 25, 3
 
     strategy = DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp}
