@@ -1,0 +1,126 @@
+"""Canonical reference-user journeys, end to end in one command.
+
+Each block is a pattern a PaddlePaddle user brings over unchanged; every
+one was probe-verified during round 4 (several found silent-wrong-math
+bugs before fixing: zero-update wrapped-model training, diverging
+checkpoint resume, train-mode dropout after .eval()).  Run time ~2 min
+on CPU.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+rng = np.random.RandomState(0)
+x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+y = paddle.to_tensor(rng.randint(0, 3, (4,)).astype(np.int64))
+
+
+def check(tag, ok):
+    print(f"{tag}: {'OK' if ok else 'FAIL'}")
+    assert ok, tag
+
+
+# 1. whole-step compiled training (forward + backward + optimizer in ONE
+#    executable — the TPU-native shape)
+lin1 = paddle.nn.Linear(8, 3)
+opt1 = paddle.optimizer.Adam(learning_rate=0.05, parameters=lin1.parameters())
+
+
+@jit.to_static
+def step(xx, yy):
+    loss = paddle.nn.functional.cross_entropy(lin1(xx), yy)
+    loss.backward()
+    opt1.step()
+    opt1.clear_grad()
+    return loss
+
+
+ls = [float(step(x, y).numpy()) for _ in range(15)]
+check("whole-step compiled training", ls[-1] < ls[0])
+
+# 2. the reference's canonical form: @to_static on the MODEL, backward and
+#    optimizer OUTSIDE
+lin2 = jit.to_static(paddle.nn.Linear(8, 3))
+opt2 = paddle.optimizer.Adam(learning_rate=0.05, parameters=lin2.parameters())
+ls2 = []
+for _ in range(15):
+    loss = paddle.nn.functional.cross_entropy(lin2(x), y)
+    loss.backward()
+    opt2.step()
+    opt2.clear_grad()
+    ls2.append(float(loss.numpy()))
+check("wrapped-model training (external backward)", ls2[-1] < ls2[0])
+
+# 3. checkpoint-resume reproduces the uninterrupted trajectory exactly
+def make():
+    lin = paddle.nn.Linear(8, 3)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=lin.parameters())
+
+    @jit.to_static
+    def s(xx, yy):
+        loss = paddle.nn.functional.cross_entropy(lin(xx), yy)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return lin, opt, s
+
+
+la, oa, sa = make()
+for _ in range(5):
+    sa(x, y)
+msd = {k: v.numpy().copy() for k, v in la.state_dict().items()}
+osd = oa.state_dict()
+tail_a = [float(sa(x, y).numpy()) for _ in range(5)]
+lb, ob, sb = make()
+lb.set_state_dict({k: paddle.to_tensor(v) for k, v in msd.items()})
+ob.set_state_dict(osd)
+tail_b = [float(sb(x, y).numpy()) for _ in range(5)]
+check("checkpoint-resume exact", np.allclose(tail_a, tail_b, rtol=1e-5))
+
+# 4. train/eval mode flips select the right executable
+drop = paddle.nn.Dropout(0.5)
+f = jit.to_static(lambda t: drop(t))
+xa = paddle.to_tensor(np.ones((16, 16), np.float32))
+_train_out = f(xa).numpy()
+drop.eval()
+check("eval-mode identity", np.allclose(f(xa).numpy(), xa.numpy()))
+drop.train()
+
+# 5. data-dependent python control flow under to_static, trainable via a
+#    trip bound
+lin5 = paddle.nn.Linear(8, 8)
+opt5 = paddle.optimizer.Adam(learning_rate=0.05, parameters=lin5.parameters())
+
+
+@jit.to_static(loop_max_trips=8)
+def loop_step(xx, n):
+    acc = paddle.zeros_like(xx)
+    for i in range(n):
+        acc = acc + lin5(xx)
+    loss = (acc * acc).mean()
+    loss.backward()
+    opt5.step()
+    opt5.clear_grad()
+    return loss
+
+
+n = paddle.to_tensor(np.int32(3))
+ls5 = [float(loop_step(x, n).numpy()) for _ in range(15)]
+check("tensor-bound for-loop training", ls5[-1] < ls5[0])
+
+# 6. export + serve round trip
+lin6 = paddle.nn.Linear(8, 3)
+lin6.eval()
+import tempfile
+
+path = tempfile.mkdtemp() + "/model"
+jit.save(lin6, path, input_spec=[jit.InputSpec([4, 8], "float32")])
+loaded = jit.load(path)
+check("export/serve round trip",
+      np.allclose(loaded(x).numpy(), lin6(x).numpy(), rtol=1e-5))
+
+print("ALL COMPAT JOURNEYS PASS")
