@@ -15,6 +15,10 @@ dense layers (degree-1 groups), matching the reference's single-rank path.
 """
 from __future__ import annotations
 
+import contextlib
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
@@ -33,6 +37,130 @@ def _mp_size():
         return hcg.get_model_parallel_world_size()
     mesh = get_mesh()
     return mesh.shape.get("mp", 1) if mesh is not None else 1
+
+
+# ---------------------------------------------------------------------------
+# Manual-collective mode (inside shard_map, e.g. the compiled 1F1B pipeline)
+#
+# Under GSPMD jit the layers below hold GLOBAL weights with sharding
+# annotations and XLA inserts the collectives.  Inside shard_map (the
+# compiled pipeline schedule runs per-device code) weights arrive as LOCAL
+# mp shards and the collectives must be explicit — the same split the
+# reference makes between its GSPMD-less manual layers (c_identity /
+# c_allreduce autograd ops, mp_layers.py:30) and auto parallel.  The
+# pipeline builder activates this mode around stage tracing.
+#
+# Gradient rule (Megatron f/g pair): a plain lax.psum is NOT its own
+# correct vjp under shard_map check_vma=False — the transpose overcounts
+# by the axis size.  Hence identity-fwd/psum-bwd (column input) and
+# psum-fwd/identity-bwd (row output) custom-vjp ops, verified exact
+# against dense math in tests/test_distributed.py.
+# ---------------------------------------------------------------------------
+
+_MANUAL_AXES: dict = {}
+
+
+@contextlib.contextmanager
+def manual_collective_axes(axis_sizes: dict):
+    """Activate manual-collective mode for the given {axis_name: size}
+    mesh axes (tracing-time switch; shard_map traces synchronously)."""
+    global _MANUAL_AXES
+    prev = _MANUAL_AXES
+    _MANUAL_AXES = dict(axis_sizes)
+    try:
+        yield
+    finally:
+        _MANUAL_AXES = prev
+
+
+def manual_axis(name: str):
+    """(axis_name, size) if manual mode is active for `name` with degree
+    > 1, else (None, 1)."""
+    size = _MANUAL_AXES.get(name, 1)
+    return (name, size) if size > 1 else (None, 1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_allreduce(x, axis):
+    """psum forward, identity backward (reference c_allreduce_sum op in
+    RowParallelLinear.forward: mp_layers.py:170)."""
+    return jax.lax.psum(x, axis)
+
+
+def _ar_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _ar_bwd(axis, _, ct):
+    return (ct,)
+
+
+mp_allreduce.defvjp(_ar_fwd, _ar_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_identity(x, axis):
+    """identity forward, psum backward (reference c_identity op at
+    ColumnParallelLinear's input: mp_layers.py:97)."""
+    return x
+
+
+def _id_fwd(x, axis):
+    return x, None
+
+
+def _id_bwd(axis, _, ct):
+    return (jax.lax.psum(ct, axis),)
+
+
+mp_identity.defvjp(_id_fwd, _id_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_all_gather(x, axis):
+    """Concat-gather along the LAST dim forward; slice backward
+    (ColumnParallelLinear gather_output=True: mp_layers.py c_concat)."""
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+def _ag_fwd(x, axis):
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True), \
+        x.shape[-1]
+
+
+def _ag_bwd(axis, local_width, ct):
+    rank = jax.lax.axis_index(axis)
+    start = rank * local_width
+    return (jax.lax.dynamic_slice_in_dim(ct, start, local_width,
+                                         axis=ct.ndim - 1),)
+
+
+mp_all_gather.defvjp(_ag_fwd, _ag_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_scatter(x, axis):
+    """Slice this rank's chunk of the LAST dim forward; concat-gather
+    backward (Megatron scatter: each rank's input-grad chunk must be
+    re-assembled into the full replicated cotangent — a bare
+    dynamic_slice transpose would zero-pad instead, leaving upstream
+    grads rank-inconsistent)."""
+    size = jax.lax.psum(1, axis)
+    local = x.shape[-1] // size
+    rank = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_slice_in_dim(x, rank * local, local,
+                                        axis=x.ndim - 1)
+
+
+def _sc_fwd(x, axis):
+    return mp_scatter(x, axis), None
+
+
+def _sc_bwd(axis, _, ct):
+    return (jax.lax.all_gather(ct, axis, axis=ct.ndim - 1, tiled=True),)
+
+
+mp_scatter.defvjp(_sc_fwd, _sc_bwd)
 
 
 class ColumnParallelLinear(nn.Layer):
@@ -55,6 +183,17 @@ class ColumnParallelLinear(nn.Layer):
             self.bias = None
 
     def forward(self, x):
+        axis, _ = manual_axis("mp")
+        if axis is not None:
+            # shard_map mode: weight/bias are LOCAL mp shards.  Identity
+            # fwd / psum bwd at the input (each rank contributes its
+            # shard's partial input-grad), local matmul, optional gather.
+            xi = apply("mp_identity", lambda v: mp_identity(v, axis), x)
+            out = F.linear(xi, self.weight, self.bias)
+            if self.gather_output:
+                out = apply("mp_all_gather",
+                            lambda v: mp_all_gather(v, axis), out)
+            return out
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output and get_mesh() is not None and \
                 "mp" in get_mesh().shape:
@@ -82,6 +221,22 @@ class RowParallelLinear(nn.Layer):
             self.bias = None
 
     def forward(self, x):
+        axis, _ = manual_axis("mp")
+        if axis is not None:
+            # shard_map mode: local matmul on the row shard, psum-fwd/
+            # identity-bwd allreduce, bias added ONCE after the reduce
+            # (reference mp_layers.py:170 adds bias post-c_allreduce)
+            def row(xv, wv):
+                if xv.shape[-1] != wv.shape[0]:
+                    # full (non-parallel) input: scatter this rank's
+                    # slice (all-gather backward, not zero-pad)
+                    xv = mp_scatter(xv, axis)
+                return mp_allreduce(xv @ wv, axis)
+
+            out = apply("row_parallel_linear", row, x, self.weight)
+            if self.bias is not None:
+                out = out + self.bias
+            return out
         # contraction dim sharded on mp → GSPMD inserts the all-reduce the
         # reference codes as c_allreduce_sum after the local matmul
         out = F.linear(x, self.weight, self.bias)
@@ -101,6 +256,21 @@ class VocabParallelEmbedding(nn.Layer):
         mark_sharding(self.weight, PartitionSpec("mp", None))
 
     def forward(self, x):
+        axis, _ = manual_axis("mp")
+        if axis is not None:
+            # shard_map mode: masked local-range lookup + allreduce — the
+            # reference's c_embedding kernel (indices offset by
+            # vocab_start, out-of-range rows zeroed, then allreduce)
+            def emb(idx, wv):
+                vloc = wv.shape[0]
+                rank = jax.lax.axis_index(axis)
+                loc = idx.astype(jnp.int32) - rank * vloc
+                mask = (loc >= 0) & (loc < vloc)
+                e = jnp.take(wv, jnp.clip(loc, 0, vloc - 1), axis=0)
+                e = jnp.where(mask[..., None], e, 0)
+                return mp_allreduce(e, axis)
+
+            return apply("vocab_parallel_embedding", emb, x, self.weight)
         return F.embedding(x, self.weight)
 
 

@@ -88,7 +88,9 @@ def gpipe_spmd(stage_fn: Callable, stacked_params, x_microbatches,
 def pipeline_1f1b(stage_fn: Callable, stacked_params, shared_params,
                   inputs_mb, targets_mb, act_example,
                   mesh: Optional[Mesh] = None, axis_name: str = "pp",
-                  data_axis: Optional[str] = None):
+                  data_axis: Optional[str] = None,
+                  stacked_specs=None, shared_specs=None,
+                  manual_axes: Optional[dict] = None):
     """Synchronous 1F1B pipeline schedule, compiled into ONE XLA program.
 
     Reference semantics: fleet/meta_parallel/pipeline_parallel.py:81
@@ -122,6 +124,13 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, shared_params,
       act_example: zeros with the canonical activation shape [micro, ...].
       data_axis: optional mesh axis the microbatch dim is sharded over
         (DP); grads/loss are psum-averaged over it.
+      stacked_specs / shared_specs: optional per-leaf PartitionSpecs for
+        TP×PP composition — stacked leaves default to P(axis_name) and
+        shared to replicated; pass specs carrying 'mp' entries to hand
+        each pp stage mp-LOCAL weight shards (reference: topology.py:133
+        composes all four axes in one HybridCommunicateGroup).
+      manual_axes: {axis: size} activated via manual_collective_axes
+        around stage tracing so TP layers emit explicit collectives.
 
     Returns (mean_loss, grads_stacked, grads_shared) — grads laid out like
     the corresponding params.
@@ -135,6 +144,12 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, shared_params,
     dp_size = mesh.shape.get(data_axis, 1) if data_axis else 1
 
     def local_fn(stacked_local, shared, inputs, targets):
+        from .parallel_layers import manual_collective_axes
+
+        with manual_collective_axes(manual_axes or {}):
+            return _local_fn_body(stacked_local, shared, inputs, targets)
+
+    def _local_fn_body(stacked_local, shared, inputs, targets):
         stage = jax.lax.axis_index(axis_name)
         local = jax.tree_util.tree_map(lambda p: p[0], stacked_local)
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -213,8 +228,11 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, shared_params,
         g_stacked = jax.tree_util.tree_map(lambda g: g[None], g_local)
         return loss, g_stacked, g_shared
 
-    pp_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    rep = jax.tree_util.tree_map(lambda _: P(), shared_params)
+    pp_specs = (stacked_specs if stacked_specs is not None else
+                jax.tree_util.tree_map(lambda _: P(axis_name),
+                                       stacked_params))
+    rep = (shared_specs if shared_specs is not None else
+           jax.tree_util.tree_map(lambda _: P(), shared_params))
     mb_spec = (P(None, data_axis) if data_axis is not None else P())
     fn = _shard_map(local_fn, mesh,
                     (pp_specs, rep, mb_spec, mb_spec),
@@ -469,6 +487,45 @@ class Compiled1F1BProgram:
         self.L = len(self.body)
         self._loss_fn = loss_fn
         self._jit_cache = {}
+        # TP×PP composition: mesh axes (beyond pp/dp) that stage params
+        # are sharded over; TP layers emit explicit collectives for these
+        # under manual_collective_axes (reference: topology.py:133 4-axis
+        # HybridCommunicateGroup — mp composes with pp in one program)
+        self.manual_axes = {
+            ax: mesh.shape[ax] for ax in ("mp",)
+            if mesh.shape.get(ax, 1) > 1}
+
+    def _leaf_entries(self, p):
+        """Param sharding entries restricted to the manual (TP) axes."""
+        from .sharding import get_sharding_spec
+
+        spec = get_sharding_spec(p)
+        if not spec:
+            return ()
+        return tuple(e if (isinstance(e, str) and e in self.manual_axes)
+                     else None for e in spec)
+
+    def read_specs(self):
+        """Per-leaf PartitionSpecs mirroring read_params()'s structure."""
+        from jax.sharding import PartitionSpec as P
+
+        shared_specs = {
+            key: [[P(*self._leaf_entries(p))
+                   for _, p in l.named_parameters()] for l in layers]
+            for key, layers in (("pro", self.prologue),
+                                ("epi", self.epilogue))}
+        body_params = [[p for _, p in l.named_parameters()]
+                       for l in self.body]
+        stacked_specs = []
+        for j in range(len(body_params[0])):
+            entries = self._leaf_entries(body_params[0][j])
+            for other in body_params[1:]:
+                if self._leaf_entries(other[j]) != entries:
+                    raise ValueError(
+                        "body layers disagree on TP sharding for leaf "
+                        f"{j}; cannot stack over the pp axis")
+            stacked_specs.append(P(self.axis_name, None, *entries))
+        return shared_specs, tuple(stacked_specs)
 
     # ---- parameter packing -------------------------------------------
     def read_params(self):
@@ -578,11 +635,14 @@ class Compiled1F1BProgram:
             mb_local = jnp.zeros((x_mb.shape[1] // dp,) + x_mb.shape[2:],
                                  x_mb.dtype)
             act = self._act_example(shared, mb_local)
+            shared_specs, stacked_specs = self.read_specs()
 
             def run(sh, st, xs, ys):
                 return pipeline_1f1b(
                     stage_fn, st, sh, xs, ys, act, mesh=self.mesh,
-                    axis_name=self.axis_name, data_axis=self.data_axis)
+                    axis_name=self.axis_name, data_axis=self.data_axis,
+                    stacked_specs=stacked_specs, shared_specs=shared_specs,
+                    manual_axes=self.manual_axes)
 
             self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key](shared, stacked, x_mb, y_mb)

@@ -179,11 +179,12 @@ class LlamaAttention(nn.Layer):
     def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
                 position_offset=0):
         B, T = hidden.shape[0], hidden.shape[1]
-        q = self.q_proj(hidden).reshape([B, T, self.num_heads, self.head_dim])
-        k = self.k_proj(hidden).reshape([B, T, self.num_kv_heads,
-                                         self.head_dim])
-        v = self.v_proj(hidden).reshape([B, T, self.num_kv_heads,
-                                         self.head_dim])
+        # head count derived from the projection's ACTUAL width: under
+        # manual TP (shard_map pipeline stages) q/k/v are mp-local shards
+        # holding num_heads/mp heads; under GSPMD they are global
+        q = self.q_proj(hidden).reshape([B, T, -1, self.head_dim])
+        k = self.k_proj(hidden).reshape([B, T, -1, self.head_dim])
+        v = self.v_proj(hidden).reshape([B, T, -1, self.head_dim])
 
         def _rope_fn(xv):
             from ..core.flags import flag
@@ -228,7 +229,7 @@ class LlamaAttention(nn.Layer):
 
             out = apply("static_cache_attention", _static_attn, q, k_buf,
                         v_buf)
-            out = out.reshape([B, T, self.num_heads * self.head_dim])
+            out = out.reshape([B, T, -1])
             return self.o_proj(out), new_cache
 
         if cache is not None:
@@ -268,7 +269,7 @@ class LlamaAttention(nn.Layer):
             return jnp.swapaxes(out, 1, 2)
 
         out = apply("attention", _attn, q, k, v)
-        out = out.reshape([B, T, self.num_heads * self.head_dim])
+        out = out.reshape([B, T, -1])
         out = self.o_proj(out)
         if cache is not None:
             return out, new_cache

@@ -25,7 +25,7 @@ from ..kernels.flash_attention import _attn_reference
 from .llama import LlamaConfig, apply_rope, precompute_rope
 
 __all__ = ["extract_pipeline_params", "make_llama_stage_fn",
-           "llama_1f1b_step_fn"]
+           "llama_1f1b_step_fn", "LlamaForCausalLMPipe"]
 
 
 def extract_pipeline_params(model):
@@ -176,6 +176,99 @@ def make_llama_stage_fn(cfg: LlamaConfig, n_stages: int):
         return h, loss
 
     return stage_fn
+
+
+# ---------------------------------------------------------------------------
+# LlamaForCausalLMPipe — Llama as a PipelineLayer for the PUBLIC fleet API
+# (fleet.distributed_model → PipelineParallel.train_batch → compiled 1F1B).
+# The decoder blocks reuse the eager LlamaDecoderLayer, whose Column/Row
+# parallel projections are mp-sharded; inside the compiled pipeline's
+# shard_map the 1F1B builder hands each pp stage mp-LOCAL weight shards and
+# the TP layers emit explicit collectives (manual_collective_axes), so
+# pp×mp×dp compose in ONE program — the reference's 4-axis
+# HybridCommunicateGroup layout (topology.py:133) with PipelineLayer
+# segmentation (pp_layers.py:159).
+# ---------------------------------------------------------------------------
+
+
+def _make_pipe_classes():
+    from .. import nn
+    from ..core.tensor import Tensor
+    from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                               VocabParallelEmbedding)
+    from .llama import LlamaRMSNorm
+
+    class EmbeddingPipe(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size)
+            self._dtype_str = cfg.dtype
+            if cfg.dtype == "bfloat16":
+                self.bfloat16()
+
+        def forward(self, ids):
+            h = self.embed_tokens(ids)
+            if self._dtype_str == "bfloat16":
+                h = h.astype("bfloat16")
+            return h
+
+    class DecoderPipe(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            from .llama import LlamaDecoderLayer
+
+            self.layer = LlamaDecoderLayer(cfg)
+            hd = cfg.hidden_size // cfg.num_attention_heads
+            cos, sin = precompute_rope(hd, cfg.max_position_embeddings,
+                                       cfg.rope_theta)
+            self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+            self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+            if cfg.dtype == "bfloat16":
+                self.bfloat16()
+
+        def forward(self, h):
+            return self.layer(h, self.rope_cos._value, self.rope_sin._value)
+
+    class HeadPipe(nn.Layer):
+        def __init__(self, cfg):
+            super().__init__()
+            self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=True)
+            if cfg.dtype == "bfloat16":
+                self.bfloat16()
+
+        def forward(self, h):
+            return self.lm_head(self.norm(h))
+
+    return EmbeddingPipe, DecoderPipe, HeadPipe
+
+
+def _llama_pipe_loss(logits, labels):
+    """Next-token shift + cross entropy, matching LlamaForCausalLM's
+    labels=... path (llama.py loss: logits[:, :-1] vs labels[:, 1:])."""
+    from ..nn import functional as F
+
+    vocab = logits.shape[-1]
+    lg = logits[:, :-1].reshape([-1, vocab])
+    lab = labels[:, 1:].reshape([-1])
+    return F.cross_entropy(lg, lab)
+
+
+def LlamaForCausalLMPipe(cfg: LlamaConfig, num_stages: Optional[int] = None):
+    """Build Llama as a PipelineLayer: [embedding] + decoder blocks +
+    [final-norm + lm-head], loss_fn = shifted cross entropy.  Pass to
+    fleet.distributed_model under a pp (optionally ×mp×dp) mesh."""
+    from ..distributed.pipeline import PipelineLayer
+
+    EmbeddingPipe, DecoderPipe, HeadPipe = _make_pipe_classes()
+    layers = ([EmbeddingPipe(cfg)]
+              + [DecoderPipe(cfg) for _ in range(cfg.num_hidden_layers)]
+              + [HeadPipe(cfg)])
+    return PipelineLayer(layers, num_stages=num_stages,
+                         loss_fn=_llama_pipe_loss)
 
 
 def llama_1f1b_step_fn(cfg: LlamaConfig, mesh, n_microbatches: int,

@@ -516,6 +516,58 @@ class Test1F1B:
         cfg.use_flash_attention = False
         return cfg
 
+    def test_manual_collective_vjp_exact(self):
+        """The Megatron f/g custom-vjp pair (identity-fwd/psum-bwd at a
+        column input, psum-fwd/identity-bwd at a row output) must give
+        grads EXACTLY matching the dense math — a plain lax.psum's
+        transpose overcounts by the axis size under check_vma=False
+        (reference autograd ops: mp_layers.py c_identity/c_allreduce)."""
+        from paddle_tpu.distributed.parallel_layers import (mp_all_gather,
+                                                            mp_allreduce,
+                                                            mp_identity,
+                                                            mp_scatter)
+
+        mesh = meshmod.init_mesh({"mp": 2}, devices=jax.devices()[:2])
+        try:
+            rng = np.random.RandomState(0)
+            x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+            w1 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+            w2 = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+
+            def dense(w1, w2, x):
+                return jnp.sum((jnp.tanh(x @ w1) @ w2) ** 2)
+
+            def local(w1, w2, x):
+                def f(w1, w2, x):
+                    # column → gather (slice-bwd) → scatter (gather-bwd)
+                    # → row: exercises all four custom ops in one chain;
+                    # raw lax.all_gather must NOT be used here — its
+                    # psum-scatter transpose overcounts replicated
+                    # cotangents exactly like bare psum does
+                    h = jnp.tanh(mp_identity(x, "mp") @ w1)
+                    h_full = mp_all_gather(h, "mp")
+                    h_local = mp_scatter(h_full, "mp")
+                    return jnp.sum(mp_allreduce(h_local @ w2, "mp") ** 2)
+
+                val, vjp = jax.vjp(f, w1, w2, x)
+                return (val,) + vjp(jnp.float32(1.0))
+
+            sm = meshmod.shard_map_compat(
+                local, mesh,
+                (P(None, "mp"), P("mp", None), P()),
+                (P(), P(None, "mp"), P("mp", None), P()))
+            out = jax.jit(sm)(w1, w2, x)
+            val_d, vjp_d = jax.vjp(dense, w1, w2, x)
+            grads_d = vjp_d(jnp.float32(1.0))
+            np.testing.assert_allclose(float(out[0]), float(val_d),
+                                       rtol=1e-5)
+            for got, want in zip(out[1:], grads_d):
+                np.testing.assert_allclose(np.asarray(got),
+                                           np.asarray(want), atol=1e-4)
+        finally:
+            meshmod._GLOBAL_MESH = None
+
+
     def test_llama_pp2_matches_pp1_10_steps(self):
         """VERDICT r1 #2 'done' bar: a REAL LM (embedding + stacked decoder
         + head) trains under pp=2 and matches the eager pp=1 model's losses
@@ -773,6 +825,94 @@ class Test1F1B:
                         jax.tree_util.tree_leaves(g_st_pp)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-6)
+
+
+class TestTPxPP:
+    """TP×PP×DP composition — the north-star layout (reference:
+    topology.py:133 4-axis HybridCommunicateGroup; hybrid tests run
+    mp×pp×dp models).  The compiled 1F1B schedule hands each pp stage
+    mp-LOCAL weight shards (stacked [pp] axis × mp column/row shards
+    simultaneously) and TP layers emit explicit collectives."""
+
+    def _cfg(self):
+        from paddle_tpu.models import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            dtype="float32", use_flash_attention=False)
+
+    def _run(self, pp, mp, dp, state=None, steps=3):
+        from paddle_tpu.distributed.pipeline import PipelineParallel
+        from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+
+        cfg = self._cfg()
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": pp, "mp_degree": mp,
+                                   "dp_degree": dp}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            np.random.seed(0)
+            pl = LlamaForCausalLMPipe(cfg, num_stages=pp)
+            if state is not None:
+                pl.set_state_dict(state)
+            saved = {k: paddle.to_tensor(np.asarray(v.numpy()).copy())
+                     for k, v in pl.state_dict().items()}
+            model = fleet.distributed_model(pl)
+            if not isinstance(model, PipelineParallel):
+                model = PipelineParallel(pl, None, strategy)
+            opt = fleet.distributed_optimizer(
+                AdamW(1e-3, parameters=pl.parameters()))
+            rng = np.random.RandomState(42)
+            M, micro, seq = 2, 4, 16
+            losses = []
+            for _ in range(steps):
+                tokens = paddle.to_tensor(rng.randint(
+                    0, cfg.vocab_size, (M * micro, seq)).astype(np.int32))
+                loss = model.train_batch((tokens, tokens), opt)
+                losses.append(float(np.asarray(loss.numpy())))
+            compiled = (isinstance(model, PipelineParallel)
+                        and model._1f1b is not None
+                        and not model._1f1b_failed)
+            return losses, saved, compiled
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
+
+    def test_pp2_mp2_dp2_matches_pp1_mp1(self):
+        base_losses, state, _ = self._run(1, 1, 1)
+        hyb_losses, _, compiled = self._run(2, 2, 2, state=state)
+        assert compiled, "pp2×mp2×dp2 must run the compiled 1F1B path"
+        for a, b in zip(base_losses, hyb_losses):
+            assert abs(a - b) < 2e-3, (base_losses, hyb_losses)
+        # three optimizer steps actually trained
+        assert hyb_losses[-1] < hyb_losses[0]
+
+    def test_pp2_mp2_stage_params_are_mp_sharded(self):
+        """The stacked stage leaves must carry BOTH the pp axis and the
+        mp column/row shards in their specs (VERDICT r4 missing #3)."""
+        from paddle_tpu.distributed.pipeline import Compiled1F1BProgram
+        from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+
+        cfg = self._cfg()
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 2,
+                                   "dp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            pl = LlamaForCausalLMPipe(cfg, num_stages=2)
+            prog = Compiled1F1BProgram(pl, meshmod.get_mesh(),
+                                       data_axis="dp")
+            assert prog.manual_axes == {"mp": 2}
+            _, stacked_specs = prog.read_specs()
+            flat = [tuple(s) for s in stacked_specs]
+            assert all(s[0] == "pp" for s in flat)
+            assert any("mp" in s for s in flat), flat
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
 
 
 class TestRecompute:
