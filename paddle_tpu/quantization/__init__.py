@@ -18,8 +18,27 @@ from ..core.dispatch import apply
 from ..core.tensor import Parameter, Tensor
 
 __all__ = ["fake_quantize_dequantize", "FakeQuantAbsMax",
-           "FakeQuantMovingAverageAbsMax", "QuantedLinear", "QuantedConv2D",
-           "ImperativeQuantAware", "PTQ", "AbsmaxObserver"]
+           "FakeQuantChannelWiseAbsMax", "FakeQuantMovingAverageAbsMax",
+           "QuantedLinear", "QuantedConv2D", "QuantedEmbedding",
+           "QuantedMatmul", "ImperativeQuantAware", "PTQ", "AbsmaxObserver",
+           "MovingAverageAbsmaxObserver", "Int8Linear", "Int8Conv2D",
+           "convert_to_int8"]
+
+
+def _ste_quant(v, s, qmax):
+    """Shared fake-quant body: quantize at scale s (already clamped),
+    straight-through gradients.  EVERY fake-quant path (per-tensor,
+    per-channel, the static quant_aware pass) and the int8 weight
+    quantizer derive from this one rounding rule so they cannot drift."""
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
+    return v + jax.lax.stop_gradient(q * s / qmax - v)
+
+
+def _channel_scale(v, quant_axis):
+    """Per-channel abs-max scale, keepdims (broadcastable against v)."""
+    red = tuple(i for i in range(v.ndim) if i != quant_axis)
+    return jnp.maximum(jnp.max(jnp.abs(v), axis=red, keepdims=True), 1e-8)
 
 
 def fake_quantize_dequantize(x, scale, bit_length=8):
@@ -27,11 +46,8 @@ def fake_quantize_dequantize(x, scale, bit_length=8):
     qmax = float(2 ** (bit_length - 1) - 1)
 
     def _fq(v, s):
-        s = jnp.maximum(s, 1e-8)
-        q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
-        dq = q * s / qmax
-        # straight-through: forward quantized, backward identity
-        return v + jax.lax.stop_gradient(dq - v)
+        return _ste_quant(v, s, qmax)
+
     return apply("fake_quant_dequant", _fq, x,
                  scale if isinstance(scale, Tensor) else Tensor(
                      jnp.asarray(scale, jnp.float32)))
@@ -48,11 +64,31 @@ class FakeQuantAbsMax(nn.Layer):
         qmax = float(2 ** (self.bit_length - 1) - 1)
 
         def _fq(v):
-            s = jnp.maximum(jnp.max(jnp.abs(v)), 1e-8)
-            q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax)
-            dq = q * s / qmax
-            return v + jax.lax.stop_gradient(dq - v)
+            return _ste_quant(v, jnp.max(jnp.abs(v)), qmax)
+
         return apply("fake_quant_abs_max", _fq, x)
+
+
+class FakeQuantChannelWiseAbsMax(nn.Layer):
+    """Per-channel abs-max weight quantization (reference:
+    fake_channel_wise_quantize_dequantize_abs_max op,
+    fake_quantize_op.cc; imperative qat.py weight_quantize_type=
+    'channel_wise_abs_max').  quant_axis is the CHANNEL axis: 1 for
+    Linear [in, out] weights, 0 for Conv2D [out, in, kh, kw]."""
+
+    def __init__(self, bit_length=8, quant_axis=0):
+        super().__init__()
+        self.bit_length = bit_length
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        axis = self.quant_axis
+
+        def _fq(v):
+            return _ste_quant(v, _channel_scale(v, axis), qmax)
+
+        return apply("fake_quant_channel_wise_abs_max", _fq, x)
 
 
 class FakeQuantMovingAverageAbsMax(nn.Layer):
@@ -64,6 +100,10 @@ class FakeQuantMovingAverageAbsMax(nn.Layer):
         self.bit_length = bit_length
         self.moving_rate = moving_rate
         self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        # True once the scale reflects real data (QAT training steps or a
+        # PTQ convert) — the int8 conversion guard keys off this, since
+        # the 1.0 init is indistinguishable from a legitimate scale
+        self.calibrated = False
 
     def forward(self, x):
         if self.training:
@@ -73,34 +113,61 @@ class FakeQuantMovingAverageAbsMax(nn.Layer):
                 cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
                 self.scale._value = (self.moving_rate * self.scale._value
                                      + (1 - self.moving_rate) * cur)
+            self.calibrated = True
         return fake_quantize_dequantize(x, self.scale, self.bit_length)
 
 
+def _make_weight_quant(kind: str, bits: int, quant_axis: int):
+    if kind == "channel_wise_abs_max":
+        return FakeQuantChannelWiseAbsMax(bits, quant_axis=quant_axis)
+    if kind == "abs_max":
+        return FakeQuantAbsMax(bits)
+    raise ValueError(
+        f"weight_quantize_type must be 'abs_max' or "
+        f"'channel_wise_abs_max', got {kind!r}")
+
+
 class QuantedLinear(nn.Layer):
-    def __init__(self, layer: nn.Linear, weight_bits=8, activation_bits=8):
+    def __init__(self, layer: nn.Linear, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max"):
         super().__init__()
         self.inner = layer
-        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        # Linear weight is [in_features, out_features] → channel axis 1
+        self.weight_quant = _make_weight_quant(weight_quantize_type,
+                                               weight_bits, quant_axis=1)
         self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits)
 
     def forward(self, x):
         from ..nn.functional.common import linear
 
+        if getattr(self, "_ptq_calibrating", False):
+            # PTQ calibration must see RAW activations: fake-quant at the
+            # uninitialized 1.0 scale would clip inputs to ±1 and every
+            # downstream observer would calibrate on distorted values
+            return linear(x, self.inner.weight, self.inner.bias)
         xq = self.act_quant(x)
         wq = self.weight_quant(self.inner.weight)
         return linear(xq, wq, self.inner.bias)
 
 
 class QuantedConv2D(nn.Layer):
-    def __init__(self, layer: nn.Conv2D, weight_bits=8, activation_bits=8):
+    def __init__(self, layer: nn.Conv2D, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max"):
         super().__init__()
         self.inner = layer
-        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        # Conv2D weight is [out, in, kh, kw] → channel axis 0
+        self.weight_quant = _make_weight_quant(weight_quantize_type,
+                                               weight_bits, quant_axis=0)
         self.act_quant = FakeQuantMovingAverageAbsMax(activation_bits)
 
     def forward(self, x):
         from ..nn.functional.conv import conv2d
 
+        if getattr(self, "_ptq_calibrating", False):
+            return conv2d(x, self.inner.weight, self.inner.bias,
+                          self.inner._stride, self.inner._padding,
+                          self.inner._dilation, self.inner._groups,
+                          self.inner._data_format)
         xq = self.act_quant(x)
         wq = self.weight_quant(self.inner.weight)
         return conv2d(xq, wq, self.inner.bias, self.inner._stride,
@@ -108,25 +175,70 @@ class QuantedConv2D(nn.Layer):
                       self.inner._groups, self.inner._data_format)
 
 
+class QuantedEmbedding(nn.Layer):
+    """Weight-quantized embedding (reference: slim quant_embedding pass —
+    abs_max table quantization; lookups read the fake-quantized table so
+    QAT trains through the STE)."""
+
+    def __init__(self, layer, weight_bits=8):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+
+    def forward(self, x):
+        from ..nn.functional.common import embedding
+
+        wq = self.weight_quant(self.inner.weight)
+        return embedding(x, wq,
+                         padding_idx=getattr(self.inner, "_padding_idx",
+                                             None))
+
+
+class QuantedMatmul(nn.Layer):
+    """Fake-quant both operands of a matmul (reference: static
+    quantization_pass.py quantizes matmul/matmul_v2 op inputs; imperative
+    models route explicit paddle.matmul calls through this wrapper)."""
+
+    def __init__(self, activation_bits=8):
+        super().__init__()
+        self.x_quant = FakeQuantMovingAverageAbsMax(activation_bits)
+        self.y_quant = FakeQuantMovingAverageAbsMax(activation_bits)
+
+    def forward(self, x, y, transpose_x=False, transpose_y=False):
+        from ..ops.math import matmul
+
+        return matmul(self.x_quant(x), self.y_quant(y),
+                      transpose_x=transpose_x, transpose_y=transpose_y)
+
+
 class ImperativeQuantAware:
     """Dygraph QAT (reference: slim ImperativeQuantAware): replaces
-    Linear/Conv2D sublayers with fake-quant wrappers in place."""
+    Linear/Conv2D/Embedding sublayers with fake-quant wrappers in place;
+    weight_quantize_type selects per-tensor or per-channel scales."""
 
     def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
-                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="abs_max", **kwargs):
         self.types = set(quantizable_layer_type)
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
 
     def quantize(self, model: nn.Layer):
         for layer in model.sublayers(include_self=True):
             for name, sub in list(layer._sub_layers.items()):
-                if type(sub).__name__ == "Linear" and "Linear" in self.types:
+                kind = type(sub).__name__
+                if kind == "Linear" and "Linear" in self.types:
                     layer._sub_layers[name] = QuantedLinear(
-                        sub, self.weight_bits, self.activation_bits)
-                elif type(sub).__name__ == "Conv2D" and "Conv2D" in self.types:
+                        sub, self.weight_bits, self.activation_bits,
+                        self.weight_quantize_type)
+                elif kind == "Conv2D" and "Conv2D" in self.types:
                     layer._sub_layers[name] = QuantedConv2D(
-                        sub, self.weight_bits, self.activation_bits)
+                        sub, self.weight_bits, self.activation_bits,
+                        self.weight_quantize_type)
+                elif kind == "Embedding" and "Embedding" in self.types:
+                    layer._sub_layers[name] = QuantedEmbedding(
+                        sub, self.weight_bits)
         return model
 
     def save_quantized_model(self, model, path, input_spec=None):
@@ -147,24 +259,192 @@ class AbsmaxObserver:
         return self.max_val
 
 
+class MovingAverageAbsmaxObserver:
+    """EMA abs-max over calibration batches (reference PTQ algo
+    'moving_average_abs_max', post_training_quantization.py) — robust to
+    a single outlier batch where plain abs_max is not."""
+
+    def __init__(self, moving_rate=0.9):
+        self.moving_rate = moving_rate
+        self.ema = None
+
+    def observe(self, x: Tensor):
+        cur = float(jnp.max(jnp.abs(x._value)))
+        self.ema = cur if self.ema is None else (
+            self.moving_rate * self.ema + (1 - self.moving_rate) * cur)
+
+    def scale(self):
+        return self.ema or 0.0
+
+    @property
+    def max_val(self):
+        return self.scale()
+
+
+# ---------------------------------------------------------------------------
+# Int8 EXECUTION (reference: the int8 path the TRT subgraph engine runs
+# after calibration, inference/tensorrt/; fake_quantize_op.cc defines the
+# quantization math).  TPU-native: int8 weights as buffers, runtime
+# activation quant at the frozen scale, lax.dot_general/conv with int8
+# inputs accumulating in int32 on the MXU, dequant epilogue in f32.
+# ---------------------------------------------------------------------------
+
+
+def _quantize_weight(w, quant_axis, qmax=127.0):
+    """(w_int8, per-channel scale broadcastable against w) — same scale
+    rule as FakeQuantChannelWiseAbsMax so QAT and int8 execution match."""
+    s = _channel_scale(w, quant_axis)
+    q = jnp.clip(jnp.round(w / s * qmax), -qmax, qmax).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
+class Int8Linear(nn.Layer):
+    """Executes y = dequant(int8(x) @ int8(w)) + b.  Built from a trained
+    QuantedLinear whose activation scale is frozen."""
+
+    def __init__(self, q: QuantedLinear):
+        super().__init__()
+        w = q.inner.weight._value.astype(jnp.float32)
+        w8, sw = _quantize_weight(w, quant_axis=1)   # [in, out] → per-out
+        self.register_buffer("w_int8", Tensor(w8))
+        self.register_buffer("w_scale", Tensor(sw))  # [1, out]
+        sx = float(np.asarray(q.act_quant.scale._value))
+        if sx <= 0 or not getattr(q.act_quant, "calibrated", False):
+            raise ValueError(
+                "Int8Linear needs a calibrated activation scale; run QAT "
+                "training or PTQ calibration before convert_to_int8")
+        self.act_scale = sx
+        self.bias = q.inner.bias
+
+    def forward(self, x):
+        sx = self.act_scale
+
+        def _int8_linear(xv, w8, sw, bv=None):
+            xq = jnp.clip(jnp.round(xv.astype(jnp.float32) / sx * 127.0),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w8, (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (sx / 127.0) * (sw / 127.0)
+            if bv is not None:
+                out = out + bv.astype(jnp.float32)
+            return out.astype(xv.dtype)
+
+        args = (x, self.w_int8, self.w_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply("int8_linear", _int8_linear, *args)
+
+
+class Int8Conv2D(nn.Layer):
+    def __init__(self, q: QuantedConv2D):
+        super().__init__()
+        inner = q.inner
+        if inner._data_format != "NCHW" or inner._groups != 1:
+            raise ValueError(
+                "Int8Conv2D supports NCHW, groups=1 (got "
+                f"{inner._data_format}, groups={inner._groups})")
+        w = inner.weight._value.astype(jnp.float32)
+        w8, sw = _quantize_weight(w, quant_axis=0)   # [out, in, kh, kw]
+        self.register_buffer("w_int8", Tensor(w8))
+        self.register_buffer("w_scale",
+                             Tensor(sw.reshape(1, -1, 1, 1)))
+        sx = float(np.asarray(q.act_quant.scale._value))
+        if sx <= 0 or not getattr(q.act_quant, "calibrated", False):
+            raise ValueError(
+                "Int8Conv2D needs a calibrated activation scale; run QAT "
+                "training or PTQ calibration before convert_to_int8")
+        self.act_scale = sx
+        self.bias = inner.bias
+        # normalize with the SAME helpers the f32 conv path uses — Paddle
+        # padding may be int, per-dim, [t,b,l,r], pair-list, or SAME/VALID
+        from ..nn.functional.conv import _padding as _norm_pad
+        from ..nn.functional.conv import _tuplize
+
+        self._stride = _tuplize(inner._stride, 2)
+        self._padding = _norm_pad(inner._padding, 2)
+        self._dilation = _tuplize(inner._dilation, 2)
+
+    def forward(self, x):
+        sx = self.act_scale
+        stride, padding, dilation = self._stride, self._padding, \
+            self._dilation
+
+        def _int8_conv(xv, w8, sw, bv=None):
+            xq = jnp.clip(jnp.round(xv.astype(jnp.float32) / sx * 127.0),
+                          -127, 127).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, w8, stride, padding, rhs_dilation=dilation,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (sx / 127.0) * (sw / 127.0)
+            if bv is not None:
+                out = out + bv.astype(jnp.float32).reshape(1, -1, 1, 1)
+            return out.astype(xv.dtype)
+
+        args = (x, self.w_int8, self.w_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply("int8_conv2d", _int8_conv, *args)
+
+
+def convert_to_int8(model: nn.Layer):
+    """Swap trained QuantedLinear/QuantedConv2D wrappers for int8-executing
+    layers (reference flow: QAT → quant_post → TRT int8 engine; here the
+    'engine' is the same XLA program with i8 dots).  The converted model
+    jit.saves like any other; the inference Predictor then provably runs
+    int8 (assert `xi8` dot_general in the exported StableHLO)."""
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantedLinear):
+                layer._sub_layers[name] = Int8Linear(sub)
+            elif isinstance(sub, QuantedConv2D):
+                layer._sub_layers[name] = Int8Conv2D(sub)
+    return model
+
+
 class PTQ:
     """Post-training quantization: run calibration batches through observers,
-    then freeze scales into fake-quant layers."""
+    then freeze scales into fake-quant layers.  algo: 'abs_max' (global
+    max over calibration) or 'moving_average_abs_max' (EMA, reference
+    post_training_quantization.py algo list)."""
 
-    def __init__(self, activation_bits=8, weight_bits=8):
+    def __init__(self, activation_bits=8, weight_bits=8, algo="abs_max",
+                 weight_quantize_type="abs_max"):
+        if algo not in ("abs_max", "moving_average_abs_max"):
+            # reference PTQ also lists KL/hist/mse/avg
+            # (post_training_quantization.py); unimplemented algos fall
+            # back rather than break ported calibration scripts
+            import warnings
+
+            warnings.warn(
+                f"PTQ algo {algo!r} not implemented on this backend; "
+                "falling back to 'abs_max'")
+            algo = "abs_max"
         self.activation_bits = activation_bits
         self.weight_bits = weight_bits
+        self.algo = algo
+        self.weight_quantize_type = weight_quantize_type
         self._observers: Dict[int, AbsmaxObserver] = {}
 
+    def _new_observer(self):
+        if self.algo == "moving_average_abs_max":
+            return MovingAverageAbsmaxObserver()
+        return AbsmaxObserver()
+
     def quantize(self, model: nn.Layer):
-        qat = ImperativeQuantAware(weight_bits=self.weight_bits,
-                                   activation_bits=self.activation_bits)
+        qat = ImperativeQuantAware(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            weight_quantize_type=self.weight_quantize_type)
         model = qat.quantize(model)
         model.eval()
-        # hooks: observe activation ranges on calibration data
+        # hooks: observe activation ranges on calibration data; fake-quant
+        # is bypassed (_ptq_calibrating) so observers see RAW activations
         for layer in model.sublayers(include_self=True):
             if isinstance(layer, (QuantedLinear, QuantedConv2D)):
-                obs = AbsmaxObserver()
+                layer._ptq_calibrating = True
+                obs = self._new_observer()
                 self._observers[id(layer)] = obs
 
                 def hook(l, inputs, _obs=obs):
@@ -175,10 +455,12 @@ class PTQ:
     def convert(self, model: nn.Layer):
         for layer in model.sublayers(include_self=True):
             if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                layer._ptq_calibrating = False
                 obs = self._observers.get(id(layer))
                 if obs and obs.max_val > 0:
                     layer.act_quant.scale._value = jnp.asarray(
                         obs.scale(), jnp.float32)
+                    layer.act_quant.calibrated = True
         return model
 
 
@@ -189,10 +471,11 @@ QAT = ImperativeQuantAware
 
 
 def quant_post_static(model, sample_generator=None, batch_nums=10,
-                      algo="abs_max", **kwargs):
+                      algo="abs_max", weight_quantize_type="abs_max",
+                      **kwargs):
     """Post-training quantization: observe activations over calibration
     batches, return the model with quant scales attached."""
-    ptq = PTQ()
+    ptq = PTQ(algo=algo, weight_quantize_type=weight_quantize_type)
     qmodel = ptq.quantize(model)
     if sample_generator is not None:
         n = 0

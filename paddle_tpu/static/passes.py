@@ -192,3 +192,52 @@ def eliminate_dead_ops(block, keep=()) -> int:
         removed_total += removed
         if not removed:
             return removed_total
+
+
+# --------------------------------------------------------------------------
+# quant_aware: static-graph QAT insertion (reference:
+# fluid/contrib/slim/quantization/quantization_pass.py — inserts
+# fake_quantize/dequantize ops before quantizable ops in the Program).
+# TPU-native: the op's lowering fn is wrapped with dynamic abs-max
+# fake-quant (STE) on its tensor operands; XLA fuses the quant math into
+# the surrounding program, and append_backward differentiates through
+# the straight-through estimator like any other op.
+# --------------------------------------------------------------------------
+
+_QUANTIZABLE_ARGS = {"matmul": (0, 1), "linear": (0, 1), "conv2d": (0, 1),
+                     "fused_linear": (0, 1), "mul": (0, 1)}
+
+
+@register_pass("quant_aware")
+def quant_aware(block, keep=(), bits=8) -> int:
+    """Wrap matmul/linear/conv2d ops with fake-quant on both operands
+    (activation AND weight), the static QAT rewrite.  Returns the number
+    of ops instrumented; idempotent via op.extra['quantized']."""
+    import jax.numpy as jnp
+
+    from ..quantization import _ste_quant
+
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def _fq(v):
+        return _ste_quant(v, jnp.max(jnp.abs(v)), qmax)
+
+    count = 0
+    for op in block.ops:
+        idxs = _QUANTIZABLE_ARGS.get(op.type)
+        if not idxs or op.extra.get("quantized"):
+            continue
+        orig = op.fn
+
+        def wrapped(*args, __orig=orig, __idxs=idxs, **kwargs):
+            args = list(args)
+            for i in __idxs:
+                if i < len(args) and hasattr(args[i], "dtype") and \
+                        jnp.issubdtype(args[i].dtype, jnp.floating):
+                    args[i] = _fq(args[i])
+            return __orig(*args, **kwargs)
+
+        op.fn = wrapped
+        op.extra["quantized"] = True
+        count += 1
+    return count
