@@ -32,7 +32,7 @@ import inspect
 import textwrap
 
 __all__ = ["convert_function", "_cvt_ifelse", "_cvt_while",
-           "_cvt_for_range"]
+           "_cvt_for_range", "_cvt_not", "_cvt_and", "_cvt_or"]
 
 _HELPERS = "__paddle_tpu_dy2static_helpers__"
 
@@ -143,12 +143,7 @@ def _cvt_while(cond_fn, body_fn, args, names=(), n_stores=None):
         n_stores = len(args)
     first = cond_fn(*args)
     if _is_tensorish(first):
-        if any(args[i] is _UNDEF for i in range(n_stores)):
-            undef = [n for n, a in zip(names, args) if a is _UNDEF]
-            raise ValueError(
-                "dy2static while over a Tensor condition: every "
-                f"loop-carried variable must be initialized before the "
-                f"loop (XLA While needs typed loop state): {undef}")
+        _check_store_operands(args, names, n_stores, "while")
         from . import while_loop
 
         op_idx = [i for i, a in enumerate(args) if _is_operand(a)]
@@ -184,6 +179,78 @@ def _cvt_while(cond_fn, body_fn, args, names=(), n_stores=None):
     return vals
 
 
+def _raw(x):
+    from ..core.tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _cvt_not(x):
+    """Tensor-aware logical not (reference: convert_operators.py
+    convert_logical_not) — used in fabricated break/return guards."""
+    if _is_tensorish(x):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_not(_raw(x)))
+    return not x
+
+
+def _cvt_and(a, b):
+    """Tensor-aware logical and (both sides evaluated — fabricated
+    conditions only, where the original expression was already
+    unconditionally evaluated per iteration)."""
+    if _is_tensorish(a) or _is_tensorish(b):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_and(_raw(a), _raw(b)))
+    return a and b
+
+
+def _cvt_or(a, b):
+    if _is_tensorish(a) or _is_tensorish(b):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_or(_raw(a), _raw(b)))
+    return a or b
+
+
+def _cvt_and_lazy(a, b_thunk):
+    """Short-circuiting and for fabricated LOOP conditions: with a plain
+    python flag the original test is NOT re-evaluated once the flag is
+    set (python `and` semantics); with a traced flag both sides trace
+    (XLA evaluates eagerly anyway)."""
+    if _is_tensorish(a):
+        return _cvt_and(a, b_thunk())
+    return a and b_thunk()
+
+
+def _check_store_operands(args, names, n_stores, kind):
+    """Every body-ASSIGNED carried value must be an operand (tensor/array/
+    scalar) under a Tensor-condition loop: XLA While needs typed loop
+    state, and a non-operand store would be silently DROPPED (the body
+    closure only returns operand positions).  _UNDEF means no binding at
+    all; None and other trace constants are equally unrepresentable."""
+    bad = [names[i] if i < len(names) else f"<arg {i}>"
+           for i in range(n_stores) if not _is_operand(args[i])]
+    if bad:
+        hint = ""
+        if _RET in bad or _RETF in bad:
+            hint = (" ('__to_static_ret*' entries mean a `return` inside "
+                    "this loop: pre-assign the result variable with the "
+                    "returned shape/dtype before the loop)")
+        raise ValueError(
+            f"dy2static {kind} over a Tensor condition: every loop-"
+            "carried variable must be initialized to a tensor/scalar "
+            f"before the loop (XLA While needs typed loop state): "
+            f"{bad}{hint}")
+
+
 def _range_cond(i, stop, step):
     """Loop-continue predicate for a lowered for-range: ``i < stop`` for
     positive step, ``i > stop`` for negative; sign-folded when the step
@@ -214,11 +281,7 @@ def _cvt_for_range(start, stop, step, body_fn, prior, args, names=(),
         return (i,) + vals
     if not _is_tensorish(step) and step == 0:
         raise ValueError("range() arg 3 must not be zero")
-    if any(args[i] is _UNDEF for i in range(n_stores or 0)):
-        undef = [n for n, a in zip(names, args) if a is _UNDEF]
-        raise ValueError(
-            "dy2static for-range over a Tensor bound: every loop-carried "
-            f"variable must be initialized before the loop: {undef}")
+    _check_store_operands(args, names, n_stores or 0, "for-range")
     from . import while_loop
 
     op_idx = [i for i, a in enumerate(args) if _is_operand(a)]
@@ -286,16 +349,31 @@ def _assigned_names(stmts):
 
 def _check_supported(stmts):
     """Raise _Unsupported if the bodies contain constructs the minimal
-    closure rewrite cannot preserve."""
+    closure rewrite cannot preserve.  break/continue are only fatal at
+    THIS nesting level — inside a nested loop they bind to that loop
+    (whose own rewrite or eager execution owns them); this-level ones
+    are lowered to flags by the caller BEFORE this check runs."""
     class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+
         def visit_Return(self, node):
             raise _Unsupported("return in controlled block")
 
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = visit_While = _loop
+
         def visit_Break(self, node):
-            raise _Unsupported("break in controlled block")
+            if self.loop_depth == 0:
+                raise _Unsupported("break in controlled block")
 
         def visit_Continue(self, node):
-            raise _Unsupported("continue in controlled block")
+            if self.loop_depth == 0:
+                raise _Unsupported("continue in controlled block")
 
         def visit_Global(self, node):
             raise _Unsupported("global in controlled block")
@@ -326,6 +404,157 @@ def _check_supported(stmts):
 
     for s in stmts:
         V().visit(s)
+
+
+def _helper_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_HELPERS, ast.Load()),
+                           attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _assign_const(n, value):
+    return ast.Assign(targets=[_name(n, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _has_break_continue(stmts):
+    """True if a Break/Continue binds to THIS level (descends ifs and
+    try/with, not nested loops or function defs)."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_For(self, node):
+            return
+
+        visit_While = visit_AsyncFor = visit_For
+
+        def visit_FunctionDef(self, node):
+            return
+
+        visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+        def visit_Break(self, node):
+            found[0] = True
+
+        def visit_Continue(self, node):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+import itertools as _itertools
+
+_FRESH_COUNTER = _itertools.count(1)
+
+
+def _is_range_for(node):
+    it = node.iter
+    return (not node.orelse and isinstance(node.target, ast.Name)
+            and isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id == "range" and not it.keywords
+            and 1 <= len(it.args) <= 3
+            and not any(isinstance(a, ast.Starred) for a in it.args))
+
+
+def _lazy_and_flag(flag, test):
+    """AST for ``_cvt_and_lazy(_cvt_not(flag), lambda: test)`` — the
+    fabricated loop condition used by both the break lowering and the
+    return-flag lowering."""
+    thunk = ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=test)
+    return _helper_call("_cvt_and_lazy", [
+        _helper_call("_cvt_not", [_name(flag, ast.Load())]), thunk])
+
+
+def _range_for_to_while(node):
+    """``for i in range(a, b, c): BODY`` → explicit while form (used when
+    the body contains break/continue/return, which the _cvt_for_range
+    closure cannot carry)::
+
+        __rng1 = a; __rng2 = b; __rng3 = c        # LTR evaluation
+        __to_static_it_N__ = __rng1
+        while _range_cond(__to_static_it_N__, __rng2, __rng3):
+            i = __to_static_it_N__
+            __to_static_it_N__ = __to_static_it_N__ + __rng3
+            BODY
+
+    The increment precedes BODY so a lowered `continue` (which guards
+    only the statements AFTER its flag-set) cannot skip it.  Post-loop
+    the loop var holds the last ITERATED value, matching python; on an
+    empty range it keeps its prior binding (or stays undefined).
+    Raises _Unsupported for non-range fors."""
+    if not _is_range_for(node):
+        raise _Unsupported("break/continue/return in a non-range for")
+    n = next(_FRESH_COUNTER)
+    arg_ns = [f"__dy2st_rng{n}_{k}__" for k in range(len(node.iter.args))]
+    setup = [ast.Assign(targets=[_name(a, ast.Store())], value=v)
+             for a, v in zip(arg_ns, node.iter.args)]
+    if len(arg_ns) == 1:
+        start, stop, step = ast.Constant(value=0), \
+            _name(arg_ns[0], ast.Load()), ast.Constant(value=1)
+    elif len(arg_ns) == 2:
+        start, stop, step = _name(arg_ns[0], ast.Load()), \
+            _name(arg_ns[1], ast.Load()), ast.Constant(value=1)
+    else:
+        start, stop, step = [_name(a, ast.Load()) for a in arg_ns]
+    it_name = f"__to_static_it_{n}__"  # carriable: not a __dy2st_ name
+    setup.append(ast.Assign(targets=[_name(it_name, ast.Store())],
+                            value=start))
+    # seed the loop var too: it is a body store, and a Tensor-bound loop
+    # needs a typed pre-loop binding (deviation: an empty range leaves
+    # the loop var at start instead of its prior binding)
+    setup.append(ast.Assign(
+        targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+        value=_name(it_name, ast.Load())))
+    test = _helper_call("_range_cond",
+                        [_name(it_name, ast.Load()), stop, step])
+    body = [ast.Assign(targets=[ast.Name(id=node.target.id,
+                                         ctx=ast.Store())],
+                       value=_name(it_name, ast.Load())),
+            ast.Assign(targets=[_name(it_name, ast.Store())],
+                       value=ast.BinOp(left=_name(it_name, ast.Load()),
+                                       op=ast.Add(), right=step))]
+    return setup, ast.While(test=test, body=body + list(node.body),
+                            orelse=[])
+
+
+def _lower_break_continue(stmts, brk, cont):
+    """Replace this-level break/continue with flag stores (reference:
+    break_continue_transformer.py BreakContinueTransformer).  Statements
+    after a flag-setting `if` are guarded by `if not (brk or cont)`;
+    statements directly after break/continue are unreachable and
+    dropped.  Returns (new_stmts, may_set_flags)."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_assign_const(brk, True))
+            return out, True
+        if isinstance(s, ast.Continue):
+            out.append(_assign_const(cont, True))
+            return out, True
+        if isinstance(s, ast.If):
+            b, fb = _lower_break_continue(s.body, brk, cont)
+            o, fo = _lower_break_continue(s.orelse, brk, cont)
+            if fb or fo:
+                out.append(ast.If(test=s.test, body=b or [ast.Pass()],
+                                  orelse=o))
+                rest, _ = _lower_break_continue(stmts[i + 1:], brk, cont)
+                if rest:
+                    guard = _helper_call("_cvt_not", [_helper_call(
+                        "_cvt_or", [_name(brk, ast.Load()),
+                                    _name(cont, ast.Load())])])
+                    out.append(ast.If(test=guard, body=rest, orelse=[]))
+                return out, True
+            out.append(s)
+            continue
+        out.append(s)
+    return out, False
 
 
 def _name(n, ctx):
@@ -467,13 +696,32 @@ class _Rewriter(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse:
             return node  # while/else: rare, unsupported
+        pre = []
+        if _has_break_continue(node.body):
+            # reference break_continue_transformer.py: lower this-level
+            # break/continue to loop-carried boolean flags, guard the
+            # trailing statements, and AND `not brk` into the condition
+            self.counter += 1
+            brk = f"__to_static_brk_{self.counter}__"
+            cont = f"__to_static_cont_{self.counter}__"
+            body, _ = _lower_break_continue(node.body, brk, cont)
+            node = ast.While(
+                test=_lazy_and_flag(brk, node.test),
+                body=[_assign_const(cont, False)] + body, orelse=[])
+            # both flags seeded OUTSIDE too: the while rewrite carries
+            # them as loop state from their pre-loop bindings
+            pre = [_assign_const(brk, False), _assign_const(cont, False)]
+            self.changed = True
+            # convert the ifs the lowering produced (the first
+            # generic_visit skipped them while they contained break)
+            self.generic_visit(node)
         try:
             _check_supported(node.body)
         except _Unsupported:
-            return node
+            return pre + [node] if pre else node
         stores = _assigned_names(node.body)
         if not stores:
-            return node
+            return pre + [node] if pre else node
         carried = self._carried(stores, node.body + [node.test])
         c_name, b_name = self._fresh("cond"), self._fresh("body")
         c_fn = _make_fn(c_name, carried, [], ast.Return(value=node.test))
@@ -498,7 +746,7 @@ class _Rewriter(ast.NodeTransformer):
                       ast.Constant(value=len(stores))],
                 keywords=[]))
         self.changed = True
-        return [_undef_guard(n) for n in carried] + [c_fn, b_fn, call]
+        return pre + [_undef_guard(n) for n in carried] + [c_fn, b_fn, call]
 
     def visit_For(self, node):
         """``for i in range(...)`` rewrites into ``_cvt_for_range``, whose
@@ -516,14 +764,18 @@ class _Rewriter(ast.NodeTransformer):
         self.generic_visit(node)
         if self.range_shadowed:
             return node  # a user `range` binding: name-match is unsound
-        if node.orelse or not isinstance(node.target, ast.Name):
+        if not _is_range_for(node):
             return node
         it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3
-                and not any(isinstance(a, ast.Starred) for a in it.args)):
-            return node
+        if _has_break_continue(node.body):
+            # reference loop_transformer: a range-for with break/continue
+            # lowers to the explicit while form, whose rewrite carries
+            # the flags as loop state
+            setup, wnode = _range_for_to_while(node)
+            result = self.visit_While(wnode)
+            self.changed = True
+            return setup + (result if isinstance(result, list)
+                            else [result])
         try:
             _check_supported(node.body)
         except _Unsupported:
@@ -573,6 +825,7 @@ class _Rewriter(ast.NodeTransformer):
 
 _RET = "__to_static_ret__"  # deliberately NOT a __dy2st_ name: it must be
 # visible to _assigned_names so the if-rewrite carries it
+_RETF = "__to_static_retflag__"  # return-flag for returns under loops
 
 
 def _count_returns(node):
@@ -635,6 +888,109 @@ def _hoist_early_returns(stmts):
     return out
 
 
+def _has_return(stmts):
+    """True if any Return exists in the statements (descending ifs,
+    loops, try/with — NOT nested function defs)."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            return
+
+        visit_AsyncFunctionDef = visit_ClassDef = visit_FunctionDef
+
+        def visit_Return(self, node):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+def _has_early_return(body):
+    """A return NOT in top-level tail position (i.e. nested under
+    control flow) remains after hoisting."""
+    return any(not isinstance(s, ast.Return) and _has_return([s])
+               for s in body)
+
+
+def _lower_returns_general(body):
+    """Flag-based return lowering (reference: return_transformer.py) —
+    handles `return` under LOOPS, which the tail hoist cannot::
+
+        while c:                 __to_static_retflag__ = False
+            if p: return A       __to_static_ret__ = None
+            S                    while _cvt_and_lazy(not RETF, c):
+        return B                     if p: RETF = True; RET = A
+                                     if _cvt_not(RETF): S
+                                 if _cvt_not(RETF): RET = B
+                                 return RET
+
+    Raises _Unsupported (caller falls back to trace) for returns under
+    try/with or non-range fors."""
+
+    def process(stmts):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign_const(_RETF, True))
+                out.append(ast.Assign(
+                    targets=[_name(_RET, ast.Store())],
+                    value=s.value if s.value is not None
+                    else ast.Constant(value=None)))
+                return out, True
+            if isinstance(s, (ast.Try, ast.With)) and _has_return([s]):
+                raise _Unsupported("return under try/with")
+            if isinstance(s, ast.If):
+                b, fb = process(s.body)
+                o, fo = process(s.orelse)
+                if fb or fo:
+                    out.append(ast.If(test=s.test, body=b or [ast.Pass()],
+                                      orelse=o))
+                    out.extend(_guard_rest(stmts[i + 1:]))
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, ast.While) and _has_return(
+                    s.body + s.orelse):
+                if s.orelse:
+                    raise _Unsupported("return in while-else")
+                nb, _ = process(s.body)
+                out.append(ast.While(
+                    test=_lazy_retf_and(s.test), body=nb, orelse=[]))
+                out.extend(_guard_rest(stmts[i + 1:]))
+                return out, True
+            if isinstance(s, ast.For) and _has_return(s.body + s.orelse):
+                setup, wnode = _range_for_to_while(s)
+                nb, _ = process(wnode.body)
+                out.extend(setup)
+                out.append(ast.While(
+                    test=_lazy_retf_and(wnode.test), body=nb, orelse=[]))
+                out.extend(_guard_rest(stmts[i + 1:]))
+                return out, True
+            out.append(s)
+        return out, False
+
+    def _guard_rest(rest_stmts):
+        rest, _ = process(rest_stmts)
+        if not rest:
+            return []
+        return [ast.If(test=_helper_call(
+            "_cvt_not", [_name(_RETF, ast.Load())]),
+            body=rest, orelse=[])]
+
+    def _lazy_retf_and(test):
+        return _lazy_and_flag(_RETF, test)
+
+    new, changed = process(body)
+    if not changed:
+        return body
+    return ([_assign_const(_RETF, False),
+             ast.Assign(targets=[_name(_RET, ast.Store())],
+                        value=ast.Constant(value=None))]
+            + new + [ast.Return(value=_name(_RET, ast.Load()))])
+
+
 def convert_function(fn):
     """Return a control-flow-converted clone of ``fn``, or ``fn`` itself
     when the pass does not apply (no rewritable statements, no source,
@@ -677,6 +1033,13 @@ def convert_function(fn):
     # visit the body statements, not fdef itself — visit_FunctionDef
     # guards NESTED defs only
     fdef.body = _hoist_early_returns(fdef.body)
+    if _has_early_return(fdef.body):
+        # returns under loops (or if-shapes the tail hoist can't touch):
+        # flag-based lowering; trace fallback on unsupported shapes
+        try:
+            fdef.body = _lower_returns_general(fdef.body)
+        except _Unsupported:
+            pass
     new_body = []
     for s in fdef.body:
         r = rw.visit(s)

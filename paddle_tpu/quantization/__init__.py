@@ -100,10 +100,22 @@ class FakeQuantMovingAverageAbsMax(nn.Layer):
         self.bit_length = bit_length
         self.moving_rate = moving_rate
         self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
-        # True once the scale reflects real data (QAT training steps or a
-        # PTQ convert) — the int8 conversion guard keys off this, since
-        # the 1.0 init is indistinguishable from a legitimate scale
-        self.calibrated = False
+        # Nonzero once the scale reflects real data (QAT training steps
+        # or a PTQ convert) — the int8 conversion guard keys off this,
+        # since the 1.0 init is indistinguishable from a legitimate
+        # scale.  A BUFFER so it survives state_dict round trips (a
+        # reloaded QAT model must stay convertible to int8).
+        self.register_buffer("calibrated_state",
+                             Tensor(jnp.zeros((), jnp.float32)))
+
+    @property
+    def calibrated(self) -> bool:
+        return float(np.asarray(self.calibrated_state._value)) > 0
+
+    @calibrated.setter
+    def calibrated(self, value: bool):
+        self.calibrated_state._value = jnp.asarray(
+            1.0 if value else 0.0, jnp.float32)
 
     def forward(self, x):
         if self.training:
@@ -290,10 +302,15 @@ class MovingAverageAbsmaxObserver:
 # ---------------------------------------------------------------------------
 
 
-def _quantize_weight(w, quant_axis, qmax=127.0):
-    """(w_int8, per-channel scale broadcastable against w) — same scale
-    rule as FakeQuantChannelWiseAbsMax so QAT and int8 execution match."""
-    s = _channel_scale(w, quant_axis)
+def _quantize_weight(w, quant_axis, qmax=127.0, per_channel=True):
+    """(w_int8, scale broadcastable against w) — the scale rule mirrors
+    the wrapper's fake-quant (per-channel FakeQuantChannelWiseAbsMax or
+    per-tensor FakeQuantAbsMax) so QAT and int8 execution match."""
+    if per_channel:
+        s = _channel_scale(w, quant_axis)
+    else:
+        s = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8)
+        s = s.reshape((1,) * w.ndim)
     q = jnp.clip(jnp.round(w / s * qmax), -qmax, qmax).astype(jnp.int8)
     return q, s.astype(jnp.float32)
 
@@ -305,7 +322,10 @@ class Int8Linear(nn.Layer):
     def __init__(self, q: QuantedLinear):
         super().__init__()
         w = q.inner.weight._value.astype(jnp.float32)
-        w8, sw = _quantize_weight(w, quant_axis=1)   # [in, out] → per-out
+        w8, sw = _quantize_weight(   # [in, out] → per-out channel
+            w, quant_axis=1,
+            per_channel=isinstance(q.weight_quant,
+                                   FakeQuantChannelWiseAbsMax))
         self.register_buffer("w_int8", Tensor(w8))
         self.register_buffer("w_scale", Tensor(sw))  # [1, out]
         sx = float(np.asarray(q.act_quant.scale._value))
@@ -345,10 +365,14 @@ class Int8Conv2D(nn.Layer):
                 "Int8Conv2D supports NCHW, groups=1 (got "
                 f"{inner._data_format}, groups={inner._groups})")
         w = inner.weight._value.astype(jnp.float32)
-        w8, sw = _quantize_weight(w, quant_axis=0)   # [out, in, kh, kw]
+        w8, sw = _quantize_weight(   # [out, in, kh, kw]
+            w, quant_axis=0,
+            per_channel=isinstance(q.weight_quant,
+                                   FakeQuantChannelWiseAbsMax))
         self.register_buffer("w_int8", Tensor(w8))
         self.register_buffer("w_scale",
-                             Tensor(sw.reshape(1, -1, 1, 1)))
+                             Tensor(sw.reshape(1, -1, 1, 1)
+                                    if sw.size > 1 else sw))
         sx = float(np.asarray(q.act_quant.scale._value))
         if sx <= 0 or not getattr(q.act_quant, "calibrated", False):
             raise ValueError(
@@ -472,10 +496,11 @@ QAT = ImperativeQuantAware
 
 def quant_post_static(model, sample_generator=None, batch_nums=10,
                       algo="abs_max", weight_quantize_type="abs_max",
-                      **kwargs):
+                      weight_bits=8, activation_bits=8, **kwargs):
     """Post-training quantization: observe activations over calibration
     batches, return the model with quant scales attached."""
-    ptq = PTQ(algo=algo, weight_quantize_type=weight_quantize_type)
+    ptq = PTQ(activation_bits=activation_bits, weight_bits=weight_bits,
+              algo=algo, weight_quantize_type=weight_quantize_type)
     qmodel = ptq.quantize(model)
     if sample_generator is not None:
         n = 0

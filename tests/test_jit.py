@@ -1031,3 +1031,161 @@ class TestControlFlowGrads:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < 0.6 * losses[0], losses
+
+
+class TestBreakContinueReturn:
+    """dy2static break/continue/return transforms (reference:
+    dygraph_to_static/break_continue_transformer.py loop-carried boolean
+    guards, return_transformer.py return-flag + result carry).  The
+    VERDICT r4 gap: these used to silently trace-fall-back, turning
+    data-dependent predicates into ConcretizationTypeErrors."""
+
+    def test_while_break_tensor_condition_trains(self):
+        """while + break over a Tensor condition compiles AND trains —
+        the gradient flows through the break guard's masked iterations."""
+        lin = nn.Linear(4, 4)
+        opt = SGD(learning_rate=0.01, parameters=lin.parameters())
+
+        @jit.to_static(loop_max_trips=12)
+        def step(x, n):
+            s = paddle.zeros_like(x)
+            i = paddle.to_tensor(np.asarray(0, np.int32))
+            while i < n:
+                s = s + lin(x)
+                if s.sum() > 6.0:
+                    break
+                i = i + 1
+            loss = ((s - 1.0) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype(np.float32))
+        n = paddle.to_tensor(np.asarray(4, np.int32))
+        losses = [float(np.asarray(step(x, n).numpy())) for _ in range(10)]
+        assert losses[-1] < losses[0], losses
+
+    def test_while_break_fires_at_right_iteration(self):
+        @jit.to_static(loop_max_trips=12)
+        def count_until(x, n, thresh):
+            s = paddle.zeros_like(x)
+            i = paddle.to_tensor(np.asarray(0, np.int32))
+            while i < n:
+                s = s + x
+                i = i + 1
+                if s.sum() >= thresh:
+                    break
+            return i
+
+        c = count_until(paddle.to_tensor(np.ones(2, np.float32)),
+                        paddle.to_tensor(np.asarray(10, np.int32)),
+                        paddle.to_tensor(np.asarray(5.9, np.float32)))
+        assert int(np.asarray(c.numpy())) == 3  # 2 per iter: 2, 4, 6
+
+    def test_for_range_continue_tensor_bound(self):
+        @jit.to_static(loop_max_trips=16)
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                if i % 2 == 0:
+                    continue
+                acc = acc + x * i
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(3, np.float32)),
+                paddle.to_tensor(np.asarray(6, np.int32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 9.0)  # 1+3+5
+
+    def test_for_range_break_tensor_bound(self):
+        @jit.to_static(loop_max_trips=16)
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+                if acc.sum() >= 6.0:
+                    break
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)),
+                paddle.to_tensor(np.asarray(10, np.int32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
+
+    def test_python_loop_break_exact_semantics(self):
+        @jit.to_static
+        def f(x):
+            i = 0
+            while i < 100:
+                i += 1
+                if i >= 5:
+                    break
+            return x + i
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 5.0)
+
+    def test_return_inside_python_loop(self):
+        """Return-flag lowering: the loop condition picks up `not retf`
+        and trailing statements are guarded."""
+        @jit.to_static
+        def f(x):
+            for i in range(10):
+                x = x + 1.0
+                if i == 3:
+                    return x * 2.0
+            return x
+
+        out = f(paddle.to_tensor(np.zeros(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 8.0)
+
+    def test_return_inside_tensor_loop_raises_actionably(self):
+        @jit.to_static(loop_max_trips=8)
+        def f(x, n):
+            i = paddle.to_tensor(np.asarray(0, np.int32))
+            while i < n:
+                if i > 2:
+                    return x * 2.0
+                i = i + 1
+            return x
+
+        with pytest.raises(ValueError, match="loop-carried"):
+            f(paddle.to_tensor(np.ones(2, np.float32)),
+              paddle.to_tensor(np.asarray(5, np.int32)))
+
+    def test_tensor_if_early_return_trains(self):
+        lin = nn.Linear(3, 3)
+        opt = SGD(learning_rate=0.05, parameters=lin.parameters())
+
+        @jit.to_static
+        def f(x):
+            h = lin(x)
+            if h.sum() > 0:
+                return (h * h).mean()
+            return ((h - 1) * (h - 1)).mean()
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        losses = []
+        for _ in range(8):
+            loss = f(x)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss.numpy())))
+        assert losses[-1] < losses[0], losses
+
+    def test_nested_loop_break_binds_to_inner(self):
+        """A break in a nested python loop must not leak into the outer
+        converted loop's flags."""
+        @jit.to_static
+        def f(x):
+            total = 0
+            for i in range(3):
+                for j in range(5):
+                    if j == 1:
+                        break
+                    total = total + 1
+            return x + total
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)

@@ -293,3 +293,37 @@ class TestStaticQuantAwarePass:
             assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
         finally:
             paddle.disable_static()
+
+
+class TestCalibrationPersistence:
+    def test_qat_state_dict_round_trip_stays_convertible(self):
+        """The calibrated flag is a BUFFER: a QAT-trained model reloaded
+        via state_dict must still convert to int8 (review r5 finding)."""
+        X, Y = _blob_data()
+        m = ImperativeQuantAware(
+            weight_quantize_type="channel_wise_abs_max").quantize(_Net())
+        _train(m, X, Y, steps=20)
+        state = m.state_dict()
+
+        fresh = ImperativeQuantAware(
+            weight_quantize_type="channel_wise_abs_max").quantize(_Net())
+        fresh.set_state_dict(state)
+        fresh.eval()
+        m8 = convert_to_int8(fresh)  # must not raise
+        out = m8(paddle.to_tensor(X[:8]))
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+    def test_per_tensor_qat_converts_per_tensor(self):
+        """Int8 weight scales mirror the wrapper's fake-quant rule:
+        default (per-tensor) QAT must not silently serve per-channel."""
+        q = QuantedLinear(nn.Linear(4, 6))  # default abs_max
+        q.act_quant.scale._value = jnp.asarray(2.0, jnp.float32)
+        q.act_quant.calibrated = True
+        m8 = Int8Linear(q)
+        assert np.asarray(m8.w_scale._value).size == 1
+        qc = QuantedLinear(nn.Linear(4, 6),
+                           weight_quantize_type="channel_wise_abs_max")
+        qc.act_quant.scale._value = jnp.asarray(2.0, jnp.float32)
+        qc.act_quant.calibrated = True
+        m8c = Int8Linear(qc)
+        assert np.asarray(m8c.w_scale._value).size == 6
