@@ -144,8 +144,6 @@ def _dot_general(g, eqn, ins):
 def _conv(g, eqn, ins):
     p = eqn.params
     dn = p["dimension_numbers"]
-    if any(d != 1 for d in p["lhs_dilation"]):
-        raise NotImplementedError("ONNX export: transposed conv (lhs_dilation)")
     lhs_spec, rhs_spec, out_spec = dn
     nsp = len(lhs_spec) - 2
     # transpose input to NC<spatial>, kernel to OI<spatial>
@@ -153,6 +151,52 @@ def _conv(g, eqn, ins):
     w = g.node("Transpose", [ins[1]], perm=list(rhs_spec))
     pads_lo = [int(lo) for lo, _ in p["padding"]]
     pads_hi = [int(hi) for _, hi in p["padding"]]
+    lhs_dil = [int(d) for d in p["lhs_dilation"]]
+    in_shape = [int(s) for s in eqn.invars[0].aval.shape]
+    sp = [in_shape[lhs_spec[2 + i]] for i in range(nsp)]
+    if any(d != 1 for d in lhs_dil):
+        # transposed conv (conv2d_transpose lowers to conv_general_dilated
+        # with lhs_dilation = stride; the kernel flip is an upstream rev
+        # eqn by jaxpr time).  ONNX has no lhs_dilation, so zero-stuff the
+        # input explicitly: [..,S,..] -> [..,S,1,..] -> pad -> [..,S*L,..]
+        # -> slice off the (L-1) trailing zeros -> plain Conv.
+        n_b, c_in = in_shape[lhs_spec[0]], in_shape[lhs_spec[1]]
+        inter = [n_b, c_in]
+        for s in sp:
+            inter += [s, 1]
+        x = g.node("Reshape", [x, g.init(
+            np.asarray(inter, np.int64), "stuff_shape")])
+        ndim = 2 + 2 * nsp
+        pad_vec = [0] * (2 * ndim)
+        for i, d in enumerate(lhs_dil):
+            pad_vec[ndim + 3 + 2 * i] = d - 1  # after-pad the 1-dims
+        x = g.node("Pad", [x, g.init(
+            np.asarray(pad_vec, np.int64), "stuff_pads")])
+        x = g.node("Reshape", [x, g.init(np.asarray(
+            [n_b, c_in] + [s * d for s, d in zip(sp, lhs_dil)],
+            np.int64), "stuffed")])
+        sp = [(s - 1) * d + 1 for s, d in zip(sp, lhs_dil)]
+        x = g.node("Slice", [
+            x,
+            g.init(np.asarray([0] * nsp, np.int64), "st"),
+            g.init(np.asarray(sp, np.int64), "en"),
+            g.init(np.asarray([2 + i for i in range(nsp)], np.int64),
+                   "ax"),
+            g.init(np.asarray([1] * nsp, np.int64), "sp")])
+    if any(v < 0 for v in pads_lo + pads_hi):
+        # XLA allows negative conv padding (transposed conv with padding
+        # > kernel-1); ONNX Conv does not — crop with Slice first
+        starts = [max(0, -lo) for lo in pads_lo]
+        ends = [s - max(0, -hi) for s, hi in zip(sp, pads_hi)]
+        x = g.node("Slice", [
+            x,
+            g.init(np.asarray(starts, np.int64), "nst"),
+            g.init(np.asarray(ends, np.int64), "nen"),
+            g.init(np.asarray([2 + i for i in range(nsp)], np.int64),
+                   "nax"),
+            g.init(np.asarray([1] * nsp, np.int64), "nsp")])
+        pads_lo = [max(0, v) for v in pads_lo]
+        pads_hi = [max(0, v) for v in pads_hi]
     out = g.node(
         "Conv", [x, w],
         strides=[int(s) for s in p["window_strides"]],
@@ -171,17 +215,29 @@ def _pool(g, eqn, ins, kind):
     win = list(p["window_dimensions"])
     strides = list(p["window_strides"])
     padding = list(p["padding"])
-    if any(d != 1 for d in p.get("base_dilation", [1] * len(win))) or \
-            any(d != 1 for d in p.get("window_dilation", [1] * len(win))):
-        raise NotImplementedError("ONNX export: dilated pooling")
-    if win[0] != 1 or win[1] != 1:
+    w_dil = list(p.get("window_dilation", [1] * len(win)))
+    if any(d != 1 for d in p.get("base_dilation", [1] * len(win))):
+        raise NotImplementedError("ONNX export: base-dilated pooling")
+    if win[0] != 1 or win[1] != 1 or w_dil[0] != 1 or w_dil[1] != 1:
         raise NotImplementedError(
             "ONNX export: reduce_window over batch/channel dims")
     kernel = [int(w) for w in win[2:]]
+    dil = [int(d) for d in w_dil[2:]]
     pads_lo = [int(lo) for lo, _ in padding[2:]]
     pads_hi = [int(hi) for _, hi in padding[2:]]
     attrs = dict(kernel_shape=kernel, strides=[int(s) for s in strides[2:]],
                  pads=pads_lo + pads_hi)
+    if any(d != 1 for d in dil):
+        if kind != "max":
+            # AveragePool only gained `dilations` at opset 19; this
+            # converter declares <= 17, so emitting it would produce a
+            # schema-invalid file that only the in-tree runtime accepts
+            raise NotImplementedError(
+                "ONNX export: dilated sum/avg pooling needs opset 19 "
+                "(AveragePool dilations); only dilated MaxPool is "
+                "supported at the declared opset")
+        # ONNX MaxPool dilations attribute (opset 10+)
+        attrs["dilations"] = dil
     if kind == "max":
         return g.node("MaxPool", ins, **attrs)
     # sum pool: AveragePool with zero-padding counted, times window size

@@ -50,17 +50,22 @@ def _attrs(node):
     return out
 
 
-def _pool_patches(x, kernel, strides, pads, pad_value=0):
-    # x: [N, C, *spatial]; returns windows [N, C, *out_spatial, *kernel]
+def _pool_patches(x, kernel, strides, pads, pad_value=0, dilations=None):
+    # x: [N, C, *spatial]; returns windows [N, C, *out_spatial, *kernel].
+    # dilations: window dilation — elements d apart within each window
+    # (ONNX MaxPool dilations / opset-19 AveragePool dilations).
     nsp = len(kernel)
+    dil = list(dilations) if dilations else [1] * nsp
+    k_eff = [(kernel[i] - 1) * dil[i] + 1 for i in range(nsp)]
     pad_width = [(0, 0), (0, 0)] + [
         (pads[i], pads[i + nsp]) for i in range(nsp)]
     xp = np.pad(x, pad_width, constant_values=pad_value)
-    out_sp = [(xp.shape[2 + i] - kernel[i]) // strides[i] + 1
+    out_sp = [(xp.shape[2 + i] - k_eff[i]) // strides[i] + 1
               for i in range(nsp)]
     windows = np.empty(list(x.shape[:2]) + out_sp + list(kernel), x.dtype)
     for idx in np.ndindex(*out_sp):
-        slc = tuple(slice(idx[i] * strides[i], idx[i] * strides[i] + kernel[i])
+        slc = tuple(slice(idx[i] * strides[i],
+                          idx[i] * strides[i] + k_eff[i], dil[i])
                     for i in range(nsp))
         windows[(slice(None), slice(None)) + idx] = xp[(slice(None),
                                                         slice(None)) + slc]
@@ -218,12 +223,14 @@ def run_model(model_bytes_or_proto, inputs):
             win, nsp = _pool_patches(x[0], a["kernel_shape"], a["strides"],
                                      a.get("pads", [0] * 2 * len(
                                          a["kernel_shape"])),
-                                     pad_value=neg)  # ONNX pads with -inf
+                                     pad_value=neg,  # ONNX pads with -inf
+                                     dilations=a.get("dilations"))
             y = win.max(axis=tuple(range(-nsp, 0)))
         elif op == "AveragePool":
             win, nsp = _pool_patches(x[0], a["kernel_shape"], a["strides"],
                                      a.get("pads", [0] * 2 * len(
-                                         a["kernel_shape"])))
+                                         a["kernel_shape"])),
+                                     dilations=a.get("dilations"))
             y = win.mean(axis=tuple(range(-nsp, 0))).astype(x[0].dtype)
         elif op == "Conv":
             y = _conv(x[0], x[1], a)
