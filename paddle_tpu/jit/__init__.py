@@ -456,7 +456,17 @@ class _CompiledEntry:
         if self._compiled is None and self._lowered is not None:
             try:
                 self._compiled = self._lowered.compile()
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                import warnings
+
+                # the plain-jit fallback RE-TRACES the python body — a
+                # documented trace-exactly-once violation (user python
+                # side effects like step counters run twice), so say so
+                # instead of silently desyncing (ADVICE r4)
+                warnings.warn(
+                    f"compiled-call build failed ({type(e).__name__}: "
+                    f"{e}); falling back to plain jit, which re-traces "
+                    "the function body (python side effects run again)")
                 self._lowered = None  # fall back to the plain jit call
         if self._compiled is not None:
             out_raw, new_state = self._compiled(

@@ -125,7 +125,7 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     return apply("normalize", _norm, _t(x))
 
 
-def _resize_taps(in_size, out_size, align_corners, cubic):
+def _resize_taps(in_size, out_size, align_corners, cubic, align_mode=0):
     """(idx [out, T] int32, w [out, T] f32): separable interpolation taps
     matching the reference/torch coordinate rules — align_corners=True
     maps i -> i*(in-1)/(out-1); False uses half-pixel centers; bicubic is
@@ -134,6 +134,10 @@ def _resize_taps(in_size, out_size, align_corners, cubic):
     i = np.arange(out_size, dtype=np.float64)
     if align_corners and out_size > 1:
         c = i * ((in_size - 1) / (out_size - 1))
+    elif align_mode == 1 and not cubic:
+        # reference align_mode=1 (interpolate_op.h): src = ratio*i, no
+        # half-pixel offset, for the linear modes only
+        c = i * (in_size / out_size)
     else:
         c = (i + 0.5) * (in_size / out_size) - 0.5
     i0 = np.floor(c)
@@ -159,8 +163,9 @@ def _resize_taps(in_size, out_size, align_corners, cubic):
     return jnp.asarray(idx), jnp.asarray(w.astype(np.float32))
 
 
-def _resize_axis(v, axis, out_size, align_corners, cubic):
-    idx, w = _resize_taps(v.shape[axis], out_size, align_corners, cubic)
+def _resize_axis(v, axis, out_size, align_corners, cubic, align_mode=0):
+    idx, w = _resize_taps(v.shape[axis], out_size, align_corners, cubic,
+                          align_mode)
     v0 = jnp.moveaxis(v, axis, 0)
     g = v0[idx]  # [out, T, ...rest]
     wb = w.astype(g.dtype).reshape(w.shape + (1,) * (g.ndim - 2))
@@ -205,10 +210,23 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             out_spatial = tuple(int(round(d * float(f)))
                                 for d, f in zip(spatial, sf))
         if mode == "nearest":
-            out_shape = list(v.shape)
+            # reference interpolate_op.h:99-104 index rule: floor(i*in/out)
+            # when align_corners=False, round(i*(in-1)/(out-1)) when True —
+            # jax.image.resize uses half-pixel centers, whose indices
+            # diverge for non-integer scales (ADVICE r4 medium)
             for a, o in zip(spatial_axes, out_spatial):
-                out_shape[a] = o
-            return jax.image.resize(v, tuple(out_shape), method="nearest")
+                in_size = v.shape[a]
+                i = np.arange(o, dtype=np.float64)
+                if align_corners and o > 1:
+                    # round HALF-UP like the reference's
+                    # static_cast<int>(c + 0.5) — np.round's half-to-even
+                    # picks the wrong pixel at exact .5 coordinates
+                    idx = np.floor(i * (in_size - 1) / (o - 1) + 0.5)
+                else:
+                    idx = np.floor(i * (in_size / o))
+                idx = np.clip(idx, 0, in_size - 1).astype(np.int32)
+                v = jnp.take(v, jnp.asarray(idx), axis=a)
+            return v
         if mode == "area":
             for a, o in zip(spatial_axes, out_spatial):
                 v = _adaptive_mean_axis(v, a, o)
@@ -216,7 +234,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         cubic = mode == "bicubic"
         dt = v.dtype
         for a, o in zip(spatial_axes, out_spatial):
-            v = _resize_axis(v, a, o, align_corners, cubic)
+            v = _resize_axis(v, a, o, align_corners, cubic, align_mode)
         return v.astype(dt)
     return apply("interpolate", _interp, _t(x))
 

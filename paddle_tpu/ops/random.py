@@ -115,12 +115,25 @@ def get_rng_state():
 
 def set_rng_state(state):
     g = _default_generator
+    legacy = True
     if isinstance(state, (list, tuple)):
         g._key = jnp.asarray(state[0])
         if len(state) > 1 and isinstance(state[1], (tuple, list)):
             g._seed, g._counter = int(state[1][0]), int(state[1][1])
+            legacy = False
     else:
         g._key = jnp.asarray(state)
+    if legacy:
+        # a single-key (pre-r4) state carries no (seed, counter) pair:
+        # reset the compiled-program chain DETERMINISTICALLY instead of
+        # silently resuming from whatever counter this process had
+        # (ADVICE r4 — compiled randomness would replay from the wrong
+        # point); fold the restored key in so distinct states still
+        # produce distinct compiled streams
+        import numpy as _np
+
+        g._seed = int(_np.asarray(g._key).ravel()[-1])
+        g._counter = 0
 
 
 def _shape(shape):

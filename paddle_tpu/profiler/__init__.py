@@ -168,6 +168,10 @@ class Profiler:
         self._step_times: List[float] = []
         self._last_step_t = None
         self._jax_trace_dir = None
+        # [(host_anchor_ns, [chrome events])] — one segment per record
+        # window, each rebased with ITS OWN anchor at export
+        self._device_segments: List[tuple] = []
+        self._device_anchor_ns = None
 
     # -- lifecycle
     def start(self):
@@ -221,6 +225,7 @@ class Profiler:
             self._jax_trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_trace_")
             try:
                 jax.profiler.start_trace(self._jax_trace_dir)
+                self._device_anchor_ns = time.perf_counter_ns()
             except Exception:
                 self._jax_trace_dir = None
 
@@ -232,17 +237,65 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            self._collect_device_events(self._jax_trace_dir)
             self._jax_trace_dir = None
+
+    def _collect_device_events(self, trace_dir):
+        """Pull the XLA profiler's chrome events (the *.trace.json.gz the
+        PJRT profiler session writes next to the xplane.pb) into this
+        profiler, so export() emits ONE file with host + device lanes —
+        the reference's merged event tree (platform/profiler/
+        chrometracing_logger.cc) instead of two disconnected dirs."""
+        import glob
+        import gzip
+
+        events = []
+        for path in glob.glob(os.path.join(
+                trace_dir, "plugins", "profile", "*", "*.trace.json.gz")):
+            try:
+                with gzip.open(path, "rt") as f:
+                    payload = json.load(f)
+            except Exception:
+                continue
+            events.extend(payload.get("traceEvents", []))
+        if events:
+            self._device_segments.append((self._device_anchor_ns, events))
 
     # -- reporting
     def _export_chrome(self, path):
         trace_events = []
+        host_pid = os.getpid()
         for tid, name, start_ns, end_ns, cat in self.events:
             trace_events.append({
                 "name": name, "cat": cat, "ph": "X",
                 "ts": start_ns / 1000.0, "dur": (end_ns - start_ns) / 1000.0,
-                "pid": os.getpid(), "tid": tid,
+                "pid": host_pid, "tid": tid,
             })
+        trace_events.append({
+            "ph": "M", "name": "process_name", "pid": host_pid,
+            "args": {"name": "host (paddle_tpu ranges)"}})
+        # device lanes ride under their own pids, rebased PER RECORD
+        # WINDOW so the two clock domains land on one timeline: each
+        # segment's earliest timestamp is pinned to the host
+        # perf_counter moment ITS start_trace returned (a global shift
+        # would stack multi-window traces on top of each other)
+        pid_off = host_pid + 100000
+        for anchor_ns, events in self._device_segments:
+            ts_events = [e for e in events if "ts" in e]
+            shift = 0.0
+            if ts_events and anchor_ns is not None:
+                shift = (anchor_ns / 1000.0
+                         - min(float(e["ts"]) for e in ts_events))
+            for e in events:
+                e = dict(e)
+                if "ts" in e:
+                    e["ts"] = float(e["ts"]) + shift
+                if "pid" in e:
+                    try:
+                        e["pid"] = int(e["pid"]) + pid_off
+                    except (TypeError, ValueError):
+                        pass
+                trace_events.append(e)
         with open(path, "w") as f:
             json.dump({"traceEvents": trace_events}, f)
         return path

@@ -891,8 +891,34 @@ class Executor:
         if isinstance(program, LoadedInferenceProgram):
             # reference contract: the program returned by
             # load_inference_model runs through exe.run(prog, feed,
-            # fetch_list=fetch_targets) like any other program
-            return program.run(feed or {})
+            # fetch_list=fetch_targets) like any other program — and a
+            # SUBSET or reordering of fetch_targets is valid, so map the
+            # requested names onto the stored output order (ADVICE r4)
+            outs = program.run(feed or {})
+            if fetch_list is None:
+                return outs
+            req = fetch_list if isinstance(fetch_list, (list, tuple)) \
+                else [fetch_list]
+            positions = {}
+            dupes = set()
+            for i, n in enumerate(program.fetch_names):
+                if n in positions:
+                    dupes.add(n)
+                else:
+                    positions[n] = i
+            picked = []
+            for r in req:
+                name = r if isinstance(r, str) else getattr(r, "name", r)
+                if name in dupes:
+                    raise ValueError(
+                        f"fetch target {name!r} is ambiguous: multiple "
+                        "outputs share that name in the saved program")
+                if name not in positions:
+                    raise KeyError(
+                        f"fetch target {name!r} not among this loaded "
+                        f"program's outputs {program.fetch_names}")
+                picked.append(outs[positions[name]])
+            return picked
         feed = feed or {}
         fetch_list = fetch_list or []
         if not isinstance(fetch_list, (list, tuple)):
