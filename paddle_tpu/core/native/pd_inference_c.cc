@@ -1,0 +1,188 @@
+// Minimal stable C inference ABI over the paddle_tpu Predictor
+// (reference: paddle/fluid/inference/capi_exp/pd_inference_api.h — the
+// C surface external serving stacks and the Go bindings link against).
+//
+// TPU-native design: the predictor is the Python/XLA serving runtime
+// (paddle_tpu/inference), so this shim embeds CPython — inside an
+// existing Python process (ctypes consumers) it joins the running
+// interpreter via the GIL; inside a plain C program it initializes one.
+// Float32 single-input/single-output convenience Run covers the
+// predictor round trip; richer IO goes through the Python API.
+//
+// Build: g++ -shared -fPIC pd_inference_c.cc $(python3-config --includes)
+//        -lpython3.X   (paddle_tpu/core/native/build.py does this)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;  // guarded by the GIL in practice
+
+struct GIL {
+  PyGILState_STATE state;
+  bool own_init = false;
+  GIL() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      own_init = true;
+    }
+    state = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(state); }
+};
+
+void capture_py_error(const char* where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  g_last_error = where;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error += ": ";
+      g_last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// run helper compiled once into __main__-independent globals: keeps the
+// C side free of the numpy C API
+const char* kHelperSrc = R"PY(
+import numpy as _np
+
+def _pd_capi_create(prog_file):
+    from paddle_tpu import inference
+    cfg = inference.Config(prog_file)
+    return inference.create_predictor(cfg)
+
+def _pd_capi_run(pred, buf, shape):
+    x = _np.frombuffer(buf, dtype=_np.float32).reshape(shape).copy()
+    outs = pred.run([x])
+    o = outs[0]
+    o = _np.asarray(o.numpy() if hasattr(o, "numpy") else o,
+                    dtype=_np.float32)
+    return o.tobytes(), list(o.shape)
+)PY";
+
+PyObject* helper_globals() {
+  static PyObject* globals = nullptr;
+  if (globals == nullptr) {
+    globals = PyDict_New();
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject* r = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
+    if (r == nullptr) {
+      capture_py_error("helper compile failed");
+      Py_CLEAR(globals);
+      return nullptr;
+    }
+    Py_DECREF(r);
+  }
+  return globals;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PD_Config {
+  std::string prog_file;
+} PD_Config;
+
+typedef struct PD_Predictor {
+  PyObject* pred;  // owned reference
+} PD_Predictor;
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigSetModel(PD_Config* cfg, const char* prog_file,
+                       const char* params_file) {
+  (void)params_file;  // jit-saved artifacts bundle weights
+  if (cfg != nullptr && prog_file != nullptr) cfg->prog_file = prog_file;
+}
+
+void PD_ConfigDestroy(PD_Config* cfg) { delete cfg; }
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Predictor* PD_PredictorCreate(PD_Config* cfg) {
+  if (cfg == nullptr) {
+    g_last_error = "null config";
+    return nullptr;
+  }
+  GIL gil;
+  PyObject* globals = helper_globals();
+  if (globals == nullptr) return nullptr;
+  PyObject* fn = PyDict_GetItemString(globals, "_pd_capi_create");
+  PyObject* pred =
+      PyObject_CallFunction(fn, "s", cfg->prog_file.c_str());
+  if (pred == nullptr) {
+    capture_py_error("PD_PredictorCreate");
+    return nullptr;
+  }
+  PD_Predictor* out = new PD_Predictor();
+  out->pred = pred;
+  return out;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (p == nullptr) return;
+  GIL gil;
+  Py_XDECREF(p->pred);
+  delete p;
+}
+
+void PD_BufferFree(void* buf) { free(buf); }
+
+// Run the predictor on ONE float32 tensor; returns 0 on success.  The
+// out_data/out_shape buffers are malloc'd — release with PD_BufferFree.
+int PD_PredictorRunFloat(PD_Predictor* p, const float* data,
+                         const int64_t* shape, int ndim, float** out_data,
+                         int64_t** out_shape, int* out_ndim) {
+  if (p == nullptr || p->pred == nullptr) {
+    g_last_error = "null predictor";
+    return 1;
+  }
+  GIL gil;
+  PyObject* globals = helper_globals();
+  if (globals == nullptr) return 1;
+
+  int64_t n = 1;
+  PyObject* pyshape = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyList_SetItem(pyshape, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), n * sizeof(float));
+  PyObject* fn = PyDict_GetItemString(globals, "_pd_capi_run");
+  PyObject* res = PyObject_CallFunctionObjArgs(fn, p->pred, buf, pyshape,
+                                               nullptr);
+  Py_DECREF(buf);
+  Py_DECREF(pyshape);
+  if (res == nullptr) {
+    capture_py_error("PD_PredictorRunFloat");
+    return 1;
+  }
+  PyObject* out_bytes = PyTuple_GetItem(res, 0);
+  PyObject* out_dims = PyTuple_GetItem(res, 1);
+  Py_ssize_t nbytes = PyBytes_Size(out_bytes);
+  *out_data = static_cast<float*>(malloc(nbytes));
+  std::memcpy(*out_data, PyBytes_AsString(out_bytes), nbytes);
+  Py_ssize_t od = PyList_Size(out_dims);
+  *out_ndim = static_cast<int>(od);
+  *out_shape = static_cast<int64_t*>(malloc(od * sizeof(int64_t)));
+  for (Py_ssize_t i = 0; i < od; ++i) {
+    (*out_shape)[i] = PyLong_AsLongLong(PyList_GetItem(out_dims, i));
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

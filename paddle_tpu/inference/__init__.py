@@ -18,7 +18,16 @@ import numpy as np
 __all__ = ["Config", "create_predictor", "DistConfig", "DistModel",
            "Predictor", "PredictorPool", "get_version", "DataType",
            "PlaceType", "PrecisionType", "Tensor", "get_trt_compile_version",
-           "get_trt_runtime_version", "get_num_bytes_of_data_type"]
+           "get_trt_runtime_version", "get_num_bytes_of_data_type",
+           "load_c_api"]
+
+
+def load_c_api():
+    """Build + load the stable C inference ABI (reference capi_exp/
+    pd_inference_api.h analog; see inference/capi.py)."""
+    from .capi import load_c_api as _load
+
+    return _load()
 
 
 def get_version():

@@ -1,0 +1,53 @@
+"""Loader for the stable C inference ABI (reference:
+paddle/fluid/inference/capi_exp/pd_inference_api.h + goapi/ — the C
+surface external serving stacks link against).
+
+The shim (core/native/pd_inference_c.cc) embeds CPython over the Python
+Predictor: C consumers get PD_ConfigCreate / PD_ConfigSetModel /
+PD_PredictorCreate / PD_PredictorRunFloat / PD_BufferFree /
+PD_GetLastError with the reference's naming.  ``load_c_api()`` builds
+(g++, first use) and returns the ctypes CDLL with argtypes configured —
+the same handle a C program gets from dlopen."""
+from __future__ import annotations
+
+import ctypes
+import sysconfig
+
+
+def _python_link_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags.append(f"-L{libdir}")
+    flags.append(f"-lpython{ver}")
+    return flags
+
+
+def load_c_api():
+    """Build + dlopen libpd_inference_c.so; returns a configured CDLL."""
+    from ..core.native.build import load_native
+
+    lib = load_native("pd_inference_c", extra_flags=_python_link_flags())
+    lib.PD_ConfigCreate.restype = ctypes.c_void_p
+    lib.PD_ConfigSetModel.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p]
+    lib.PD_ConfigDestroy.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+    lib.PD_BufferFree.argtypes = [ctypes.c_void_p]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    lib.PD_PredictorRunFloat.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.PD_PredictorRunFloat.restype = ctypes.c_int
+    return lib

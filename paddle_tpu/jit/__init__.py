@@ -178,6 +178,22 @@ class _State:
                     seen.add(id(b))
                     self.buffers.append(b)
         self.optimizers = list(optimizers)
+        # BARE tensors handed straight to an optimizer (no Layer) are
+        # state too: reference scripts train plain
+        # paddle.to_tensor(stop_gradient=False) params; without this,
+        # opt.step() under trace writes a tracer into the live value and
+        # the update is silently lost
+        for opt in self.optimizers:
+            for p in (getattr(opt, "_parameter_list", None) or ()):
+                # parameter-GROUP dicts ({'params': [...], 'lr': ...})
+                # hold bare tensors too (optimizer.py _static_minimize
+                # flattens them the same way)
+                entries = (p.get("params", []) if isinstance(p, dict)
+                           else [p])
+                for q in entries:
+                    if isinstance(q, Tensor) and id(q) not in seen:
+                        seen.add(id(q))
+                        self.params.append(q)
 
     def opt_slots(self):
         slots = []

@@ -1,0 +1,78 @@
+"""Stable C inference ABI (reference: inference/capi_exp/
+pd_inference_api.h + goapi) — PD_Config/PD_Predictor C functions over
+the serving runtime, consumed exactly as a C program would (dlopen +
+C calls via ctypes)."""
+import ctypes
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def capi():
+    from paddle_tpu.inference.capi import load_c_api
+
+    try:
+        return load_c_api()
+    except Exception as e:  # no toolchain / headers: degrade loudly
+        pytest.skip(f"C ABI build unavailable: {e}")
+
+
+@pytest.fixture(scope="module")
+def saved_model():
+    lin = nn.Linear(8, 4)
+    lin.eval()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "m")
+    jit.save(lin, path, input_spec=[InputSpec([2, 8], "float32")])
+    return lin, path
+
+
+class TestCInferenceABI:
+    def test_round_trip_matches_python_predictor(self, capi, saved_model):
+        lin, path = saved_model
+        cfg = capi.PD_ConfigCreate()
+        capi.PD_ConfigSetModel(cfg, path.encode(), None)
+        pred = capi.PD_PredictorCreate(cfg)
+        assert pred, capi.PD_GetLastError().decode()
+
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        shape = (ctypes.c_int64 * 2)(2, 8)
+        out_data = ctypes.POINTER(ctypes.c_float)()
+        out_shape = ctypes.POINTER(ctypes.c_int64)()
+        out_ndim = ctypes.c_int()
+        rc = capi.PD_PredictorRunFloat(
+            pred, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            shape, 2, ctypes.byref(out_data), ctypes.byref(out_shape),
+            ctypes.byref(out_ndim))
+        assert rc == 0, capi.PD_GetLastError().decode()
+        dims = [out_shape[i] for i in range(out_ndim.value)]
+        n = int(np.prod(dims))
+        got = np.ctypeslib.as_array(out_data,
+                                    shape=(n,)).reshape(dims).copy()
+        capi.PD_BufferFree(out_data)
+        capi.PD_BufferFree(out_shape)
+        want = np.asarray(lin(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        capi.PD_PredictorDestroy(pred)
+        capi.PD_ConfigDestroy(cfg)
+
+    def test_bad_model_path_reports_error(self, capi):
+        cfg = capi.PD_ConfigCreate()
+        capi.PD_ConfigSetModel(cfg, b"/nonexistent/model", None)
+        pred = capi.PD_PredictorCreate(cfg)
+        assert not pred
+        assert capi.PD_GetLastError()
+        capi.PD_ConfigDestroy(cfg)
+
+    def test_null_safety(self, capi):
+        assert not capi.PD_PredictorCreate(None)
+        capi.PD_PredictorDestroy(None)
+        capi.PD_ConfigDestroy(None)
