@@ -24,11 +24,13 @@ std::string g_last_error;  // guarded by the GIL in practice
 
 struct GIL {
   PyGILState_STATE state;
-  bool own_init = false;
   GIL() {
     if (!Py_IsInitialized()) {
       Py_InitializeEx(0);
-      own_init = true;
+      // Py_InitializeEx leaves this thread HOLDING the GIL; release it
+      // so other threads of a multithreaded C consumer can Ensure —
+      // otherwise their first call deadlocks forever
+      PyEval_SaveThread();
     }
     state = PyGILState_Ensure();
   }
@@ -42,8 +44,13 @@ void capture_py_error(const char* where) {
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
     if (s != nullptr) {
+      const char* text = PyUnicode_AsUTF8(s);
+      if (text == nullptr) {  // non-UTF-8 message: report what we can
+        PyErr_Clear();
+        text = "<error text not UTF-8 encodable>";
+      }
       g_last_error += ": ";
-      g_last_error += PyUnicode_AsUTF8(s);
+      g_last_error += text;
       Py_DECREF(s);
     }
   }
@@ -154,13 +161,30 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float* data,
   if (globals == nullptr) return 1;
 
   int64_t n = 1;
-  PyObject* pyshape = PyList_New(ndim);
   for (int i = 0; i < ndim; ++i) {
+    if (shape[i] < 0) {
+      g_last_error =
+          "negative shape dimension: PD_PredictorRunFloat needs a "
+          "concrete shape (dynamic -1 dims are a Python-API feature)";
+      return 1;
+    }
     n *= shape[i];
+  }
+  PyObject* pyshape = PyList_New(ndim);
+  if (pyshape == nullptr) {
+    capture_py_error("PD_PredictorRunFloat: shape alloc");
+    return 1;
+  }
+  for (int i = 0; i < ndim; ++i) {
     PyList_SetItem(pyshape, i, PyLong_FromLongLong(shape[i]));
   }
   PyObject* buf = PyBytes_FromStringAndSize(
       reinterpret_cast<const char*>(data), n * sizeof(float));
+  if (buf == nullptr) {
+    Py_DECREF(pyshape);
+    capture_py_error("PD_PredictorRunFloat: input buffer");
+    return 1;
+  }
   PyObject* fn = PyDict_GetItemString(globals, "_pd_capi_run");
   PyObject* res = PyObject_CallFunctionObjArgs(fn, p->pred, buf, pyshape,
                                                nullptr);

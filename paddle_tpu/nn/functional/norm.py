@@ -209,7 +209,11 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         window[ch_axis] = size
         summed = jax.lax.reduce_window(
             sq, 0.0, jax.lax.add, tuple(window), (1,) * v.ndim, "VALID")
-        return v / jnp.power(k + alpha * summed, beta)
+        # the reference IMPLEMENTS avg_pool over the zero-padded window
+        # (norm.py:547 — divisor always `size`, edges included), i.e.
+        # k + alpha*sum/size, like torch; its docstring's alpha*sum is
+        # not what it computes (verified element-exact vs torch oracle)
+        return v / jnp.power(k + alpha * summed / size, beta)
     return apply("local_response_norm", _lrn, _t(x))
 
 

@@ -76,3 +76,22 @@ class TestCInferenceABI:
         assert not capi.PD_PredictorCreate(None)
         capi.PD_PredictorDestroy(None)
         capi.PD_ConfigDestroy(None)
+
+    def test_negative_shape_rejected(self, capi, saved_model):
+        _, path = saved_model
+        cfg = capi.PD_ConfigCreate()
+        capi.PD_ConfigSetModel(cfg, path.encode(), None)
+        pred = capi.PD_PredictorCreate(cfg)
+        shape = (ctypes.c_int64 * 2)(-1, 8)
+        out_data = ctypes.POINTER(ctypes.c_float)()
+        out_shape = ctypes.POINTER(ctypes.c_int64)()
+        out_ndim = ctypes.c_int()
+        x = np.zeros((2, 8), np.float32)
+        rc = capi.PD_PredictorRunFloat(
+            pred, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            shape, 2, ctypes.byref(out_data), ctypes.byref(out_shape),
+            ctypes.byref(out_ndim))
+        assert rc != 0
+        assert b"negative shape" in capi.PD_GetLastError()
+        capi.PD_PredictorDestroy(pred)
+        capi.PD_ConfigDestroy(cfg)

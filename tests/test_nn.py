@@ -682,3 +682,19 @@ class TestNNExtrasR2:
         out = ids.numpy()[0]
         # the immediately-finished beam survives as pure end tokens
         assert (out == 1).all(axis=-1).any(), out
+
+
+class TestLocalResponseNormOracle:
+    def test_matches_torch_and_reference_avg_semantics(self):
+        """The reference IMPLEMENTS k + alpha*sum/size (avg_pool over the
+        zero-padded channel window, norm.py:547) even though its
+        docstring says alpha*sum; torch agrees with the implementation.
+        Found by the round-5 oracle probe (we followed the docstring)."""
+        import torch
+        import torch.nn.functional as tF
+
+        x = np.random.RandomState(0).randn(2, 8, 5, 5).astype(np.float32)
+        ours = np.asarray(F.local_response_norm(
+            paddle.to_tensor(x), size=5).numpy())
+        want = tF.local_response_norm(torch.tensor(x), size=5).numpy()
+        np.testing.assert_allclose(ours, want, atol=1e-6)
