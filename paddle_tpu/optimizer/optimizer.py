@@ -378,11 +378,16 @@ def _adagrad_rule(p, moment, g, lr, eps, wd):
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def _adadelta_rule(p, avg_sq_grad, avg_sq_update, g, lr, rho, eps, wd):
+    # reference adadelta_kernel_impl.h:54: param += update with NO
+    # learning-rate factor (classic Adadelta; the phi kernel takes no LR
+    # input, so paddle's learning_rate arg is inert) — multiplying by
+    # the default lr=0.001 made updates 1000x too small
+    del lr
     g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
     avg_sq_grad = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
     update = jnp.sqrt(avg_sq_update + eps) / jnp.sqrt(avg_sq_grad + eps) * g
     avg_sq_update = rho * avg_sq_update + (1 - rho) * jnp.square(update)
-    return (p.astype(jnp.float32) - lr * update).astype(p.dtype), \
+    return (p.astype(jnp.float32) - update).astype(p.dtype), \
         avg_sq_grad, avg_sq_update
 
 
@@ -404,8 +409,10 @@ def _rmsprop_rule(p, mean_sq, mom, g, lr, rho, eps, momentum, wd, mean_g,
 def _adamax_rule(p, m, u, g, lr, beta1, beta2, eps, step, wd):
     g = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
     m = beta1 * m + (1 - beta1) * g
-    u = jnp.maximum(beta2 * u, jnp.abs(g))
-    new_p = p.astype(jnp.float32) - lr / (1 - beta1 ** step) * m / (u + eps)
+    # reference adamax_kernel_impl.h:60: eps rides INSIDE the max
+    # (u = max(|g|, beta2*u + eps)), and the denominator gets u alone
+    u = jnp.maximum(jnp.abs(g), beta2 * u + eps)
+    new_p = p.astype(jnp.float32) - lr / (1 - beta1 ** step) * m / u
     return new_p.astype(p.dtype), m, u
 
 

@@ -296,3 +296,63 @@ class TestAMP:
             scaler.step(opt)
             opt.clear_grad()
         assert np.isfinite(net.weight.numpy()).all()
+
+
+class TestUpdateRulesExact:
+    """Element-exact update-rule oracles against the reference phi
+    kernels (round-5 audit; found: Adadelta multiplied by lr where
+    adadelta_kernel_impl.h:54 has none, Adamax put eps in the
+    denominator where adamax_kernel_impl.h:60 puts it inside the max)."""
+
+    def _one_step(self, opt_cls, kw):
+        p0 = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        g0 = np.asarray([0.1, -0.2, 0.3, -0.4], np.float32)
+        w = paddle.to_tensor(p0.copy())
+        w.stop_gradient = False
+        opt = opt_cls(parameters=[w], **kw)
+        w.grad = paddle.to_tensor(g0.copy())
+        opt.step()
+        return p0, g0, np.asarray(w.numpy())
+
+    def test_momentum_matches_kernel(self):
+        p0, g, got = self._one_step(
+            Momentum, dict(learning_rate=0.1, momentum=0.9))
+        vel = 0.9 * 0.0 + g
+        np.testing.assert_allclose(got, p0 - 0.1 * vel, rtol=1e-6)
+
+    def test_adagrad_matches_kernel(self):
+        p0, g, got = self._one_step(Adagrad, dict(learning_rate=0.1))
+        moment = g * g
+        want = p0 - 0.1 * g / (np.sqrt(moment) + 1e-6)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_adadelta_matches_kernel_no_lr(self):
+        """adadelta_kernel_impl.h: param += -sqrt((asu+eps)/(asg+eps))*g
+        — the learning rate does NOT appear."""
+        p0, g, got = self._one_step(
+            Adadelta, dict(learning_rate=123.0))  # any lr: must be inert
+        eps, rho = 1e-6, 0.95
+        asg = (1 - rho) * g * g
+        upd = np.sqrt((0.0 + eps) / (asg + eps)) * g
+        np.testing.assert_allclose(got, p0 - upd, rtol=1e-5)
+        _, _, got2 = self._one_step(
+            Adadelta, dict(learning_rate=0.001))
+        np.testing.assert_allclose(got, got2, rtol=1e-6)  # lr-independent
+
+    def test_adamax_matches_kernel_eps_in_max(self):
+        p0, g, got = self._one_step(
+            Adamax, dict(learning_rate=0.1))
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m = (1 - b1) * g
+        u = np.maximum(np.abs(g), b2 * 0.0 + eps)
+        want = p0 - 0.1 / (1 - b1) * m / u
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_rmsprop_matches_kernel_eps_inside_sqrt(self):
+        """rmsprop_kernel_impl.h:82: lr*g/sqrt(ms + eps) — eps INSIDE
+        the sqrt (torch puts it outside; the reference is the oracle)."""
+        p0, g, got = self._one_step(
+            RMSProp, dict(learning_rate=0.1, rho=0.95))
+        ms = 0.05 * g * g
+        mom = 0.1 * g / np.sqrt(ms + 1e-6)
+        np.testing.assert_allclose(got, p0 - mom, rtol=1e-5)
