@@ -226,7 +226,7 @@ def create_parameter(shape, dtype, name=None, attr=None,
     if init is None:
         # same defaults as the static path (static/graph.py
         # create_parameter), so behavior doesn't depend on the mode
-        init = _I.Constant(0.0) if is_bias else _I.XavierNormal()
+        init = _I.Constant(0.0) if is_bias else _I.XavierUniform()
     p = Parameter(_jnp.zeros(tuple(int(s) for s in shape), _to_np(dtype)),
                   name=name)
     with no_grad():

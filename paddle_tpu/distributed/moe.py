@@ -95,12 +95,12 @@ class ExpertMLP(nn.Layer):
         self.activation = activation
         self.w1 = self.create_parameter(
             [num_experts, d_model, d_hidden],
-            default_initializer=I.XavierNormal())
+            default_initializer=I.XavierUniform())
         self.b1 = self.create_parameter([num_experts, 1, d_hidden],
                                         is_bias=True)
         self.w2 = self.create_parameter(
             [num_experts, d_hidden, d_model],
-            default_initializer=I.XavierNormal())
+            default_initializer=I.XavierUniform())
         self.b2 = self.create_parameter([num_experts, 1, d_model],
                                         is_bias=True)
         for p in (self.w1, self.b1, self.w2, self.b2):

@@ -174,7 +174,7 @@ class ColumnParallelLinear(nn.Layer):
         self.world_size = _mp_size()
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
-            default_initializer=I.XavierNormal())
+            default_initializer=I.XavierUniform())
         mark_sharding(self.weight, PartitionSpec(None, "mp"))
         if has_bias:
             self.bias = self.create_parameter([out_features], is_bias=True)
@@ -213,7 +213,7 @@ class RowParallelLinear(nn.Layer):
         self.world_size = _mp_size()
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
-            default_initializer=I.XavierNormal())
+            default_initializer=I.XavierUniform())
         mark_sharding(self.weight, PartitionSpec("mp", None))
         if has_bias:
             self.bias = self.create_parameter([out_features], is_bias=True)

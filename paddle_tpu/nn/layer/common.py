@@ -20,7 +20,7 @@ class Linear(Layer):
         self.out_features = out_features
         self.weight = self.create_parameter(
             [in_features, out_features], attr=weight_attr,
-            default_initializer=init.XavierNormal())
+            default_initializer=init.XavierUniform())
         if bias_attr is not False:
             self.bias = self.create_parameter(
                 [out_features], attr=bias_attr, is_bias=True)

@@ -109,7 +109,11 @@ class Layer:
             if is_bias:
                 default_initializer = init.Constant(0.0)
             else:
-                default_initializer = init.XavierNormal()
+                # reference default: ParamAttr._set_default_param_
+                # initializer uses Xavier() with uniform=True
+                # (param_attr.py:144, initializer.py:506) — U(±sqrt(6/
+                # (fan_in+fan_out))), NOT the normal variant
+                default_initializer = init.XavierUniform()
         # ParamAttr support: attr may carry name/initializer/trainable
         trainable = True
         if attr is not None and attr is not False:

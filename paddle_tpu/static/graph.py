@@ -1031,7 +1031,7 @@ def create_parameter(shape, dtype, name=None, initializer=None,
     from ..nn import initializer as I
 
     if initializer is None:
-        initializer = I.Constant(0.0) if is_bias else I.XavierNormal()
+        initializer = I.Constant(0.0) if is_bias else I.XavierUniform()
     prog = default_startup_program()
     name = name or default_main_program()._unique_name("param")
     shape = tuple(int(s) for s in shape)

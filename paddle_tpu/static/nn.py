@@ -30,7 +30,7 @@ def _param(shape, dtype, attr, is_bias=False, default=None):
     elif attr is not None:
         init = attr
     if init is None:
-        init = default or (I.Constant(0.0) if is_bias else I.XavierNormal())
+        init = default or (I.Constant(0.0) if is_bias else I.XavierUniform())
     return G.create_parameter(shape, dtype, name=name, initializer=init,
                               is_bias=is_bias, trainable=trainable)
 
