@@ -164,15 +164,29 @@ class Categorical(Distribution):
             key, self.logits._value.astype(jnp.float32),
             shape=shp if shp else None).astype(jnp.int64))
 
-    @property
-    def probs(self):
-        return apply("softmax", lambda l: jax.nn.softmax(l, -1), self.logits)
+    def probs(self, value):
+        """Probabilities of the given category indices (reference
+        categorical.py:266 — a METHOD taking `value`, not the full
+        softmax; 1-D logits gather all entries, batched logits take
+        along the last axis)."""
+        return self.prob(value)
 
     def log_prob(self, value):
         def _lp(lg, v):
             logp = jax.nn.log_softmax(lg, -1)
-            return jnp.take_along_axis(
-                logp, v[..., None].astype(jnp.int32), -1)[..., 0]
+            v = v.astype(jnp.int32)
+            # reference categorical.py probs(): 1-D logits gather ALL
+            # value entries from the one distribution (output
+            # value.shape); batched logits take a 1-D value broadcast
+            # across distributions, or an aligned value along axis -1
+            if logp.ndim == 1:
+                return logp[v.reshape(-1)].reshape(v.shape)
+            if v.ndim == 1:
+                vb = v.reshape((1,) * (logp.ndim - 1) + (-1,))
+                return jnp.take_along_axis(
+                    logp, jnp.broadcast_to(
+                        vb, logp.shape[:-1] + (v.shape[0],)), -1)
+            return jnp.take_along_axis(logp, v, -1)
         return apply("categorical_log_prob", _lp, self.logits, _t(value))
 
     def entropy(self):

@@ -821,3 +821,37 @@ class TestSubmConvNative:
         t_dense = best_of(dense_path, 3)
         assert t_native * 5 < t_dense, (
             f"native {t_native * 1e3:.1f}ms vs dense {t_dense * 1e3:.1f}ms")
+
+
+class TestCategoricalReference:
+    """Reference categorical.py semantics (round-5 audit: vector value
+    over 1-D logits crashed; probs was wrongly a full-softmax property
+    where the reference has a METHOD taking category indices)."""
+
+    def test_vector_value_over_one_distribution(self):
+        from paddle_tpu.distribution import Categorical
+
+        probs = np.asarray([0.2, 0.3, 0.5], np.float32)
+        ci = np.asarray([0, 2, 1], np.int64)
+        c = Categorical(probs=paddle.to_tensor(probs))
+        lp = np.asarray(c.log_prob(paddle.to_tensor(ci)).numpy())
+        np.testing.assert_allclose(lp, np.log(probs[ci]), atol=1e-5)
+        pm = np.asarray(c.probs(paddle.to_tensor(ci)).numpy())
+        np.testing.assert_allclose(pm, probs[ci], atol=1e-5)
+
+    def test_batched_logits_broadcast_value(self):
+        from paddle_tpu.distribution import Categorical
+
+        pr = np.asarray([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]], np.float32)
+        c = Categorical(probs=paddle.to_tensor(pr))
+        # reference: 1-D value broadcasts across the distributions ->
+        # [n_dist, len(value)]
+        out = np.asarray(c.probs(paddle.to_tensor(
+            np.asarray([2, 0], np.int64))).numpy())
+        np.testing.assert_allclose(
+            out, [[0.5, 0.2], [0.1, 0.6]], atol=1e-5)
+        # aligned value: one index per distribution
+        lp = np.asarray(c.log_prob(paddle.to_tensor(
+            np.asarray([[2], [0]], np.int64))).numpy())
+        np.testing.assert_allclose(lp[:, 0], np.log([0.5, 0.6]),
+                                   atol=1e-5)
