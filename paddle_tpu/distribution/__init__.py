@@ -186,6 +186,10 @@ class Categorical(Distribution):
                 return jnp.take_along_axis(
                     logp, jnp.broadcast_to(
                         vb, logp.shape[:-1] + (v.shape[0],)), -1)
+            if v.ndim == logp.ndim - 1:
+                # aligned per-batch index gather ([B,T] value over
+                # [B,T,K] logits — the per-token case)
+                return jnp.take_along_axis(logp, v[..., None], -1)[..., 0]
             return jnp.take_along_axis(logp, v, -1)
         return apply("categorical_log_prob", _lp, self.logits, _t(value))
 

@@ -1941,3 +1941,50 @@ class TestLaunchController:
         ctrl = Controller(str(script), [], nnodes=1,
                           log_dir=str(tmp_path / "log"))
         assert ctrl.run() == 3
+
+
+class TestFourAxisComposition:
+    """pp × mp × sharding in ONE program — the reference's full 4-axis
+    HybridCommunicateGroup order [data, pipe, sharding, model]
+    (topology.py:159) with dp folded to 1 on the 8-device mesh."""
+
+    def test_pp_mp_sharding_trains_with_sharded_slots(self):
+        from paddle_tpu.distributed.pipeline import PipelineParallel
+        from paddle_tpu.models import LlamaConfig
+        from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+        from paddle_tpu.optimizer import AdamW as _AdamW
+
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            dtype="float32", use_flash_attention=False)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 2,
+                                   "sharding_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        strategy.sharding_configs = {"stage": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            pl = LlamaForCausalLMPipe(cfg, num_stages=2)
+            model = fleet.distributed_model(pl)
+            assert isinstance(model, PipelineParallel)
+            opt = fleet.distributed_optimizer(
+                _AdamW(1e-3, parameters=pl.parameters()))
+            rng = np.random.RandomState(0)
+            losses = []
+            for _ in range(3):
+                tokens = paddle.to_tensor(
+                    rng.randint(0, 64, (4, 16)).astype(np.int32))
+                loss = model.train_batch((tokens, tokens), opt)
+                losses.append(float(np.asarray(loss.numpy())))
+            assert all(np.isfinite(v) for v in losses), losses
+            assert model._1f1b is not None and not model._1f1b_failed
+            slots = opt._accumulators.get("moment1", {})
+            assert any("sharding" in str(a.sharding.spec)
+                       for a in slots.values()
+                       if hasattr(a, "sharding")), (
+                "ZeRO-1 slots must shard over the 'sharding' axis")
+        finally:
+            meshmod._GLOBAL_MESH = None
+            meshmod._GLOBAL_HCG = None
