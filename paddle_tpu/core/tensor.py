@@ -120,6 +120,15 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._value.shape[0]
 
+    def __iter__(self):
+        """Iterate the leading dim (reference Tensor iteration).  MUST
+        be explicit: without it python falls back to the __getitem__
+        sequence protocol, and jnp's CLIPPED out-of-range indexing never
+        raises IndexError — `for row in tensor` spun forever."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self._value.shape[0]))
+
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
         try:
