@@ -1,0 +1,55 @@
+"""Llama through the full hybrid-parallel fleet API — the north-star
+layout (reference: fleet.init + distributed_model + distributed_optimizer
+over a 4-axis HybridCommunicateGroup, topology.py:133).
+
+Runs on the 8-virtual-device CPU mesh out of the box:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/hybrid_parallel_llama.py
+
+On real hardware the SAME script spans the chips jax.devices() reports —
+pp stages ride collective-permute over ICI, mp shards ride all-reduce,
+ZeRO-1 optimizer slots shard over the 'sharding' axis, all inside ONE
+compiled 1F1B program per step.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.models import LlamaConfig
+from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+from paddle_tpu.optimizer import AdamW
+
+cfg = LlamaConfig(
+    vocab_size=128, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=64, dtype="float32",
+    use_flash_attention=False)
+
+strategy = DistributedStrategy()
+strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 2,
+                           "sharding_degree": 2}
+strategy.pipeline_configs = {"accumulate_steps": 2}
+strategy.sharding_configs = {"stage": 1}
+fleet.init(is_collective=True, strategy=strategy)
+
+model = fleet.distributed_model(LlamaForCausalLMPipe(cfg, num_stages=2))
+opt = fleet.distributed_optimizer(
+    AdamW(3e-4, parameters=model._layers.parameters()))
+
+rng = np.random.RandomState(0)
+for step in range(8):
+    tokens = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32))
+    loss = model.train_batch((tokens, tokens), opt)
+    print(f"step {step}: loss {float(np.asarray(loss.numpy())):.4f}")
+
+assert model._1f1b is not None and not model._1f1b_failed, \
+    "expected the compiled 1F1B path"
+slots = opt._accumulators.get("moment1", {})
+n_sharded = sum("sharding" in str(a.sharding.spec)
+                for a in slots.values() if hasattr(a, "sharding"))
+print(f"compiled 1F1B with mp-sharded stages; "
+      f"{n_sharded} optimizer slots sharded over the 'sharding' axis")
+print("HYBRID PARALLEL OK")
