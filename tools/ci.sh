@@ -27,6 +27,7 @@ python examples/pretrain_llama.py --steps 2 --batch 2 --seq 32
 python examples/generate_text.py
 python examples/export_and_serve.py
 python examples/compat_journeys.py
+python examples/hybrid_parallel_llama.py
 
 echo "== multichip dryrun =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
