@@ -89,7 +89,11 @@ def mode(x, axis=-1, keepdim=False, name=None):
         best_run = jnp.argmax(counts, axis=-1)
         first_idx_of_run = jnp.argmax(run_id == best_run[..., None], axis=-1)
         values = jnp.take_along_axis(sorted_v, first_idx_of_run[..., None], -1)[..., 0]
-        orig_idx = jnp.argmax(vm == values[..., None], axis=-1).astype(jnp.int64)
+        # reference funcs/mode.h:113 records the index at the END of the
+        # sorted run — the LAST original occurrence of the mode value
+        # (torch agrees); argmax-over-equality would give the first
+        rev_pos = jnp.argmax((vm == values[..., None])[..., ::-1], axis=-1)
+        orig_idx = (n - 1 - rev_pos).astype(jnp.int64)
         if keepdim:
             return (jnp.expand_dims(jnp.moveaxis(values, -1, -1), ax),
                     jnp.expand_dims(orig_idx, ax))

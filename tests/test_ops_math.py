@@ -194,3 +194,22 @@ class TestSearchSort:
             paddle.searchsorted(paddle.to_tensor(seq),
                                 paddle.to_tensor(vals)).numpy(),
             np.searchsorted(seq, vals))
+
+
+class TestModeTieIndex:
+    def test_mode_returns_last_occurrence_index(self):
+        """Reference funcs/mode.h:113 records the index at the END of
+        the sorted run — the LAST original occurrence (torch agrees);
+        we returned the first (round-5 stat-op oracle sweep)."""
+        import torch
+
+        m = np.asarray([[1., 2., 2., 3.], [3., 3., 1., 2.]], np.float32)
+        mv, mi = paddle.mode(paddle.to_tensor(m))
+        tv, ti = torch.mode(torch.tensor(m), -1)
+        np.testing.assert_allclose(np.asarray(mv.numpy()), tv.numpy())
+        np.testing.assert_array_equal(np.asarray(mi.numpy()), ti.numpy())
+        mv2, mi2 = paddle.mode(paddle.to_tensor(m), axis=0, keepdim=True)
+        tv2, ti2 = torch.mode(torch.tensor(m), 0, keepdim=True)
+        np.testing.assert_allclose(np.asarray(mv2.numpy()), tv2.numpy())
+        np.testing.assert_array_equal(np.asarray(mi2.numpy()),
+                                      ti2.numpy())
