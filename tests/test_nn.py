@@ -698,3 +698,52 @@ class TestLocalResponseNormOracle:
             paddle.to_tensor(x), size=5).numpy())
         want = tF.local_response_norm(torch.tensor(x), size=5).numpy()
         np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+class TestRNNFamilyTorchOracle:
+    """Element-exact parity vs torch with transplanted weights (round-5
+    sweep; LSTM was pinned in r4 — GRU/SimpleRNN/BiLSTM join it)."""
+
+    def _transplant(self, tmod, pl_state, rename=lambda k: k):
+        import torch
+
+        with torch.no_grad():
+            for k, v in pl_state.items():
+                getattr(tmod, rename(k)).copy_(
+                    torch.tensor(np.asarray(v.numpy())))
+
+    def test_gru_matches_torch(self):
+        import torch
+
+        g = nn.GRU(3, 4)
+        tg = torch.nn.GRU(3, 4, batch_first=True)
+        self._transplant(tg, g.state_dict())
+        x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+        out_p, _ = g(paddle.to_tensor(x))
+        out_t, _ = tg(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()),
+                                   out_t.detach().numpy(), atol=1e-5)
+
+    def test_simple_rnn_matches_torch(self):
+        import torch
+
+        s = nn.SimpleRNN(3, 4)
+        ts = torch.nn.RNN(3, 4, batch_first=True)
+        self._transplant(ts, s.state_dict())
+        x = np.random.RandomState(1).randn(2, 5, 3).astype(np.float32)
+        out_p, _ = s(paddle.to_tensor(x))
+        out_t, _ = ts(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()),
+                                   out_t.detach().numpy(), atol=1e-5)
+
+    def test_bidirectional_lstm_matches_torch(self):
+        import torch
+
+        bl = nn.LSTM(3, 4, direction="bidirect")
+        tbl = torch.nn.LSTM(3, 4, batch_first=True, bidirectional=True)
+        self._transplant(tbl, bl.state_dict())
+        x = np.random.RandomState(2).randn(2, 5, 3).astype(np.float32)
+        out_p, _ = bl(paddle.to_tensor(x))
+        out_t, _ = tbl(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()),
+                                   out_t.detach().numpy(), atol=1e-5)
