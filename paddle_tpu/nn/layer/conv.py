@@ -30,11 +30,17 @@ class _ConvNd(Layer):
             shape = [in_channels, out_channels // groups] + list(self._kernel_size)
         else:
             shape = [out_channels, in_channels // groups] + list(self._kernel_size)
-        fan_in = (in_channels // groups) * int(np.prod(self._kernel_size))
-        std = (2.0 / fan_in) ** 0.5
+        # reference conv.py _get_default_param_initializer: forward convs
+        # use Normal(0, sqrt(2/(prod(kernel)*in_channels))) — NOT divided
+        # by groups — and TRANSPOSED convs return None, falling back to
+        # the Xavier-uniform create_parameter default
+        if transposed:
+            default_init = None
+        else:
+            filter_elem_num = in_channels * int(np.prod(self._kernel_size))
+            default_init = init.Normal(0.0, (2.0 / filter_elem_num) ** 0.5)
         self.weight = self.create_parameter(
-            shape, attr=weight_attr,
-            default_initializer=init.Normal(0.0, std))
+            shape, attr=weight_attr, default_initializer=default_init)
         if bias_attr is not False:
             self.bias = self.create_parameter([out_channels], attr=bias_attr,
                                               is_bias=True)
