@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle_tpu — a TPU-native deep learning framework.
 
 Brand-new framework with the capabilities of the PaddlePaddle reference

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Automatic mixed precision (reference: python/paddle/amp/auto_cast.py:21,
 grad_scaler.py:26, fluid/dygraph/amp/loss_scaler.py:40).
 

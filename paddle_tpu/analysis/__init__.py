@@ -1,0 +1,93 @@
+"""paddle_tpu.analysis — static checking for the TPU stack.
+
+Three layers (reference: PaddlePaddle's ``framework/ir`` graph
+validation, InferShape/InferMeta consistency enforcement, and
+``tools/check_api_compatible.py``):
+
+- :mod:`paddle_tpu.analysis.verifier` — structural Program verifier
+  (def-before-use/SSA across sub-blocks, dangling Variable refs, dead
+  ops, shape/dtype re-inference against ``jax.eval_shape``).  Runs
+  automatically after every graph rewrite pass.
+- :mod:`paddle_tpu.analysis.hazards` — TPU performance-hazard detector
+  over recorded Programs and ``@to_static`` functions (scalar-capture
+  recompiles, host syncs in traced regions, f64 upcasts, weak-type
+  promotion leaks, zero-trip loop-var deviation).
+- :mod:`paddle_tpu.analysis.astlint` — repo AST lint (op-schema parity,
+  inplace-alias pairing, jax-import boundaries, mutable defaults), also
+  exposed as the ``tools/lint_tpu.py`` CLI and a ``lint`` CI stage.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from .verifier import (ERROR, INFO, WARNING, Diagnostic,
+                       ProgramVerificationError, verify_program)
+from .hazards import (scan, scan_function, scan_program,
+                      scan_static_function)
+from . import astlint
+
+__all__ = [
+    "Diagnostic",
+    "ProgramVerificationError",
+    "verify_program",
+    "scan",
+    "scan_program",
+    "scan_function",
+    "scan_static_function",
+    "set_pass_verification",
+    "pass_verification",
+    "verify_after_pass",
+    "astlint",
+    "ERROR",
+    "WARNING",
+    "INFO",
+]
+
+# Pass-guard policy.  Structural verification after every rewrite pass is
+# cheap (metadata walk); re-inference is skipped there because passes
+# legitimately replace fns with fused equivalents whose per-op shapes are
+# re-checked by record-time eval_shape anyway.  ``strict`` escalates
+# findings from stderr warnings to ProgramVerificationError.
+_PASS_VERIFY = {"enabled": True, "strict": False}
+
+
+def set_pass_verification(enabled: bool = True, strict: bool = False):
+    """Configure the automatic verifier run after ``apply_pass`` /
+    ``apply_build_strategy``.  Returns the previous policy."""
+    prev = dict(_PASS_VERIFY)
+    _PASS_VERIFY["enabled"] = bool(enabled)
+    _PASS_VERIFY["strict"] = bool(strict)
+    return prev
+
+
+def pass_verification() -> dict:
+    """Current pass-guard policy (copy)."""
+    return dict(_PASS_VERIFY)
+
+
+def verify_after_pass(program, pass_name: str,
+                      fetch_list: Optional[Sequence[Any]] = None
+                      ) -> List[Diagnostic]:
+    """Guard hook called by ``static.passes`` after a pass rewrote ops.
+
+    Honors :func:`set_pass_verification`; under the default non-strict
+    policy, error findings are printed to stderr (a buggy pass should be
+    loud even when the user never asked for verification), and under
+    ``strict`` they raise :class:`ProgramVerificationError`.
+    """
+    if not _PASS_VERIFY["enabled"]:
+        return []
+    diags = verify_program(program, fetch_list=fetch_list,
+                           strict=False, reinfer=False)
+    errors = [d for d in diags if d.severity == ERROR]
+    if errors and _PASS_VERIFY["strict"]:
+        raise ProgramVerificationError(errors)
+    if errors:
+        import sys
+
+        print(f"[paddle_tpu.analysis] pass '{pass_name}' left the "
+              f"program malformed ({len(errors)} finding(s)):",
+              file=sys.stderr)
+        for d in errors:
+            print(f"  {d}", file=sys.stderr)
+    return diags

@@ -1,0 +1,390 @@
+"""Repo-specific AST lint for the paddle_tpu op surface and TPU discipline
+(reference: tools/check_api_compatible.py as an API gate, plus the
+codestyle hooks under tools/codestyle/).
+
+Rules (all ERROR severity unless noted):
+
+- **L001 op-schema-missing** — every public top-level function in an
+  ``paddle_tpu/ops/`` submodule must have an ``op_schema.yaml`` entry.
+- **L002 op-schema-signature** — the schema entry's parameter names must
+  match the ``def`` (the runtime gate ``tests/test_op_schema.py`` pins
+  exact default reprs; this static half catches drift without importing
+  the package).
+- **L003 inplace-unpaired** — ``op_schema.yaml`` ``inplace:`` variants
+  and the live ``_INPLACE_ALIASES`` table in ``ops/__init__.py`` must
+  stay paired in both directions (``add_`` ↔ ``add``).
+- **L004 jax-import** — ``jax`` may be imported only in sanctioned
+  modules (``core/``, ``ops/``, ``kernels/``, ``static/``,
+  ``distributed/``): everything else goes through the public paddle_tpu
+  surface so backend policy (precision, donation, sharding) stays in one
+  layer.  Legacy numeric modules carry explicit file-level suppressions.
+- **L005 mutable-default** — no mutable default arguments
+  (``def f(x=[])``): shared-state bugs plus retrace hazards when the
+  default rides a trace signature.
+
+Suppressions (documented in README):
+
+- line-level:  ``some_code  # lint-tpu: disable=L004`` (comma-separate
+  several codes, or ``disable=all``)
+- file-level:  a comment line anywhere in the file reading
+  ``# lint-tpu: disable-file=L004``
+
+This module is deliberately self-contained (stdlib + yaml only, no
+paddle_tpu imports) so ``tools/lint_tpu.py`` can load it by path and
+lint the whole repo in milliseconds without pulling in jax.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set
+
+__all__ = ["Finding", "RULES", "lint_file", "lint_paths", "main"]
+
+ERROR = "error"
+WARNING = "warning"
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    code: str
+    severity: str
+    message: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"[{self.severity.upper()}] {self.message}")
+
+
+RULES: Dict[str, str] = {
+    "L001": "public op function missing from op_schema.yaml",
+    "L002": "op signature drifted from its op_schema.yaml entry",
+    "L003": "inplace alias and schema 'inplace:' field out of sync",
+    "L004": "jax imported outside sanctioned modules "
+            "(core/, ops/, kernels/, static/, distributed/)",
+    "L005": "mutable default argument",
+}
+
+_SANCTIONED_ROOTS = ("core", "ops", "kernels", "static", "distributed")
+_OPS_SUBMODULES = ("creation", "math", "manipulation", "logic", "linalg",
+                   "search", "stat", "random", "einsum")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint-tpu:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<codes>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+# ---------------------------------------------------------------------------
+# schema loading (yaml, no package import)
+# ---------------------------------------------------------------------------
+
+_SCHEMA_CACHE: Optional[dict] = None
+
+
+def _schema_path() -> str:
+    return os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "ops",
+        "op_schema.yaml"))
+
+
+def _load_schema() -> dict:
+    """{op name: entry dict} from op_schema.yaml ({} if unreadable)."""
+    global _SCHEMA_CACHE
+    if _SCHEMA_CACHE is None:
+        try:
+            import yaml
+
+            with open(_schema_path()) as f:
+                raw = yaml.safe_load(f)
+            _SCHEMA_CACHE = {e["op"]: e for e in raw["ops"]}
+        except Exception:  # noqa: BLE001 — lint must not crash on it
+            _SCHEMA_CACHE = {}
+    return _SCHEMA_CACHE
+
+
+def _sig_param_names(sig: str) -> Optional[List[str]]:
+    """Ordered parameter names (with */** prefixes) from a canonical
+    signature string like "(x, axis=None, *args, **kwargs)"."""
+    try:
+        tree = ast.parse(f"def _f{sig}: pass")
+        args = tree.body[0].args
+    except SyntaxError:
+        return None
+    return _arg_names(args)
+
+
+def _arg_names(args: ast.arguments) -> List[str]:
+    out = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if args.vararg:
+        out.append("*" + args.vararg.arg)
+    out.extend(a.arg for a in args.kwonlyargs)
+    if args.kwarg:
+        out.append("**" + args.kwarg.arg)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-file analysis
+# ---------------------------------------------------------------------------
+
+def _package_relpath(path: str) -> Optional[str]:
+    """Path relative to the innermost ``paddle_tpu`` package dir, or None
+    when the file is not inside the package (tests, tools, ...)."""
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if "paddle_tpu" not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index("paddle_tpu")
+    rel = parts[idx + 1:]
+    return "/".join(rel) if rel else None
+
+
+def _suppressions(src: str):
+    """(file-level codes, {line: codes}) from lint-tpu comments."""
+    file_codes: Set[str] = set()
+    line_codes: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group("codes").split(",")
+                 if c.strip()}
+        if m.group("file"):
+            file_codes |= codes
+        else:
+            line_codes.setdefault(lineno, set()).update(codes)
+    return file_codes, line_codes
+
+
+def _suppressed(code: str, lineno: int, file_codes, line_codes) -> bool:
+    if "ALL" in file_codes or code in file_codes:
+        return True
+    at_line = line_codes.get(lineno, ())
+    return "ALL" in at_line or code in at_line
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, relpath: Optional[str]):
+        self.path = path
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        root = relpath.split("/", 1)[0] if relpath else None
+        self.sanctioned = (relpath is None
+                           or root in _SANCTIONED_ROOTS
+                           or root == "analysis")
+        self.ops_submodule = None
+        if relpath:
+            m = re.fullmatch(r"ops/(\w+)\.py", relpath)
+            if m and m.group(1) in _OPS_SUBMODULES:
+                self.ops_submodule = m.group(1)
+        self._depth = 0
+
+    def add(self, node, code, message, severity=ERROR):
+        self.findings.append(Finding(
+            self.path, getattr(node, "lineno", 1), code, severity,
+            message))
+
+    # -- L004: jax imports ----------------------------------------------
+    def visit_Import(self, node):
+        if not self.sanctioned:
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    self.add(node, "L004",
+                             f"import of '{alias.name}' outside "
+                             "sanctioned modules " +
+                             str(list(_SANCTIONED_ROOTS)))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if not self.sanctioned and (mod == "jax"
+                                    or mod.startswith("jax.")):
+            self.add(node, "L004",
+                     f"import from '{mod}' outside sanctioned modules " +
+                     str(list(_SANCTIONED_ROOTS)))
+        self.generic_visit(node)
+
+    # -- L005: mutable defaults -----------------------------------------
+    def _check_defaults(self, node, args: ast.arguments):
+        for default in list(args.defaults) + \
+                [d for d in args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and isinstance(default.func, ast.Name)
+                    and default.func.id in ("list", "dict", "set")):
+                self.add(default, "L005",
+                         f"mutable default argument in "
+                         f"'{getattr(node, 'name', '<lambda>')}' — "
+                         "shared across calls and unhashable in trace "
+                         "signatures; use None and construct inside")
+
+    # -- L001/L002: op schema -------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node, node.args)
+        if self.ops_submodule and self._depth == 0 and \
+                not node.name.startswith("_"):
+            self._check_op_schema(node)
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    def visit_AsyncFunctionDef(self, node):
+        self.visit_FunctionDef(node)
+
+    def visit_ClassDef(self, node):
+        self._depth += 1  # methods are not module-level ops
+        self.generic_visit(node)
+        self._depth -= 1
+
+    def visit_Lambda(self, node):
+        self._check_defaults(node, node.args)
+        self.generic_visit(node)
+
+    def _check_op_schema(self, node):
+        schema = _load_schema()
+        if not schema:
+            return
+        entry = schema.get(node.name)
+        if entry is None:
+            self.add(node, "L001",
+                     f"public op '{node.name}' in ops/"
+                     f"{self.ops_submodule}.py has no op_schema.yaml "
+                     "entry — run tools/gen_op_schema.py and commit "
+                     "the diff")
+            return
+        if entry.get("module") != self.ops_submodule:
+            return  # same name owned by another submodule entry
+        declared = _sig_param_names(entry.get("signature", ""))
+        actual = _arg_names(node.args)
+        if declared is not None and declared != actual:
+            self.add(node, "L002",
+                     f"op '{node.name}' signature drifted from schema: "
+                     f"declared params {declared}, actual {actual} — "
+                     "regenerate with tools/gen_op_schema.py if "
+                     "intentional")
+
+
+def _lint_inplace_pairing(path: str, tree: ast.Module) -> List[Finding]:
+    """L003 over ops/__init__.py: _INPLACE_ALIASES keys vs schema."""
+    findings: List[Finding] = []
+    schema = _load_schema()
+    if not schema:
+        return findings
+    aliases = None
+    alias_node = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and \
+                        tgt.id == "_INPLACE_ALIASES" and \
+                        isinstance(node.value, ast.Dict):
+                    aliases = {
+                        k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                    alias_node = node
+    if aliases is None:
+        return findings
+    declared = {entry["inplace"]: name for name, entry in schema.items()
+                if entry.get("inplace")}
+    for inplace_name, base in sorted(declared.items()):
+        if inplace_name not in aliases:
+            findings.append(Finding(
+                path, alias_node.lineno, "L003", ERROR,
+                f"schema declares inplace variant '{inplace_name}' for "
+                f"'{base}' but _INPLACE_ALIASES has no such entry"))
+    for inplace_name in sorted(aliases):
+        base = inplace_name[:-1]
+        if base in schema and inplace_name not in declared:
+            findings.append(Finding(
+                path, alias_node.lineno, "L003", ERROR,
+                f"_INPLACE_ALIASES pairs '{inplace_name}' with op "
+                f"'{base}' but the schema entry lacks "
+                f"'inplace: {inplace_name}' — regenerate "
+                "op_schema.yaml"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_file(path: str, src: Optional[str] = None) -> List[Finding]:
+    if src is None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            return [Finding(path, 1, "L000", ERROR,
+                            f"unreadable: {e}")]
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "L000", ERROR,
+                        f"syntax error: {e.msg}")]
+    relpath = _package_relpath(path)
+    linter = _FileLinter(path, relpath)
+    linter.visit(tree)
+    findings = linter.findings
+    if relpath == "ops/__init__.py":
+        findings.extend(_lint_inplace_pairing(path, tree))
+    file_codes, line_codes = _suppressions(src)
+    return [f for f in findings
+            if not _suppressed(f.code, f.line, file_codes, line_codes)]
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(
+                            lint_file(os.path.join(dirpath, fn)))
+        else:
+            findings.extend(lint_file(path))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu repo lint (op schema, jax-import "
+        "boundaries, mutable defaults)")
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--warnings-as-errors", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for code, desc in sorted(RULES.items()):
+            print(f"{code}: {desc}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given (try: python tools/lint_tpu.py "
+                     "paddle_tpu/)")
+    findings = lint_paths(args.paths)
+    for f in findings:
+        print(f)
+    errors = [f for f in findings
+              if f.severity == ERROR
+              or (args.warnings_as_errors and f.severity == WARNING)]
+    n_files = sum(len(list(_iter_py(p))) if os.path.isdir(p) else 1
+                  for p in args.paths)
+    print(f"lint-tpu: {n_files} files, {len(findings)} finding(s), "
+          f"{len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+def _iter_py(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)

@@ -1,0 +1,291 @@
+"""TPU-hazard detector over recorded Programs and ``@to_static`` code.
+
+"Operator Fusion in XLA: Analysis and Evaluation" (PAPERS.md) shows that
+end-to-end TPU throughput is dominated not by kernel quality but by the
+defects AROUND the compiled region: recompilation storms, host round
+trips, and precision-widening ops XLA must honor.  This module flags
+exactly that class of defect:
+
+- **H101 scalar-capture retrace**: a ``@to_static`` function whose
+  compile cache holds multiple entries that differ ONLY in captured
+  Python scalar/shape values — every new value triggers a full XLA
+  recompile (minutes on a real TPU), the classic "loss curve pauses
+  every step" bug.  Detected from the live ``StaticFunction`` cache, so
+  it sees what actually happened rather than guessing from source.
+- **H102 host sync in traced region**: ``.numpy()`` / ``.item()`` /
+  ``.tolist()`` / ``to_np(...)`` / ``float(tensor)`` inside a function
+  that compiles — each forces a device→host transfer and serializes the
+  pipeline (and under trace, usually a ConcretizationTypeError at best).
+- **H103 float64 upcast**: literal ``float64``/``double`` dtypes in
+  traced code or recorded programs.  TPUs emulate f64 in software; one
+  stray ``np.float64`` mean poisons a whole fused region.
+- **H104 weak-type promotion leak**: a recorded op whose output is
+  WIDER than every one of its tensor inputs — a Python scalar or weak-
+  typed constant silently promoted the computation.
+- **H105 zero-trip loop-var deviation**: a ``range()`` for-loop with
+  ``break``/``continue``/``return`` in its body compiles through
+  ``jit.dy2static._range_for_to_while``, whose documented deviation is
+  that an EMPTY range leaves the loop variable at ``start`` instead of
+  its prior binding (MIGRATING.md "dy2static constraints").
+
+Program-level scans are pure metadata walks (no execution); source-level
+scans are AST walks with real file/line locations.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, List, Optional
+
+from .verifier import ERROR, INFO, WARNING, Diagnostic
+
+__all__ = [
+    "scan_program",
+    "scan_function",
+    "scan_static_function",
+    "scan",
+]
+
+_HOST_SYNC_ATTRS = ("numpy", "item", "tolist", "cpu")
+_HOST_SYNC_CALLS = ("to_np",)
+_F64_NAMES = ("float64", "double")
+
+
+# ---------------------------------------------------------------------------
+# recorded-Program scans
+# ---------------------------------------------------------------------------
+
+def _op_tensor_in_widths(op):
+    widths = []
+    for kind, ref in op.inputs:
+        v = getattr(ref, "_value", None)
+        if kind in ("var", "const") and v is not None:
+            try:
+                widths.append(v.dtype.itemsize)
+            except (AttributeError, TypeError):
+                pass
+    return widths
+
+
+def scan_program(program) -> List[Diagnostic]:
+    """Flag TPU hazards recorded into a static Program."""
+    diags: List[Diagnostic] = []
+    for block in program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            where = f"block {block.idx} op {op_idx} ({op.type})"
+            in_widths = _op_tensor_in_widths(op)
+            for o in op.outputs:
+                dt = getattr(getattr(o, "_value", None), "dtype", None)
+                if dt is None:
+                    continue
+                name = getattr(dt, "name", str(dt))
+                if name in ("float64", "complex128"):
+                    diags.append(Diagnostic(
+                        "H103", ERROR,
+                        f"output '{o.name}' is {name}: TPUs have no "
+                        "native f64 — this op (and everything fused "
+                        "with it) runs software-emulated", where))
+                elif in_widths and hasattr(dt, "itemsize") and \
+                        dt.itemsize > max(in_widths) and \
+                        name.startswith(("float", "int", "uint")):
+                    diags.append(Diagnostic(
+                        "H104", WARNING,
+                        f"output '{o.name}' ({name}) is wider than every "
+                        "tensor input — a Python scalar or weak-typed "
+                        "constant promoted this op", where))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# source-level scans
+# ---------------------------------------------------------------------------
+
+class _SourceScanner(ast.NodeVisitor):
+    def __init__(self, filename: str, firstline: int):
+        self.filename = filename
+        self.firstline = firstline
+        self.diags: List[Diagnostic] = []
+        self._loop_depth = 0
+
+    def _where(self, node) -> str:
+        return f"{self.filename}:{self.firstline + node.lineno - 1}"
+
+    def add(self, code, severity, message, node):
+        self.diags.append(
+            Diagnostic(code, severity, message, self._where(node)))
+
+    # -- host syncs ------------------------------------------------------
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _HOST_SYNC_ATTRS \
+                and not node.args and not node.keywords:
+            self.add(
+                "H102", ERROR,
+                f".{fn.attr}() inside a traced region forces a device→"
+                "host sync (and fails outright under jit tracing); "
+                "fetch values OUTSIDE the compiled function", node)
+        elif isinstance(fn, ast.Name) and fn.id in _HOST_SYNC_CALLS:
+            self.add(
+                "H102", ERROR,
+                f"{fn.id}(...) inside a traced region materializes the "
+                "value on host — a device→host sync per call", node)
+        elif isinstance(fn, ast.Attribute) and fn.attr in (
+                "asarray", "array") and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("np", "numpy"):
+            self.add(
+                "H102", WARNING,
+                f"{fn.value.id}.{fn.attr}(...) on a traced value is a "
+                "host sync; use paddle/jnp ops instead", node)
+        # dtype strings only count as hazards when passed to a call
+        # (astype('float64'), cast(x, 'float64'), dtype='float64') —
+        # a bare string constant may be a docstring or message
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and arg.value in _F64_NAMES:
+                self.add(
+                    "H103", WARNING,
+                    f"dtype '{arg.value}' passed to a call: TPUs emulate "
+                    "f64 in software — use float32/bfloat16 unless the "
+                    "extra mantissa is load-bearing", arg)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in _F64_NAMES and isinstance(node.value, ast.Name) \
+                and node.value.id in ("np", "numpy", "jnp", "paddle"):
+            self.add(
+                "H103", WARNING,
+                f"{node.value.id}.{node.attr} upcasts to f64 — software-"
+                "emulated on TPU", node)
+        self.generic_visit(node)
+
+    # -- zero-trip range-for deviation ----------------------------------
+    def visit_For(self, node):
+        it = node.iter
+        is_range = (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range")
+        if is_range and _body_has_break_continue_return(node.body):
+            tgt = node.target.id if isinstance(node.target, ast.Name) \
+                else "<loop var>"
+            self.add(
+                "H105", INFO,
+                f"range-for with break/continue/return lowers through "
+                "dy2static's explicit-while form: on a ZERO-iteration "
+                f"range the loop variable '{tgt}' is left at the range "
+                "start instead of keeping its prior binding (see "
+                "MIGRATING.md, dy2static constraints)", node)
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    def visit_While(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+
+def _body_has_break_continue_return(stmts) -> bool:
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            return
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_For(self, node):  # nested loops own their break/continue
+            for s in ast.walk(node):
+                if isinstance(s, ast.Return):
+                    found[0] = True
+            return
+
+        visit_While = visit_For
+
+        def visit_Break(self, node):
+            found[0] = True
+
+        def visit_Continue(self, node):
+            found[0] = True
+
+        def visit_Return(self, node):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+def scan_function(fn) -> List[Diagnostic]:
+    """AST-scan a function that will be traced (``@to_static`` target,
+    jit.save export, or a dy2static conversion candidate)."""
+    raw = inspect.unwrap(getattr(fn, "_fn", fn))
+    raw = getattr(raw, "__func__", raw)
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        filename = inspect.getsourcefile(raw) or "<unknown>"
+        firstline = inspect.getsourcelines(raw)[1]
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return []
+    scanner = _SourceScanner(filename, firstline)
+    scanner.visit(tree)
+    return scanner.diags
+
+
+# ---------------------------------------------------------------------------
+# live StaticFunction scans
+# ---------------------------------------------------------------------------
+
+def scan_static_function(sfn, retrace_threshold: int = 2
+                         ) -> List[Diagnostic]:
+    """Inspect a live ``StaticFunction``: source hazards (H102/H103/H105)
+    plus the compile-cache retrace analysis (H101).
+
+    The cache key is ``((dyn_specs, static_values, treedef), state_sig,
+    mode_key)``; entries sharing everything but ``static_values`` mean
+    the function recompiled once per captured Python scalar value.
+    """
+    diags = scan_function(sfn)
+    cache = getattr(sfn, "_cache", None)
+    if not cache:
+        return diags
+    groups = {}
+    for key in cache:
+        try:
+            (dyn, stat, treedef), state_sig, mode_key = key
+        except (TypeError, ValueError):
+            continue
+        groups.setdefault((dyn, treedef, state_sig, mode_key),
+                          []).append(stat)
+    name = getattr(sfn, "__name__", repr(sfn))
+    for (dyn, _td, _sig, _mode), stats in groups.items():
+        if len(stats) >= retrace_threshold:
+            seen_vals = sorted({repr(s) for s in stats})
+            diags.append(Diagnostic(
+                "H101", ERROR,
+                f"'{name}' recompiled {len(stats)}x for identical tensor "
+                f"shapes {list(dyn)} but different captured Python "
+                f"values ({', '.join(seen_vals[:4])}"
+                f"{', ...' if len(seen_vals) > 4 else ''}) — pass "
+                "varying scalars as 0-d tensors so one executable "
+                "serves every value",
+                f"cache of {name}"))
+    return diags
+
+
+def scan(obj: Any, fetch_list: Optional[list] = None) -> List[Diagnostic]:
+    """Dispatching front door: accepts a Program, a StaticFunction, a
+    Layer with a to_static forward, or a plain function."""
+    if hasattr(obj, "blocks") and hasattr(obj, "global_block"):
+        return scan_program(obj)
+    if hasattr(obj, "_cache") and hasattr(obj, "_fn"):
+        return scan_static_function(obj)
+    fwd = getattr(obj, "forward", None)
+    if fwd is not None and hasattr(fwd, "_cache"):
+        return scan_static_function(fwd)
+    if callable(obj):
+        return scan_function(obj)
+    raise TypeError(
+        f"cannot hazard-scan {type(obj).__name__}: expected a Program, "
+        "StaticFunction, Layer, or function")

@@ -1,0 +1,370 @@
+"""Structural Program verifier (reference: the IR graph validation under
+paddle/fluid/framework/ir/ — Graph::Has/IsValid checks, pass post-
+conditions — plus InferShape/InferMeta consistency enforcement).
+
+A recorded ``Program`` (static/graph.py) is an op list over named
+``Variable``s; rewrite passes (static/passes.py) mutate it in place, and
+a buggy pass can silently produce a malformed block: an op reading a
+variable no pass produces anymore, two ops claiming the same output name,
+a fused op whose lowering computes a different shape than the recorded
+metadata promises.  This module re-checks the invariants record-time
+construction guarantees:
+
+- **def-before-use / SSA** (V001/V002/V003): every ``var`` input must be
+  a feed, a loop shadow, or the output of a PRECEDING op in the same
+  block or an ancestor block; every name is produced at most once.
+- **branch locality** (V004): a value produced inside a control-flow
+  sub-block can only leave through the cond/while op's declared outputs.
+- **dead ops** (V005) and **unfetchable fetches** (V006) when the fetch
+  targets are known (``fetch_list``/``keep``).
+- **shape/dtype re-inference** (V007/V008): re-run ``jax.eval_shape``
+  per ``OpDesc`` — the same InferShape analog record_op used — and diff
+  against the recorded output metadata.  A pass that swaps an op's
+  ``fn`` but lies about the result shape is caught here before XLA
+  compiles garbage (or worse, compiles fine and computes garbage).
+
+Everything is duck-typed against the OpDesc/Block/Program protocol so
+this module imports neither jax nor the static package at import time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+__all__ = [
+    "Diagnostic",
+    "ProgramVerificationError",
+    "verify_program",
+    "ERROR",
+    "WARNING",
+    "INFO",
+]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+# ops interpreted specially by _Interp — they have no re-inferable fn
+_SPECIAL_OPS = ("backward", "cond", "while")
+
+_SUB_BLOCK_KEYS = ("true_block", "false_block", "cond_block", "body_block")
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One verifier/hazard/lint finding."""
+
+    code: str
+    severity: str
+    message: str
+    where: str = ""
+
+    def __str__(self):
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.code} {self.severity.upper()}{loc}: {self.message}"
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised under ``strict=True`` when error-severity findings exist."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        lines = "\n".join(f"  {d}" for d in self.diagnostics)
+        super().__init__(
+            f"program verification failed with "
+            f"{len(self.diagnostics)} finding(s):\n{lines}")
+
+
+def _op_where(block, op_idx, op) -> str:
+    return f"block {block.idx} op {op_idx} ({op.type})"
+
+
+def _sub_blocks(op):
+    for key in _SUB_BLOCK_KEYS:
+        blk = op.extra.get(key) if op.extra else None
+        if blk is not None:
+            yield key, blk
+
+
+def _branch_out_vars(op):
+    """Variables a cond/while op's sub-blocks must have defined."""
+    outs = []
+    if not op.extra:
+        return outs
+    for key in ("true_out", "false_out", "body_out"):
+        for o in op.extra.get(key) or []:
+            if _is_variable(o):
+                outs.append((key, o))
+    p = op.extra.get("pred_out")
+    if _is_variable(p):
+        outs.append(("pred_out", p))
+    return outs
+
+
+def _is_variable(x) -> bool:
+    # duck-typed: a symbolic Variable has a .block and a .name; eager
+    # Tensors riding as consts have no .block
+    return hasattr(x, "block") and hasattr(x, "name") and \
+        getattr(x, "block", None) is not None
+
+
+class _Checker:
+    def __init__(self, program, fetch_list, reinfer: bool):
+        self.program = program
+        self.fetch_list = fetch_list
+        self.reinfer = reinfer
+        self.diags: List[Diagnostic] = []
+        # name -> block idx that produced it (feeds map to their block)
+        self.produced_in = {}
+        self.producers = {}  # name -> (block_idx, op_idx) first producer
+        self.consumed = set()
+
+    def add(self, code, severity, message, where=""):
+        self.diags.append(Diagnostic(code, severity, message, where))
+
+    # -- visibility ------------------------------------------------------
+    def _ancestors(self, block_idx: int):
+        seen = set()
+        while block_idx >= 0 and block_idx not in seen:
+            seen.add(block_idx)
+            block_idx = self.program.blocks[block_idx].parent_idx
+        return seen
+
+    # -- main walk -------------------------------------------------------
+    def run(self) -> List[Diagnostic]:
+        root = self.program.global_block()
+        defined = set()
+        for name, v in root.vars.items():
+            if getattr(v, "is_data", False) or \
+                    getattr(v, "persistable", False):
+                defined.add(name)
+                self.produced_in.setdefault(name, root.idx)
+        self._check_block(root, defined)
+        self._check_dead_and_fetches(root)
+        return self.diags
+
+    def _check_block(self, block, defined: set):
+        visible_blocks = self._ancestors(block.idx)
+        for op_idx, op in enumerate(block.ops):
+            where = _op_where(block, op_idx, op)
+            for kind, ref in op.inputs:
+                if kind != "var":
+                    continue
+                name = getattr(ref, "name", None)
+                self.consumed.add(name)
+                if name in defined:
+                    # defined — but was it defined in a visible block?
+                    src = self.produced_in.get(name)
+                    if src is not None and src not in visible_blocks:
+                        self.add(
+                            "V004", ERROR,
+                            f"input '{name}' is local to sub-block {src} "
+                            "and cannot be read from this block (branch-"
+                            "local values leave only through the control-"
+                            "flow op's outputs)", where)
+                    continue
+                if name in self.produced_in:
+                    # produced, but in a block not visible from here —
+                    # a branch-local value leaked past its sub-block
+                    self.add(
+                        "V004", ERROR,
+                        f"input '{name}' is local to sub-block "
+                        f"{self.produced_in[name]} and cannot be read "
+                        "from this block (branch-local values leave "
+                        "only through the control-flow op's outputs)",
+                        where)
+                elif self._registered_anywhere(name):
+                    self.add(
+                        "V002", ERROR,
+                        f"input '{name}' is used before it is defined "
+                        "(no preceding op produces it and it is not a "
+                        "feed)", where)
+                else:
+                    self.add(
+                        "V001", ERROR,
+                        f"input references unknown variable '{name}' "
+                        "(dangling reference: not registered in any "
+                        "block of this program)", where)
+            # control-flow sub-blocks see everything defined so far plus,
+            # for while, the loop shadows bound by the interpreter
+            if op.type in ("cond", "while"):
+                inner = set(defined)
+                for s in (op.extra.get("shadows") or []
+                          if op.extra else []):
+                    inner.add(s.name)
+                    self.produced_in.setdefault(
+                        s.name, getattr(s.block, "idx", block.idx))
+                for _, blk in _sub_blocks(op):
+                    # each branch sees the same pre-branch environment
+                    self._check_block(blk, set(inner))
+                self._check_branch_outputs(op, where)
+            if op.type not in _SPECIAL_OPS and self.reinfer:
+                self._reinfer_op(block, op_idx, op)
+            for o in op.outputs:
+                prev = self.producers.get(o.name)
+                if prev is not None:
+                    pb, pi = prev
+                    self.add(
+                        "V003", ERROR,
+                        f"output '{o.name}' is produced twice (first at "
+                        f"block {pb} op {pi}) — SSA discipline violated",
+                        where)
+                else:
+                    self.producers[o.name] = (block.idx, op_idx)
+                defined.add(o.name)
+                self.produced_in[o.name] = block.idx
+
+    def _check_branch_outputs(self, op, where):
+        for key, o in _branch_out_vars(op):
+            if o.name not in self.producers and \
+                    o.name not in self.produced_in:
+                self.add(
+                    "V001", ERROR,
+                    f"control-flow {key} references '{o.name}', which "
+                    "no op produces", where)
+
+    def _registered_anywhere(self, name) -> bool:
+        return any(name in b.vars for b in self.program.blocks)
+
+    # -- dead ops / fetches ---------------------------------------------
+    def _check_dead_and_fetches(self, root):
+        fetch_names = set()
+        if self.fetch_list is not None:
+            for ref in self.fetch_list:
+                name = ref if isinstance(ref, str) else \
+                    getattr(ref, "name", None)
+                if name is not None:
+                    fetch_names.add(name)
+            for name in sorted(fetch_names):
+                if name not in self.produced_in:
+                    self.add(
+                        "V006", ERROR,
+                        f"fetch target '{name}' is neither produced by "
+                        "any op nor a feed — a pass removed or renamed "
+                        "its producer")
+                elif self.produced_in[name] != root.idx:
+                    self.add(
+                        "V006", ERROR,
+                        f"fetch target '{name}' is produced inside sub-"
+                        f"block {self.produced_in[name]}; only global-"
+                        "block values are fetchable")
+        # branch outputs count as consumption of the sub-block terminals
+        live = set(self.consumed) | fetch_names
+        for op in _all_ops(self.program):
+            for _, o in _branch_out_vars(op):
+                live.add(o.name)
+        if self.fetch_list is None:
+            return
+        for op_idx, op in enumerate(root.ops):
+            if op.writeback or op.type in _SPECIAL_OPS:
+                continue
+            outs = list(op.outputs)
+            if outs and all(
+                    o.name not in live
+                    and not getattr(o, "persistable", False)
+                    for o in outs):
+                self.add(
+                    "V005", WARNING,
+                    f"dead op: no output of "
+                    f"{[o.name for o in outs]} is consumed, fetched, or "
+                    "written back (eliminate_dead_ops would remove it)",
+                    _op_where(root, op_idx, op))
+
+    # -- shape/dtype re-inference ---------------------------------------
+    def _reinfer_op(self, block, op_idx, op):
+        if op.fn is None:
+            return
+        where = _op_where(block, op_idx, op)
+        import jax
+
+        specs, spec_pos, flat = [], [], []
+        for i, (kind, ref) in enumerate(op.inputs):
+            flat.append(ref)
+            if kind == "var":
+                v = getattr(ref, "_value", None)
+                if v is None:
+                    return
+                specs.append(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype))
+                spec_pos.append(i)
+            elif kind == "const":
+                v = ref._value
+                specs.append(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype))
+                spec_pos.append(i)
+            elif kind == "dyn":
+                import jax.numpy as jnp
+
+                try:
+                    v = jnp.asarray(ref())
+                except Exception:  # noqa: BLE001 — provider needs runtime
+                    return
+                specs.append(jax.ShapeDtypeStruct(tuple(v.shape), v.dtype))
+                spec_pos.append(i)
+
+        from ..static.graph import _call_op_fn
+
+        def shape_fn(*vals):
+            return _call_op_fn(op.fn, flat, op.treedef, spec_pos, vals,
+                               op.attrs)
+
+        from ..ops import random as rnd
+
+        prev = rnd.set_trace_key_provider(lambda: jax.random.PRNGKey(0))
+        try:
+            out_aval = jax.eval_shape(shape_fn, *specs)
+        except Exception as e:  # noqa: BLE001 — surface, don't crash
+            self.add("V009", WARNING,
+                     f"shape re-inference failed: {type(e).__name__}: {e}",
+                     where)
+            return
+        finally:
+            rnd.set_trace_key_provider(prev)
+        out_list = [out_aval] if op.single else list(out_aval)
+        if len(out_list) != len(op.outputs):
+            self.add(
+                "V007", ERROR,
+                f"op declares {len(op.outputs)} outputs but its fn "
+                f"produces {len(out_list)}", where)
+            return
+        for o, inferred in zip(op.outputs, out_list):
+            rec = o._value
+            if tuple(rec.shape) != tuple(inferred.shape):
+                self.add(
+                    "V007", ERROR,
+                    f"recorded shape {tuple(rec.shape)} of '{o.name}' "
+                    f"disagrees with re-inferred {tuple(inferred.shape)} "
+                    "(a pass rewired this op without updating metadata)",
+                    where)
+            if rec.dtype != inferred.dtype:
+                self.add(
+                    "V008", ERROR,
+                    f"recorded dtype {rec.dtype} of '{o.name}' disagrees "
+                    f"with re-inferred {inferred.dtype}", where)
+
+
+def _all_ops(program):
+    for b in program.blocks:
+        for op in b.ops:
+            yield op
+
+
+def verify_program(program, fetch_list: Optional[Sequence[Any]] = None,
+                   strict: bool = False,
+                   reinfer: bool = True) -> List[Diagnostic]:
+    """Check structural invariants of a recorded Program.
+
+    ``fetch_list`` (Variables or names) enables dead-op (V005) and
+    unfetchable-fetch (V006) detection — without it the verifier cannot
+    tell a terminal result op from dead code, so those checks are
+    skipped.  ``reinfer=False`` skips the per-op ``jax.eval_shape`` diff
+    (V007/V008/V009) for cheap structural-only validation.
+
+    Returns the diagnostics; with ``strict=True`` raises
+    :class:`ProgramVerificationError` when any error-severity finding
+    exists.
+    """
+    diags = _Checker(program, fetch_list, reinfer).run()
+    if strict:
+        errors = [d for d in diags if d.severity == ERROR]
+        if errors:
+            raise ProgramVerificationError(errors)
+    return diags

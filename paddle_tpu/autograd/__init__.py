@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.autograd analog: functional grad, PyLayer, backward.
 
 Reference: /root/reference/python/paddle/autograd/py_layer.py:202 (PyLayer),

@@ -127,6 +127,10 @@ PD_Predictor* PD_PredictorCreate(PD_Config* cfg) {
   PyObject* globals = helper_globals();
   if (globals == nullptr) return nullptr;
   PyObject* fn = PyDict_GetItemString(globals, "_pd_capi_create");
+  if (fn == nullptr) {
+    g_last_error = "helper module lacks _pd_capi_create";
+    return nullptr;
+  }
   PyObject* pred =
       PyObject_CallFunction(fn, "s", cfg->prog_file.c_str());
   if (pred == nullptr) {
@@ -186,6 +190,12 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float* data,
     return 1;
   }
   PyObject* fn = PyDict_GetItemString(globals, "_pd_capi_run");
+  if (fn == nullptr) {
+    Py_DECREF(buf);
+    Py_DECREF(pyshape);
+    g_last_error = "helper module lacks _pd_capi_run";
+    return 1;
+  }
   PyObject* res = PyObject_CallFunctionObjArgs(fn, p->pred, buf, pyshape,
                                                nullptr);
   Py_DECREF(buf);
@@ -194,14 +204,41 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float* data,
     capture_py_error("PD_PredictorRunFloat");
     return 1;
   }
+  // contract with the helper: a 2-tuple of (bytes payload, dims list)
+  if (!PyTuple_Check(res) || PyTuple_Size(res) != 2) {
+    Py_DECREF(res);
+    g_last_error =
+        "_pd_capi_run returned a malformed result (expected "
+        "(bytes, dims) 2-tuple)";
+    return 1;
+  }
   PyObject* out_bytes = PyTuple_GetItem(res, 0);
   PyObject* out_dims = PyTuple_GetItem(res, 1);
+  if (!PyBytes_Check(out_bytes) || !PyList_Check(out_dims)) {
+    Py_DECREF(res);
+    g_last_error =
+        "_pd_capi_run returned a malformed result (expected "
+        "(bytes, dims) 2-tuple)";
+    return 1;
+  }
   Py_ssize_t nbytes = PyBytes_Size(out_bytes);
   *out_data = static_cast<float*>(malloc(nbytes));
+  if (*out_data == nullptr) {
+    Py_DECREF(res);
+    g_last_error = "out of memory allocating output buffer";
+    return 1;
+  }
   std::memcpy(*out_data, PyBytes_AsString(out_bytes), nbytes);
   Py_ssize_t od = PyList_Size(out_dims);
   *out_ndim = static_cast<int>(od);
   *out_shape = static_cast<int64_t*>(malloc(od * sizeof(int64_t)));
+  if (*out_shape == nullptr) {
+    free(*out_data);
+    *out_data = nullptr;
+    Py_DECREF(res);
+    g_last_error = "out of memory allocating shape buffer";
+    return 1;
+  }
   for (Py_ssize_t i = 0; i < od; ++i) {
     (*out_shape)[i] = PyLong_AsLongLong(PyList_GetItem(out_dims, i));
   }

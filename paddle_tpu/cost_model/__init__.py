@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.cost_model (reference: python/paddle/cost_model/cost_model.py —
 CostModel: profile a static program for per-op costs, plus a static
 op-benchmark table lookup).

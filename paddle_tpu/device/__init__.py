@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.device (reference: python/paddle/device/).
 
 TPU is the accelerator; `cuda` names exist for API compatibility and map to

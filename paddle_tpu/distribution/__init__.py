@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.distribution (reference: python/paddle/distribution/ — ~10
 distributions + kl_divergence + transforms)."""
 from __future__ import annotations

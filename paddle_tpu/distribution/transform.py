@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.distribution.transform (reference:
 python/paddle/distribution/ transform APIs of the 2.x line; the 2022
 snapshot ships the Distribution zoo in python/paddle/distribution/ and the

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.fft (reference: python/paddle/fft.py, kernels via Pocketfft/cuFFT;
 here all transforms lower to XLA FFT)."""
 from __future__ import annotations

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.save / paddle.load (reference: python/paddle/framework/io.py:572,788).
 
 Pickles nested state structures with tensors converted to numpy, protocol 4

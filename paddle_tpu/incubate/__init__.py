@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.incubate (reference: python/paddle/incubate/): autotune config,
 segment ops, fused transformer ops, 2:4 sparsity (asp)."""
 from __future__ import annotations

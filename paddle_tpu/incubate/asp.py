@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """2:4 structured sparsity (reference: python/paddle/fluid/contrib/sparsity —
 ASP masks + OptimizerWithSparsityGuarantee).
 

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Fused functional ops (reference: python/paddle/incubate/nn/functional/)."""
 from __future__ import annotations
 

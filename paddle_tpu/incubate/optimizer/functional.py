@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Functional quasi-Newton minimizers (reference:
 python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py —
 minimize_bfgs returns (is_converge, num_func_calls, position, value,

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.inference: the serving runtime (reference:
 paddle/fluid/inference/api/analysis_predictor.cc + paddle_inference_api.h).
 

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.io: Dataset / DataLoader (reference: python/paddle/fluid/reader.py:273
 DataLoader, fluid/dataloader/ worker.py + batch_sampler.py + dataset.py).
 

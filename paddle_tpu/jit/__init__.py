@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.jit analog: compile eager code to one XLA executable.
 
 Replaces the reference dy2static stack
@@ -31,6 +32,7 @@ import types
 from typing import Any, Dict, List
 
 import jax
+import jax.export  # noqa: F401 — jax.export is lazy; attribute access alone fails
 import jax.numpy as jnp
 import numpy as np
 

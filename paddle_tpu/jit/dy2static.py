@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Minimal AST dy2static pass (VERDICT r3 #7).
 
 Reference: python/paddle/fluid/dygraph/dygraph_to_static/
@@ -507,8 +508,10 @@ def _range_for_to_while(node):
     setup.append(ast.Assign(targets=[_name(it_name, ast.Store())],
                             value=start))
     # seed the loop var too: it is a body store, and a Tensor-bound loop
-    # needs a typed pre-loop binding (deviation: an empty range leaves
-    # the loop var at start instead of its prior binding)
+    # needs a typed pre-loop binding.  DEVIATION (documented in
+    # MIGRATING.md "dy2static constraints", flagged by analysis.hazards
+    # as H105): a zero-iteration range leaves the loop var at the range
+    # start instead of its prior binding / staying unbound
     setup.append(ast.Assign(
         targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
         value=_name(it_name, ast.Load())))

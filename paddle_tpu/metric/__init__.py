@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.metric (reference: python/paddle/metric/metrics.py:37 Metric base,
 :180 Accuracy, Precision, Recall, Auc)."""
 from __future__ import annotations

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Autoregressive decoding over KV caches (reference capability:
 paddle/fluid/operators/fused/fused_multi_transformer_op.cu decode path +
 the sampling ops top_k_op/top_p_sampling; the high-level loop lives in

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Llama model family (Llama-2/3 architecture) — the flagship pretrain config
 (BASELINE.md config 3).
 

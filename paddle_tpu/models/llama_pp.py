@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Pipeline-parallel Llama: functional per-stage forward for the compiled
 1F1B schedule.
 

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Diffusion UNet with cross-attention (BASELINE.md config 5: SDXL UNet via
 the inference predictor).
 

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Common functionals: linear, dropout, embedding, pad, interpolate, etc.
 (reference: python/paddle/nn/functional/common.py, input.py)."""
 from __future__ import annotations

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Convolution functionals lowered to XLA conv_general_dilated
 (reference: python/paddle/nn/functional/conv.py; kernels in
 /root/reference/paddle/phi/kernels/gpu/conv_*).  Paddle layouts: input NCHW

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Long-tail functional ops (reference: python/paddle/nn/functional/
 vision.py, loss.py, extension.py — affine_grid, temporal_shift,
 max_unpool, dice/npair losses, hsigmoid, margin softmax, gather_tree,

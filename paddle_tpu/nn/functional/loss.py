@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
 from __future__ import annotations
 

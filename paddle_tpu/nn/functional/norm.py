@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Normalization functionals (reference: python/paddle/nn/functional/norm.py).
 
 batch_norm running-stat updates are expressed as in-place buffer rebinds; the

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Seq2seq decoding API (reference: python/paddle/nn/decode.py —
 BeamSearchDecoder over an RNN cell + dynamic_decode driver; the static
 path compiles to a While op, the dygraph path is a host loop).

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
 
 The reference dispatches to cuDNN RNN kernels; the TPU-native design lowers

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.nn.utils (reference: python/paddle/nn/utils/): weight_norm,
 spectral_norm, parameters_to_vector, vector_to_parameters."""
 from __future__ import annotations

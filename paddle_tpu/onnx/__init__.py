@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.onnx (reference: python/paddle/onnx/export.py -> paddle2onnx).
 
 TPU-native design: the reference shells out to the external paddle2onnx

@@ -69,6 +69,25 @@ def get_op_info(name: str) -> OpSpec:
         raise KeyError(f"no op schema entry for {name!r}") from None
 
 
+def param_names(name: str) -> List[str]:
+    """Ordered parameter names of an op's schema signature (``*``/``**``
+    prefixes kept).  This is the same view `analysis.astlint` rule L002
+    checks statically; exposing it here lets runtime tooling (and tests)
+    compare a live callable against the frozen schema without string
+    munging."""
+    import ast
+
+    sig = get_op_info(name).signature
+    args = ast.parse(f"def _f{sig}: pass").body[0].args
+    out = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if args.vararg:
+        out.append("*" + args.vararg.arg)
+    out.extend(a.arg for a in args.kwonlyargs)
+    if args.kwarg:
+        out.append("**" + args.kwarg.arg)
+    return out
+
+
 def current_signature(fn) -> str:
     """Canonical signature string used by both generator and gate."""
     try:

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.profiler (reference: python/paddle/profiler/profiler.py:270 +
 platform/profiler/ host tracer + CUPTI).
 

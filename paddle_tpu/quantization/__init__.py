@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Quantization: QAT + PTQ (reference: python/paddle/fluid/contrib/slim —
 quantization_pass.py fake_quant insertion, ImperativeQuantAware dygraph QAT,
 PTQ calibration; ops paddle/fluid/operators/fake_quantize_op.cc).
@@ -345,7 +346,11 @@ class Int8Linear(nn.Layer):
             acc = jax.lax.dot_general(
                 xq, w8, (((xq.ndim - 1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)
-            out = acc.astype(jnp.float32) * (sx / 127.0) * (sw / 127.0)
+            # sw is keepdims ([1, out] per-channel, [1, 1] per-tensor);
+            # collapse to the trailing axis so a rank-1 [in] input yields
+            # [out] instead of broadcasting up to [1, out]
+            out = acc.astype(jnp.float32) * (sx / 127.0) * \
+                (sw.reshape(-1) / 127.0)
             if bv is not None:
                 out = out + bv.astype(jnp.float32)
             return out.astype(xv.dtype)

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.signal — frame / overlap_add / stft / istft.
 
 Reference: python/paddle/signal.py:32 (frame), :154 (overlap_add),

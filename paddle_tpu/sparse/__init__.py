@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.sparse (reference: python/paddle/sparse — COO/CSR tensors, sparse
 ops; phi sparse kernels).
 

@@ -33,6 +33,7 @@ from collections import ChainMap
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.export  # noqa: F401 — jax.export is lazy; attribute access alone fails
 import jax.numpy as jnp
 import numpy as np
 
@@ -173,6 +174,20 @@ class Program:
         p._startup_actions = self._startup_actions
         p._for_test = for_test
         return p
+
+    def verify(self, fetch_list=None, strict: bool = True,
+               reinfer: bool = True):
+        """Structural + shape/dtype verification (analysis.verifier).
+
+        Returns the diagnostics list; with ``strict`` (default) raises
+        ``paddle_tpu.analysis.ProgramVerificationError`` on any
+        error-severity finding.  ``fetch_list`` enables dead-op and
+        unfetchable-output detection.
+        """
+        from ..analysis import verify_program
+
+        return verify_program(self, fetch_list=fetch_list, strict=strict,
+                              reinfer=reinfer)
 
     def __repr__(self):
         lines = []

@@ -37,14 +37,32 @@ def list_passes() -> List[str]:
     return sorted(_REGISTRY)
 
 
+def _verify_rewrite(program, pass_name, keep=()):
+    """Post-rewrite guard: structural verification via paddle_tpu.analysis
+    (pass/graph validation analog of framework/ir's Graph::Validate).
+    Imported lazily — analysis imports nothing from passes, but keeping
+    the dependency one-directional at import time is cheap insurance."""
+    from .. import analysis
+
+    analysis.verify_after_pass(program, pass_name,
+                               fetch_list=list(keep) or None)
+
+
 def apply_pass(program, name: str, **kwargs) -> int:
-    """Apply one pass to every block; returns number of rewrites."""
+    """Apply one pass to every block; returns number of rewrites.
+
+    After any rewrite the program is re-verified (def-before-use, SSA,
+    dangling refs) so a buggy pass fails loudly at rewrite time instead
+    of as a KeyError deep inside the executor.  Configure with
+    ``paddle_tpu.analysis.set_pass_verification(enabled, strict)``.
+    """
     fn = get_pass(name)
     total = 0
     for block in program.blocks:
         total += fn(block, **kwargs) or 0
     if total:
         program._version += 1
+        _verify_rewrite(program, name, keep=kwargs.get("keep", ()))
     return total
 
 
@@ -64,6 +82,8 @@ def apply_build_strategy(program, passes=("fuse_linear_act",
             total += apply_pass(program, p, keep=keep)
             continue
         total += apply_pass(program, p)
+    if total:
+        _verify_rewrite(program, "+".join(passes), keep=keep)
     return total
 
 

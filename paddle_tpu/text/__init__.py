@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.text (reference: python/paddle/text/ — NLP datasets) + a host-side
 tokenizer (the reference's in-graph faster_tokenizer_op,
 paddle/fluid/operators/string/faster_tokenizer_op.cc:525, becomes host

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.utils.dlpack (reference: paddle/fluid/framework/dlpack_tensor.cc):
 zero-copy tensor exchange with other frameworks via the DLPack protocol."""
 from __future__ import annotations

@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.vision.ops (reference: python/paddle/vision/ops.py): detection
 primitives — nms, box coding, roi_align, deform_conv2d (subset)."""
 from __future__ import annotations

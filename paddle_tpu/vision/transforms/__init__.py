@@ -1,3 +1,4 @@
+# lint-tpu: disable-file=L004 -- grandfathered direct jax use; new backend code belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """paddle.vision.transforms (reference: python/paddle/vision/transforms/).
 
 Operate on numpy HWC uint8/float arrays (the DataLoader host path) and on

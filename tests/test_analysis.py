@@ -1,0 +1,304 @@
+"""paddle_tpu.analysis tests: Program verifier over seeded malformed
+programs, TPU-hazard detector (retrace / host-sync / f64 / zero-trip),
+pass-guard integration, and the repo AST lint (including the whole-
+package clean-run gate that backs the `lint` CI stage)."""
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis, static
+from paddle_tpu.analysis import (ProgramVerificationError, astlint,
+                                 verify_program)
+from paddle_tpu.static.passes import (apply_build_strategy, apply_pass,
+                                      register_pass)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _linear_gelu():
+    """main, startup, feed var, fetch var for x @ w + b -> gelu."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        w = paddle.create_parameter([4, 8], "float32")
+        b = paddle.create_parameter([8], "float32")
+        h = paddle.nn.functional.linear(x, w, b)
+        y = paddle.nn.functional.gelu(h)
+    return main, startup, x, y
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+class TestVerifier:
+    def test_clean_program_has_no_findings(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        assert main.verify(fetch_list=[y]) == []
+
+    def test_dangling_reference_V001(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        op = main.global_block().ops[-1]
+        ghost = types.SimpleNamespace(name="ghost_var",
+                                      block=main.global_block())
+        op.inputs[0] = ("var", ghost)
+        diags = verify_program(main, reinfer=False)
+        assert "V001" in _codes(diags)
+        with pytest.raises(ProgramVerificationError):
+            verify_program(main, strict=True, reinfer=False)
+
+    def test_use_before_def_V002(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        blk = main.global_block()
+        # a buggy pass reorders: activation now precedes its producer
+        blk.ops[:] = [blk.ops[-1]] + blk.ops[:-1]
+        diags = verify_program(main, reinfer=False)
+        assert "V002" in _codes(diags)
+
+    def test_ssa_violation_V003(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        blk = main.global_block()
+        blk.ops.append(blk.ops[-1])  # same output produced twice
+        diags = verify_program(main, reinfer=False)
+        assert "V003" in _codes(diags)
+
+    def test_dead_op_V005(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = paddle.nn.functional.relu(x)
+            paddle.ops.tanh(x)  # recorded, never fetched or consumed
+        diags = verify_program(main, fetch_list=[y], reinfer=False)
+        assert "V005" in _codes(diags)
+        # dead code is a WARNING: strict mode must still pass
+        verify_program(main, fetch_list=[y], strict=True, reinfer=False)
+        # without fetch context the verifier cannot call anything dead
+        assert "V005" not in _codes(verify_program(main, reinfer=False))
+
+    def test_unfetchable_fetch_V006(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        blk = main.global_block()
+        blk.ops[:] = [op for op in blk.ops if y.name not in
+                      [o.name for o in op.outputs]]
+        diags = verify_program(main, fetch_list=[y], reinfer=False)
+        assert "V006" in _codes(diags)
+
+    def test_shape_lying_pass_V007(self, static_mode):
+        import jax
+
+        main, _, _, y = _linear_gelu()
+        blk = main.global_block()
+        lin = [op for op in blk.ops if op.type == "linear"][0]
+        out = lin.outputs[0]
+        # a pass rewired the op but "forgot" to update recorded metadata
+        out._value = jax.ShapeDtypeStruct((3, 3), out._value.dtype)
+        diags = verify_program(main, fetch_list=[y])
+        assert "V007" in _codes(diags)
+
+    def test_dtype_lie_V008(self, static_mode):
+        import jax
+        import jax.numpy as jnp
+
+        main, _, _, y = _linear_gelu()
+        out = main.global_block().ops[0].outputs[0]
+        out._value = jax.ShapeDtypeStruct(tuple(out._value.shape),
+                                          jnp.int32)
+        diags = verify_program(main, fetch_list=[y])
+        assert "V008" in _codes(diags)
+
+
+class TestPassGuard:
+    def test_good_passes_stay_silent(self, static_mode, capsys):
+        main, _, _, y = _linear_gelu()
+        assert apply_build_strategy(main, keep=(y.name,)) >= 1
+        assert main.verify(fetch_list=[y]) == []
+        assert "malformed" not in capsys.readouterr().err
+
+    def test_broken_pass_reported_on_stderr(self, static_mode, capsys):
+        @register_pass("break_program_for_test")
+        def break_program_for_test(block, keep=()):
+            if block.ops:
+                del block.ops[0]  # orphans every consumer downstream
+                return 1
+            return 0
+
+        main, _, _, y = _linear_gelu()
+        apply_pass(main, "break_program_for_test")
+        assert "malformed" in capsys.readouterr().err
+
+    def test_broken_pass_raises_under_strict(self, static_mode):
+        prev = analysis.set_pass_verification(enabled=True, strict=True)
+        try:
+            main, _, _, y = _linear_gelu()
+            with pytest.raises(ProgramVerificationError):
+                apply_pass(main, "break_program_for_test")
+        finally:
+            analysis.set_pass_verification(**prev)
+
+    def test_guard_can_be_disabled(self, static_mode, capsys):
+        prev = analysis.set_pass_verification(enabled=False)
+        try:
+            main, _, _, y = _linear_gelu()
+            apply_pass(main, "break_program_for_test")
+            assert "malformed" not in capsys.readouterr().err
+        finally:
+            analysis.set_pass_verification(**prev)
+
+
+# hazard-scan targets must live at module level in a real file so
+# inspect.getsource works
+def _host_sync_fn(x):
+    v = x.numpy()
+    return paddle.to_tensor(v + 1)
+
+
+def _f64_zero_trip_fn(x):
+    y = x.astype("float64")
+    for i in range(10):
+        if i > 3:
+            break
+        y = y + 1
+    return y
+
+
+class TestHazards:
+    def test_scalar_capture_retrace_H101(self):
+        @paddle.jit.to_static
+        def scaled(x, alpha):
+            return x * alpha
+
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        for a in (0.1, 0.2, 0.3):
+            scaled(x, a)
+        diags = analysis.scan(scaled)
+        h101 = [d for d in diags if d.code == "H101"]
+        assert h101 and h101[0].severity == "error"
+        assert "recompiled 3x" in h101[0].message
+
+    def test_tensor_arg_does_not_retrace(self):
+        @paddle.jit.to_static
+        def scaled(x, alpha):
+            return x * alpha
+
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        for a in (0.1, 0.2, 0.3):
+            scaled(x, paddle.to_tensor(np.float32(a)))
+        assert [d for d in analysis.scan(scaled)
+                if d.code == "H101"] == []
+
+    def test_host_sync_H102(self):
+        diags = analysis.scan_function(_host_sync_fn)
+        h102 = [d for d in diags if d.code == "H102"]
+        assert h102 and h102[0].severity == "error"
+        assert "test_analysis.py" in h102[0].where
+
+    def test_f64_and_zero_trip_H103_H105(self):
+        codes = _codes(analysis.scan_function(_f64_zero_trip_fn))
+        assert "H103" in codes
+        assert "H105" in codes
+
+    def test_scan_dispatches_on_program(self, static_mode):
+        main, _, _, y = _linear_gelu()
+        assert analysis.scan(main) == []
+
+    def test_scan_rejects_junk(self):
+        with pytest.raises(TypeError):
+            analysis.scan(42)
+
+
+class TestAstLint:
+    def test_whole_package_is_clean(self):
+        """The acceptance gate: the CLI over the real package, exactly as
+        the `lint` CI stage runs it."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint_tpu.py"),
+             os.path.join(REPO, "paddle_tpu")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 error(s)" in proc.stdout
+
+    def _lint_src(self, tmp_path, relpath, src):
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        return astlint.lint_file(str(path))
+
+    def test_jax_import_outside_sanctioned_L004(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            "import jax\nfrom jax import numpy as jnp\n")
+        assert [f.code for f in findings] == ["L004", "L004"]
+
+    def test_jax_import_sanctioned_ok(self, tmp_path):
+        assert self._lint_src(tmp_path, "paddle_tpu/core/ok.py",
+                              "import jax\n") == []
+
+    def test_line_suppression(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            "import jax  # lint-tpu: disable=L004\n")
+        assert findings == []
+
+    def test_file_suppression(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            "# lint-tpu: disable-file=L004 -- test fixture\n"
+            "import jax\nimport jax.numpy\n")
+        assert findings == []
+
+    def test_mutable_default_L005(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            "def f(x, hooks=[]):\n    return hooks\n"
+            "def g(x, opts=dict()):\n    return opts\n")
+        assert [f.code for f in findings] == ["L005", "L005"]
+
+    def test_missing_schema_entry_L001(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/ops/math.py",
+            "def totally_new_op(x, name=None):\n    return x\n")
+        assert [f.code for f in findings] == ["L001"]
+
+    def test_signature_drift_L002(self, tmp_path):
+        # schema: add is "(x, y, name=None)" in module math
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/ops/math.py",
+            "def add(x, other, name=None):\n    return x\n")
+        assert [f.code for f in findings] == ["L002"]
+        assert self._lint_src(
+            tmp_path, "paddle_tpu/ops/math.py",
+            "def add(x, y, name=None):\n    return x\n") == []
+
+    def test_private_and_method_defs_exempt(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/ops/math.py",
+            "def _helper(x):\n    return x\n"
+            "class K:\n    def method_not_an_op(self):\n        pass\n")
+        assert findings == []
+
+    def test_unpaired_inplace_L003(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/ops/__init__.py",
+            "_INPLACE_ALIASES = {'matmul_': None}\n")
+        codes = [f.code for f in findings]
+        # matmul_ claims a base op with no schema inplace field, and every
+        # schema-declared inplace variant is now missing from the table
+        assert "L003" in codes
+
+    def test_schema_param_names_helper(self):
+        from paddle_tpu.ops.schema import param_names
+
+        assert param_names("add") == ["x", "y", "name"]
+        assert param_names("einsum") == ["equation", "*operands"]

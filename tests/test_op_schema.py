@@ -23,9 +23,13 @@ def _live_surface():
                "manipulation": ops.manipulation, "logic": ops.logic,
                "linalg": ops.linalg, "search": ops.search,
                "stat": ops.stat, "random": ops.random}
-    import paddle_tpu.ops.einsum as einsum_mod
+    # NOT `import paddle_tpu.ops.einsum as einsum_mod`: the package
+    # re-exports the einsum FUNCTION under the same name, and `import as`
+    # prefers the package attribute over sys.modules — dir() over the
+    # function would silently drop the whole submodule from the gate
+    import importlib
 
-    submods["einsum"] = einsum_mod
+    submods["einsum"] = importlib.import_module("paddle_tpu.ops.einsum")
     for sub, mod in submods.items():
         for name in dir(mod):
             if name.startswith("_"):

@@ -327,3 +327,23 @@ class TestCalibrationPersistence:
         qc.act_quant.calibrated = True
         m8c = Int8Linear(qc)
         assert np.asarray(m8c.w_scale._value).size == 6
+
+    def test_rank1_input_keeps_rank1_output(self):
+        """nn.Linear maps [in] -> [out]; Int8Linear must too — the
+        keepdims [1, out] w_scale used to broadcast the output to
+        [1, out]."""
+        for wtype in ("abs_max", "channel_wise_abs_max"):
+            q = QuantedLinear(nn.Linear(4, 6, ), weight_quantize_type=wtype)
+            q.act_quant.scale._value = jnp.asarray(2.0, jnp.float32)
+            q.act_quant.calibrated = True
+            m8 = Int8Linear(q)
+            x1 = paddle.to_tensor(np.linspace(-1, 1, 4).astype(np.float32))
+            out1 = m8(x1)
+            assert tuple(out1.shape) == (6,), (wtype, tuple(out1.shape))
+            # same numbers as the batched path, just without the row axis
+            out2 = m8(paddle.to_tensor(
+                np.linspace(-1, 1, 4).astype(np.float32)[None, :]))
+            assert tuple(out2.shape) == (1, 6)
+            np.testing.assert_allclose(np.asarray(out1.numpy()),
+                                       np.asarray(out2.numpy())[0],
+                                       rtol=1e-6, atol=1e-6)

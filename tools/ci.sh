@@ -18,6 +18,12 @@ if [ "${JAX_PLATFORMS}" = "cpu" ]; then
   unset PALLAS_AXON_POOL_IPS
 fi
 
+echo "== lint =="
+# repo AST lint: op-schema parity, inplace-alias pairing, jax-import
+# boundaries, mutable defaults.  Exit 1 on any ERROR finding; suppress
+# intentional exceptions with `# lint-tpu: disable[-file]=CODE` (README).
+python tools/lint_tpu.py paddle_tpu/
+
 echo "== unit + integration tests =="
 python -m pytest tests/ -q
 

@@ -24,8 +24,11 @@ def main():
                   "search", "stat", "random", "einsum"]
     seen = {}
     for sub in submodules:
-        mod = getattr(ops, sub if sub != "math" else "math_mod", None) or \
-            __import__(f"paddle_tpu.ops.{sub}", fromlist=[sub])
+        mod = getattr(ops, sub if sub != "math" else "math_mod", None)
+        if not inspect.ismodule(mod):
+            # getattr can return a same-named FUNCTION re-exported in
+            # ops/__init__ (einsum) — always fall back to the module
+            mod = __import__(f"paddle_tpu.ops.{sub}", fromlist=[sub])
         for name in dir(mod):
             if name.startswith("_"):
                 continue

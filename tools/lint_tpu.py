@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""lint_tpu — repo AST lint CLI (op-schema parity, inplace-alias
+pairing, jax-import boundaries, mutable defaults).
+
+Usage:
+    python tools/lint_tpu.py paddle_tpu/
+    python tools/lint_tpu.py --list-rules
+
+Exit status 1 when any unsuppressed ERROR-severity finding exists (the
+``lint`` stage of tools/ci.sh gates on this).  Suppress with
+``# lint-tpu: disable=L004`` on the flagged line or
+``# lint-tpu: disable-file=L004`` anywhere in the file (see README).
+
+Loads the rule engine (paddle_tpu/analysis/astlint.py) by file path so
+linting never imports paddle_tpu or jax — it stays fast and usable even
+when the package itself is broken.
+"""
+import importlib.util
+import os
+import sys
+
+_ASTLINT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "analysis", "astlint.py")
+
+
+def _load_astlint():
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu_astlint", _ASTLINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    sys.exit(_load_astlint().main(sys.argv[1:]))
