@@ -1,0 +1,58 @@
+"""Serving example: continuous-batching inference over the block-pool
+KV cache (paddle_tpu/serving/).
+
+Eight requests with different prompt lengths arrive STAGGERED — new ones
+are submitted while earlier ones are mid-decode — and the engine admits
+and retires them at every decode iteration over one fixed-shape compiled
+step.  Compare the engine's total decode iterations with what serving
+the requests one at a time would cost.
+
+Run:  python examples/serve_llama.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Engine, ServingConfig
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+               for L in (3, 8, 5, 12, 4, 9, 6, 7)]
+    max_new = 16
+
+    eng = Engine(model, ServingConfig(max_batch_size=4, block_size=8,
+                                      num_blocks=64))
+    reqs = []
+    for prompt in prompts:                  # staggered arrivals
+        reqs.append(eng.submit(prompt, max_new_tokens=max_new))
+        eng.step()                          # decode while others queue
+    eng.run_until_complete()
+
+    for req in reqs:
+        out = req.output_ids()
+        print(f"{req.request_id}: prompt={req.prompt_len:2d} tokens -> "
+              f"{out[req.prompt_len:].tolist()} ({req.finish_reason})")
+
+    stats = eng.stats()
+    iters = stats["counters"]["decode_iterations"]
+    sequential = len(prompts) * (max_new - 1)
+    print(f"\ndecode iterations: {iters} continuous-batched vs "
+          f"{sequential} sequential")
+    print(f"avg batch occupancy: "
+          f"{stats['gauges']['batch_occupancy_avg']:.2f}, "
+          f"avg cache utilization: "
+          f"{stats['gauges']['cache_utilization_avg']:.2f}")
+    print(f"compiled decode executables: {eng.decode_cache_size()} "
+          f"(never retraces)")
+    assert iters < sequential
+    assert eng.decode_cache_size() == 1
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,8 @@ validation, InferShape/InferMeta consistency enforcement, and
 - :mod:`paddle_tpu.analysis.hazards` — TPU performance-hazard detector
   over recorded Programs and ``@to_static`` functions (scalar-capture
   recompiles, host syncs in traced regions, f64 upcasts, weak-type
-  promotion leaks, zero-trip loop-var deviation).
+  promotion leaks, zero-trip loop-var deviation, per-token host work
+  in registered serving decode steps).
 - :mod:`paddle_tpu.analysis.astlint` — repo AST lint (op-schema parity,
   inplace-alias pairing, jax-import boundaries, mutable defaults), also
   exposed as the ``tools/lint_tpu.py`` CLI and a ``lint`` CI stage.
@@ -22,8 +23,8 @@ from typing import Any, List, Optional, Sequence
 
 from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
-from .hazards import (scan, scan_function, scan_program,
-                      scan_static_function)
+from .hazards import (scan, scan_decode_step, scan_decode_steps,
+                      scan_function, scan_program, scan_static_function)
 from . import astlint
 
 __all__ = [
@@ -34,6 +35,8 @@ __all__ = [
     "scan_program",
     "scan_function",
     "scan_static_function",
+    "scan_decode_step",
+    "scan_decode_steps",
     "set_pass_verification",
     "pass_verification",
     "verify_after_pass",

@@ -27,6 +27,13 @@ exactly that class of defect:
   ``jit.dy2static._range_for_to_while``, whose documented deviation is
   that an EMPTY range leaves the loop variable at ``start`` instead of
   its prior binding (MIGRATING.md "dy2static constraints").
+- **H106 host work in a decode step**: the serving hot loop runs one
+  compiled decode step PER TOKEN; a ``.item()``/``.numpy()``-style host
+  sync inside a registered step (models/generation.py
+  ``register_decode_step``) stalls the device once per generated token
+  (ERROR), and Python ``if``/``while`` branching on traced values bakes
+  one executable per branch outcome — a retrace per token at worst
+  (WARNING).  ``scan_decode_steps()`` audits every live registered step.
 
 Program-level scans are pure metadata walks (no execution); source-level
 scans are AST walks with real file/line locations.
@@ -44,6 +51,8 @@ __all__ = [
     "scan_program",
     "scan_function",
     "scan_static_function",
+    "scan_decode_step",
+    "scan_decode_steps",
     "scan",
 ]
 
@@ -231,6 +240,96 @@ def scan_function(fn) -> List[Diagnostic]:
     scanner = _SourceScanner(filename, firstline)
     scanner.visit(tree)
     return scanner.diags
+
+
+# ---------------------------------------------------------------------------
+# decode-step scans (serving hot loop)
+# ---------------------------------------------------------------------------
+
+class _DecodeStepScanner(ast.NodeVisitor):
+    """H106: the body of a decode step runs once PER GENERATED TOKEN, so
+    hazards that are merely slow elsewhere are per-token stalls here."""
+
+    def __init__(self, filename: str, firstline: int, name: str):
+        self.filename = filename
+        self.firstline = firstline
+        self.name = name
+        self.diags: List[Diagnostic] = []
+
+    def _where(self, node) -> str:
+        return f"{self.filename}:{self.firstline + node.lineno - 1}"
+
+    def add(self, severity, message, node):
+        self.diags.append(
+            Diagnostic("H106", severity, message, self._where(node)))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _HOST_SYNC_ATTRS \
+                and not node.args and not node.keywords:
+            self.add(
+                ERROR,
+                f"decode step '{self.name}' calls .{fn.attr}() — a device→"
+                "host sync once per generated token; keep the hot loop "
+                "device-side and fetch results after retirement", node)
+        elif isinstance(fn, ast.Name) and fn.id in _HOST_SYNC_CALLS:
+            self.add(
+                ERROR,
+                f"decode step '{self.name}' calls {fn.id}(...) — "
+                "materializes on host once per generated token", node)
+        self.generic_visit(node)
+
+    def _branch(self, node, kind):
+        self.add(
+            WARNING,
+            f"decode step '{self.name}' has a Python {kind} — branching "
+            "on a traced value fails outright, and branching on a "
+            "captured scalar bakes one executable per outcome (a retrace "
+            "per token at worst); use lax.select/where so ONE program "
+            "serves every iteration", node)
+
+    def visit_If(self, node):
+        self._branch(node, "'if'")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._branch(node, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._branch(node, "'while' loop")
+        self.generic_visit(node)
+
+
+def scan_decode_step(fn) -> List[Diagnostic]:
+    """AST-audit one decode-step function (the raw Python function behind
+    a compiled serving step) for H106 hazards: host syncs (ERROR) and
+    Python branching (WARNING) inside the per-token hot loop."""
+    raw = inspect.unwrap(getattr(fn, "_fn", fn))
+    raw = getattr(raw, "__func__", raw)
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        filename = inspect.getsourcefile(raw) or "<unknown>"
+        firstline = inspect.getsourcelines(raw)[1]
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return []
+    scanner = _DecodeStepScanner(
+        filename, firstline, getattr(raw, "__name__", repr(fn)))
+    scanner.visit(tree)
+    return scanner.diags
+
+
+def scan_decode_steps() -> List[Diagnostic]:
+    """Audit every LIVE decode step registered via
+    ``models.generation.register_decode_step`` (the built-in greedy/
+    beam/prefill/paged steps plus any user-registered custom step)."""
+    from ..models.generation import registered_decode_steps
+
+    diags: List[Diagnostic] = []
+    for fn in registered_decode_steps():
+        diags.extend(scan_decode_step(fn))
+    return diags
 
 
 # ---------------------------------------------------------------------------

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Config", "create_predictor", "DistConfig", "DistModel",
+__all__ = ["Config", "create_predictor", "create_serving_endpoint",
+           "DistConfig", "DistModel",
            "Predictor", "PredictorPool", "get_version", "DataType",
            "PlaceType", "PrecisionType", "Tensor", "get_trt_compile_version",
            "get_trt_runtime_version", "get_num_bytes_of_data_type",
@@ -249,6 +250,20 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_serving_endpoint(model, config=None, **generate_defaults):
+    """Continuous-batching LLM front door: a Predictor-shaped
+    :class:`paddle_tpu.serving.Endpoint` over a live causal LM (the
+    Predictor above serves jit.save artifacts; this serves token
+    streams with iteration-level batching — see paddle_tpu/serving/).
+
+    ``config`` is a :class:`paddle_tpu.serving.ServingConfig`;
+    ``generate_defaults`` (eos_token_id, max_new_tokens, ...) apply to
+    every request unless overridden per call."""
+    from ..serving import Endpoint
+
+    return Endpoint(model, config, **generate_defaults)
 
 
 class PredictorPool:

@@ -75,6 +75,87 @@ def _gather_caches(caches, idx):
             for c in caches]
 
 
+# ---------------------------------------------------------------------------
+# decode-step registry (serving hot loop + analysis H106)
+# ---------------------------------------------------------------------------
+# Every compiled step built here registers its raw (pre-jit) Python
+# function so paddle_tpu.analysis.hazards can AST-audit the serving hot
+# loop (H106: host syncs / python branching inside a decode step force a
+# device→host round trip per token).  Weak refs: a registered step must
+# not keep its model alive after the caller drops it.
+_decode_step_registry: "list[weakref.ref]" = []
+
+
+def register_decode_step(fn):
+    """Register ``fn`` (the raw Python function behind a compiled decode/
+    prefill step) for hazard auditing.  Returns ``fn`` so it can be used
+    as a decorator."""
+    _decode_step_registry.append(weakref.ref(fn))
+    return fn
+
+
+def registered_decode_steps():
+    """Live registered decode-step functions (dead models pruned)."""
+    alive = []
+    remaining = []
+    for r in _decode_step_registry:
+        fn = r()
+        if fn is not None:
+            alive.append(fn)
+            remaining.append(r)
+    _decode_step_registry[:] = remaining
+    return alive
+
+
+# ---------------------------------------------------------------------------
+# stop sequences (shared between generate() and serving.Scheduler)
+# ---------------------------------------------------------------------------
+
+def normalize_stop_sequences(stop_sequences, tokenizer=None):
+    """Normalize user-facing stop specs to ``list[list[int]]``.
+
+    Accepts None, a single token id, one token-id sequence, a list of
+    either, or strings (requires ``tokenizer`` with an ``encode`` method
+    or a callable returning token ids)."""
+    if stop_sequences is None:
+        return []
+    if isinstance(stop_sequences, (int, np.integer, str)):
+        stop_sequences = [stop_sequences]
+    elif stop_sequences and all(
+            isinstance(t, (int, np.integer)) for t in stop_sequences):
+        # one bare token-id sequence
+        stop_sequences = [list(stop_sequences)]
+    out = []
+    for s in stop_sequences:
+        if isinstance(s, str):
+            if tokenizer is None:
+                raise ValueError(
+                    "string stop sequences need a tokenizer= with an "
+                    "encode method (generate works on token ids)")
+            enc = getattr(tokenizer, "encode", tokenizer)
+            s = enc(s)
+            ids = getattr(s, "ids", s)  # tokenizers-style Encoding
+            s = list(np.asarray(ids).reshape(-1))
+        elif isinstance(s, (int, np.integer)):
+            s = [s]
+        s = [int(t) for t in s]
+        if not s:
+            raise ValueError("empty stop sequence")
+        out.append(s)
+    return out
+
+
+def match_stop(generated, stop_sequences) -> bool:
+    """True when ``generated`` (token ids, oldest→newest) ends with any
+    of the normalized stop sequences.  The serving scheduler and
+    ``generate()`` share this exact termination check."""
+    for s in stop_sequences:
+        n = len(s)
+        if n <= len(generated) and list(generated[-n:]) == s:
+            return True
+    return False
+
+
 def _weights_fingerprint(model):
     """Identity fingerprint of every parameter buffer.  Any rebind of a
     param's backing array (optimizer step, set_state_dict, checkpoint
@@ -126,6 +207,7 @@ def make_decode_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
+    @register_decode_step
     def step(tok, caches, offset):
         with no_grad_ctx():
             wrapped = [StaticKVCache(k, v) for k, v in caches]
@@ -157,6 +239,7 @@ def make_beam_decode_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
+    @register_decode_step
     def step(tok, caches, offset, parents):
         with no_grad_ctx():
             wrapped = [StaticKVCache(k[parents], v[parents])
@@ -171,15 +254,91 @@ def make_beam_decode_step(model):
     return step
 
 
+def make_prefill_step(model):
+    """One jit-compiled prompt-prefill step over static caches, reusable
+    at any padded prompt length (serving buckets prompts to block
+    multiples, so the jit cache holds one executable per bucket, never
+    per prompt).  step(ids[1, Lp] int32, caches, last_index int32 scalar)
+    -> (last_real_logits[1, V] f32, new_caches): the logits are gathered
+    at the TRACED index of the last REAL prompt token, so padding never
+    changes which row is returned."""
+    step = getattr(model, "_prefill_step", None)
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_prefill_step_fp", None)):
+        return step
+    fp = _weights_fingerprint(model)
+
+    from .llama import StaticKVCache
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    @register_decode_step
+    def step(ids, caches, last_index):
+        with no_grad_ctx():
+            wrapped = [StaticKVCache(k, v) for k, v in caches]
+            logits, new_caches = model(Tensor(ids), caches=wrapped,
+                                       position_offset=0)
+            last = jax.lax.dynamic_index_in_dim(
+                logits._value, last_index, axis=1, keepdims=False)
+            return (last.astype(jnp.float32),
+                    [(c.k, c.v) for c in new_caches])
+
+    model._prefill_step = step
+    model._prefill_step_fp = fp
+    return step
+
+
+def make_paged_decode_step(model):
+    """The continuous-batching decode step: one token for a BUCKET of
+    sequences, each at its own position, over the shared block-pool
+    cache (models/llama.py PagedKVCache).  step(tok[B,1] int32, pools
+    [(k, v)] per layer, block_tables[B, max_blocks] int32, lengths[B]
+    int32) -> (last_logits[B, V] f32, new_pools).  Every input shape is
+    fixed by the engine config, so after the first call this NEVER
+    retraces — the property the serving engine asserts every step."""
+    step = getattr(model, "_paged_decode_step", None)
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_paged_decode_step_fp", None)):
+        return step
+    fp = _weights_fingerprint(model)
+
+    from .llama import PagedKVCache
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    @register_decode_step
+    def step(tok, pools, block_tables, lengths):
+        with no_grad_ctx():
+            wrapped = [PagedKVCache(k, v, block_tables) for k, v in pools]
+            logits, new_caches = model(Tensor(tok), caches=wrapped,
+                                       position_offset=lengths)
+            return (logits._value[:, -1].astype(jnp.float32),
+                    [(c.k, c.v) for c in new_caches])
+
+    model._paged_decode_step = step
+    model._paged_decode_step_fp = fp
+    return step
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
-             eos_token_id=None, seed=None, use_static_cache=False):
+             eos_token_id=None, seed=None, use_static_cache=False,
+             stop_sequences=None, tokenizer=None):
     """Decode continuations for a batch of prompts.
 
     Returns [B, T_prompt + T_new] token ids (beam search returns the best
     beam per batch element).  Greedy by default; ``do_sample`` enables
     temperature/top-k/top-p sampling; ``num_beams > 1`` switches to beam
-    search with length-agnostic log-prob scores."""
+    search with length-agnostic log-prob scores.
+
+    Termination: a sequence finishes when it emits ``eos_token_id``, when
+    its generated suffix matches any of ``stop_sequences`` (token-id
+    list(s); strings need ``tokenizer``), or at ``max_new_tokens``.
+    Finished sequences are padded with ``eos_token_id`` (0 when only stop
+    sequences are given) and the loop exits early once EVERY sequence has
+    finished — a mixed-length batch never pays full-length compute."""
     from ..core.dispatch import no_grad_ctx
     from ..ops import random as rnd
 
@@ -194,8 +353,13 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             f"prompt ({T0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings ({max_pos}) — the rope table has no "
             f"entries past it (dynamic_slice would silently clamp)")
+    stops = normalize_stop_sequences(stop_sequences, tokenizer)
     with no_grad_ctx():
         if num_beams > 1:
+            if stops:
+                raise ValueError(
+                    "stop_sequences are not supported with beam search; "
+                    "use eos_token_id or greedy/sampling decoding")
             return _beam_generate(model, ids, max_new_tokens, num_beams,
                                   eos_token_id,
                                   use_static_cache=use_static_cache)
@@ -211,6 +375,12 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             cache_arrays = [(c.k, c.v) for c in caches]
         out = [ids]
         finished = np.zeros((B,), bool)
+        terminal = eos_token_id is not None or bool(stops)
+        # finished rows are padded with eos (0 when only stop sequences
+        # terminate) so a mixed-length batch stays rectangular
+        pad_id = eos_token_id if eos_token_id is not None else 0
+        max_stop = max((len(s) for s in stops), default=0)
+        suffixes = [[] for _ in range(B)]   # per-row stop-match windows
         last = logits._value[:, -1].astype(jnp.float32)
         for step in range(max_new_tokens):
             key, sub = jax.random.split(key)
@@ -218,11 +388,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p, key=sub)
             tok_np = np.asarray(tok)
-            if eos_token_id is not None:
-                tok_np = np.where(finished, eos_token_id, tok_np)
-                finished |= tok_np == eos_token_id
+            if terminal:
+                tok_np = np.where(finished, pad_id, tok_np)
+                if eos_token_id is not None:
+                    finished |= tok_np == eos_token_id
+                for b in range(B):
+                    if stops and not finished[b]:
+                        suffixes[b].append(int(tok_np[b]))
+                        if len(suffixes[b]) > max_stop:
+                            del suffixes[b][:-max_stop]
+                        finished[b] = match_stop(suffixes[b], stops)
             out.append(tok_np[:, None])
-            if eos_token_id is not None and finished.all():
+            if terminal and finished.all():
                 break
             if step == max_new_tokens - 1:
                 break  # the last token is chosen; don't pay one more step

@@ -90,8 +90,17 @@ def precompute_rope(head_dim, max_pos, theta):
 
 def apply_rope(x, cos, sin, position_offset=0):
     """x: [B, T, H, D].  Rotate-half convention.  position_offset may be
-    a traced scalar (static-cache decode compiles ONE step program)."""
+    a traced scalar (static-cache decode compiles ONE step program) or a
+    traced [B] vector of per-sequence positions (continuous-batching
+    decode: every sequence in the bucket sits at its own frontier)."""
     T = x.shape[1]
+    if jnp.ndim(position_offset):
+        pos = jnp.asarray(position_offset)[:, None] + jnp.arange(T)
+        c = cos[pos][:, :, None, :]     # [B, T, 1, D/2]
+        s = sin[pos][:, :, None, :]
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return out.astype(x.dtype)
     c = jax.lax.dynamic_slice_in_dim(cos, position_offset, T)[
         None, :, None, :]
     s = jax.lax.dynamic_slice_in_dim(sin, position_offset, T)[
@@ -130,6 +139,46 @@ class StaticKVCache:
 jax.tree_util.register_pytree_node(
     StaticKVCache, lambda c: c.tree_flatten(),
     StaticKVCache.tree_unflatten)
+
+
+class PagedKVCache:
+    """Block-pool cache view for continuous-batching decode (the serving
+    engine's substrate; PAPERS.md: vLLM's PagedAttention over Orca's
+    iteration-level scheduling).  ``k``/``v`` are SHARED physical pools of
+    shape [num_blocks, block_size, kv_heads, head_dim]; ``block_table``
+    [B, max_blocks] maps each sequence's logical block i to a pool block
+    id.  Per-sequence write frontiers ride in as the (vector)
+    ``position_offset`` of the forward call, exactly as the scalar offset
+    does for :class:`StaticKVCache` — every shape is fixed, so ONE
+    compiled decode step serves every mix of sequences forever.
+
+    Unallocated/retired table entries may point anywhere (the engine uses
+    a reserved garbage block): attention masks keys past each sequence's
+    frontier, so stale pool contents are never observable.
+    """
+
+    __slots__ = ("k", "v", "block_table")
+
+    def __init__(self, k, v, block_table):
+        self.k = k              # [num_blocks, block_size, kv_heads, head_dim]
+        self.v = v
+        self.block_table = block_table      # [B, max_blocks] int32
+
+    @property
+    def block_size(self):
+        return self.k.shape[1]
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.block_table), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache, lambda c: c.tree_flatten(),
+    PagedKVCache.tree_unflatten)
 
 
 class LlamaRMSNorm(nn.Layer):
@@ -190,13 +239,68 @@ class LlamaAttention(nn.Layer):
         def _rope_fn(xv):
             from ..core.flags import flag
 
-            if flag("use_pallas_kernels") and jax.default_backend() == "tpu":
+            # the fused kernel takes a scalar offset; per-sequence vector
+            # offsets (continuous-batching decode) use the gather path
+            if flag("use_pallas_kernels") and jax.default_backend() == "tpu" \
+                    and not jnp.ndim(position_offset):
                 from ..kernels.rope import fused_rope
 
                 return fused_rope(xv, cos, sin, position_offset)
             return apply_rope(xv, cos, sin, position_offset)
         q = apply("rope", _rope_fn, q)
         k = apply("rope", _rope_fn, k)
+
+        if isinstance(cache, PagedKVCache):
+            # serving decode: T == 1, position_offset is a [B] vector of
+            # per-sequence frontiers.  Write this token's k/v into each
+            # sequence's current block, then attend over the gathered
+            # block views — all fixed shapes, one executable forever.
+            assert T == 1, "PagedKVCache supports single-token decode only"
+            bs = cache.k.shape[1]
+            bt = cache.block_table
+            offsets = jnp.asarray(position_offset)
+
+            def _scatter(pool, new):
+                # pool [nb, bs, kvh, hd]; new [B, 1, kvh, hd] → flat row
+                # index block_table[b, off//bs]*bs + off%bs per sequence
+                nb = pool.shape[0]
+                rows = jnp.arange(bt.shape[0])
+                blk = bt[rows, offsets // bs]
+                idx = blk * bs + offsets % bs
+                flat = pool.reshape(nb * bs, pool.shape[2], pool.shape[3])
+                flat = flat.at[idx].set(new[:, 0].astype(pool.dtype))
+                return flat.reshape(pool.shape)
+
+            k_pool = apply("paged_kv_update", _scatter, Tensor(cache.k), k)
+            v_pool = apply("paged_kv_update", _scatter, Tensor(cache.v), v)
+            new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
+
+            def _paged_attn(qv, kp, vp):
+                # contiguous per-sequence views of the block pool: the
+                # same full-buffer masked attention as the static cache,
+                # just gathered through the block table
+                kb = kp[bt].reshape(bt.shape[0], -1, kp.shape[2],
+                                    kp.shape[3])
+                vb = vp[bt].reshape(bt.shape[0], -1, vp.shape[2],
+                                    vp.shape[3])
+                rep = qv.shape[2] // kb.shape[2]
+                if rep > 1:
+                    kb = jnp.repeat(kb, rep, axis=2)
+                    vb = jnp.repeat(vb, rep, axis=2)
+                scores = jnp.einsum(
+                    "bthd,bshd->bhts", qv, kb,
+                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(self.head_dim)
+                q_pos = offsets[:, None] + jnp.arange(qv.shape[1])  # [B, 1]
+                k_pos = jnp.arange(kb.shape[1])
+                valid = k_pos[None, None, :] <= q_pos[:, :, None]
+                scores = jnp.where(valid[:, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(qv.dtype)
+                return jnp.einsum("bhts,bshd->bthd", probs, vb)
+
+            out = apply("paged_attention", _paged_attn, q, k_pool, v_pool)
+            out = out.reshape([B, T, -1])
+            return self.o_proj(out), new_cache
 
         if isinstance(cache, StaticKVCache):
             # fixed-size buffer write; one compiled program per decode
@@ -461,7 +565,8 @@ class LlamaForCausalLM(nn.Layer):
                  top_k: Optional[int] = None, top_p: float = 1.0,
                  do_sample: Optional[bool] = None, num_beams: int = 1,
                  eos_token_id: Optional[int] = None, seed=None,
-                 use_static_cache: bool = False):
+                 use_static_cache: bool = False, stop_sequences=None,
+                 tokenizer=None):
         """Decode with the KV cache (models/generation.py): greedy,
         temperature/top-k/top-p sampling, or beam search.
 
@@ -486,4 +591,5 @@ class LlamaForCausalLM(nn.Layer):
                 do_sample=do_sample, temperature=temperature,
                 top_k=top_k or 0, top_p=top_p, num_beams=num_beams,
                 eos_token_id=eos_token_id, seed=seed,
-                use_static_cache=use_static_cache)
+                use_static_cache=use_static_cache,
+                stop_sequences=stop_sequences, tokenizer=tokenizer)

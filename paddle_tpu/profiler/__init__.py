@@ -18,7 +18,8 @@ from enum import Enum
 from typing import Callable, List, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "current_profiler", "record_host_range"]
 
 
 class ProfilerState(Enum):
@@ -88,6 +89,22 @@ class _HostEventRecorder:
 
 _recorder = _HostEventRecorder()
 _active_profiler: Optional["Profiler"] = None
+
+
+def current_profiler() -> Optional["Profiler"]:
+    """The active Profiler session, or None.  External event sources
+    (e.g. serving metrics) use this to emit host ranges only while a
+    session is actually recording."""
+    return _active_profiler
+
+
+def record_host_range(name: str, start_ns: int, end_ns: int,
+                      category: str = "host"):
+    """Record an explicit host range with caller-measured timestamps
+    (perf_counter_ns).  Lands in the active session's chrome trace next
+    to RecordEvent ranges; categories other than "host" stay on the
+    Python buffer so they keep their category at export."""
+    _recorder.record(name, start_ns, end_ns, category=category)
 
 
 class RecordEvent:

@@ -1,0 +1,55 @@
+"""paddle_tpu.serving — continuous-batching LLM inference.
+
+The ROADMAP's north star serves "heavy traffic from millions of users";
+this package is the serving half of that claim.  It turns the one-shot
+``models.generation.generate()`` loop into an engine that admits and
+retires requests at EVERY decode iteration (Orca's iteration-level
+scheduling) over a block-pool KV cache with free-list allocation and
+preemption (vLLM's paged KV cache) — see PAPERS.md for both.  Because
+the decode step's shapes are fixed by the engine config, the whole hot
+loop is ONE compiled XLA program that never retraces: the TPU-native
+serving property the rest of the framework is built around.
+
+Layout:
+
+- :mod:`engine`    — the continuous-batching :class:`Engine`
+- :mod:`cache`     — :class:`BlockKVPool`, the paged cache memory manager
+- :mod:`scheduler` — FCFS+fairness policy, admission control, preemption
+- :mod:`metrics`   — TTFT/TPOT/queue-time counters + engine gauges
+- :mod:`endpoint`  — Predictor-shaped :class:`Endpoint` front door
+
+Quick start::
+
+    from paddle_tpu.serving import Engine, ServingConfig
+    eng = Engine(model, ServingConfig(max_batch_size=8, block_size=16,
+                                      num_blocks=128))
+    req = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+    eng.run_until_complete()
+    tokens = req.output_ids()
+    print(eng.stats())
+"""
+from __future__ import annotations
+
+from .cache import BlockKVPool, PoolExhausted
+from .endpoint import Endpoint
+from .engine import Engine, ServingConfig
+from .metrics import RequestTimeline, ServingMetrics
+from .scheduler import (FINISHED, PREEMPTED, QUEUED, RUNNING,
+                        AdmissionError, Request, Scheduler)
+
+__all__ = [
+    "Engine",
+    "ServingConfig",
+    "Endpoint",
+    "BlockKVPool",
+    "PoolExhausted",
+    "Scheduler",
+    "Request",
+    "AdmissionError",
+    "ServingMetrics",
+    "RequestTimeline",
+    "QUEUED",
+    "RUNNING",
+    "PREEMPTED",
+    "FINISHED",
+]

@@ -1,0 +1,143 @@
+# lint-tpu: disable-file=L004 -- serving owns the block-pool device
+# buffers directly (like models/); new backend code belongs under core/
+# ops/ kernels/ static/ distributed/ (README: Repo lint)
+"""Block-based KV-cache pool (PAPERS.md: vLLM's PagedAttention memory
+manager, layered on models/llama.py StaticKVCache semantics).
+
+The pool owns per-layer (k, v) device buffers of shape
+``[num_blocks, block_size, kv_heads, head_dim]``.  Sequences own
+BLOCKS, not contiguous buffer ranges: a free-list allocator hands out
+``block_size``-token blocks one at a time as a sequence's frontier
+grows, so cache capacity is packed at block granularity instead of
+being reserved at worst-case length per request — the memory headroom
+that lets continuous batching run many more concurrent sequences than
+``max_batch * max_len`` preallocation would.
+
+Block 0 is a reserved garbage sink: idle engine slots decode with
+block-table entries pointing at it, so the compiled step never needs a
+host-side branch on "is this slot live" (the write lands in garbage,
+attention masks it, and the hot loop stays device-resident — H106).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PoolExhausted(Exception):
+    """No free blocks: the caller must preempt or wait."""
+
+
+class BlockKVPool:
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the reserved "
+                             "garbage sink)")
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        z = jnp.zeros((num_blocks, block_size, kv_heads, head_dim), dtype)
+        # per-layer (k, v) physical pools — the arrays handed to the
+        # compiled decode step and rebound to its outputs every token
+        self.layers: List[Tuple[jax.Array, jax.Array]] = [
+            (z, z) for _ in range(num_layers)]
+        # LIFO free list over blocks 1..n-1 (block 0 reserved)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owner: Dict[int, object] = {}   # block id -> request id
+
+    # ------------------------------------------------------- accounting
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (excludes the reserved garbage block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def utilization(self) -> float:
+        return self.num_used / self.capacity_blocks
+
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` cache positions."""
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def owned_by(self, request_id) -> List[int]:
+        return [b for b, o in self._owner.items() if o == request_id]
+
+    # ------------------------------------------------------- allocation
+    def allocate(self, request_id, n: int = 1) -> List[int]:
+        if len(self._free) < n:
+            raise PoolExhausted(
+                f"need {n} block(s), {len(self._free)} free "
+                f"(capacity {self.capacity_blocks})")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = request_id
+        return blocks
+
+    def free(self, blocks: Sequence[int]):
+        for b in blocks:
+            owner = self._owner.pop(b, None)
+            if owner is None:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+    def free_request(self, request_id):
+        self.free(self.owned_by(request_id))
+
+    def check_leaks(self):
+        """Raise if any block is still owned — used by tests and engine
+        shutdown to prove the free-list round-trips."""
+        if self._owner:
+            raise AssertionError(
+                f"leaked blocks: {sorted(self._owner.items())}")
+
+    # ------------------------------------------------------ device data
+    def install_prefill(self, blocks: Sequence[int], prefill_caches):
+        """Copy a prompt's prefilled StaticKVCache buffers
+        (``[(k, v)]`` per layer, each ``[1, len(blocks)*block_size, kv,
+        hd]``) into the owned pool blocks.  Shapes vary only with
+        ``len(blocks)``, so jit holds one executable per prompt-block
+        count (prefill-side; the decode step itself never retraces)."""
+        idx = jnp.asarray(list(blocks), jnp.int32)
+        new = _install_impl(tuple(self.layers),
+                            tuple((k, v) for k, v in prefill_caches), idx)
+        self.layers = [(k, v) for k, v in new]
+
+    def stats(self) -> dict:
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "used_blocks": self.num_used,
+            "free_blocks": self.num_free,
+            "block_size": self.block_size,
+            "utilization": round(self.utilization(), 4),
+        }
+
+
+@jax.jit
+def _install_impl(layers, prefill, idx):
+    out = []
+    for (pk, pv), (fk, fv) in zip(layers, prefill):
+        n = idx.shape[0]
+        bs = pk.shape[1]
+        out.append((
+            pk.at[idx].set(fk[0].reshape(n, bs, fk.shape[2], fk.shape[3])
+                           .astype(pk.dtype)),
+            pv.at[idx].set(fv[0].reshape(n, bs, fv.shape[2], fv.shape[3])
+                           .astype(pv.dtype)),
+        ))
+    return out

@@ -1,0 +1,104 @@
+"""``serving.Endpoint`` — the Predictor-shaped front door to the
+continuous-batching engine (reference: paddle_inference's
+AnalysisPredictor run loop; see paddle_tpu/inference/__init__.py).
+
+Two usage styles:
+
+- Predictor parity: ``get_input_handle("input_0").copy_from_cpu(ids)``
+  → ``run()`` → ``get_output_handle("output_0").copy_to_cpu()`` — one
+  rectangular batch in, EOS-padded rectangular batch out, so code
+  written against :class:`paddle_tpu.inference.Predictor` ports over.
+- Streaming: ``submit()`` / ``poll()`` / ``drain()`` for callers that
+  want requests admitted and retired at token granularity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .engine import Engine, ServingConfig
+from .scheduler import FINISHED, Request
+
+
+class Endpoint:
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 **generate_defaults):
+        self.engine = Engine(model, config)
+        self._defaults = generate_defaults
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    # ------------------------------------------------- Predictor parity
+    def get_input_names(self) -> List[str]:
+        return ["input_0"]
+
+    def get_output_names(self) -> List[str]:
+        return ["output_0"]
+
+    def get_input_handle(self, name: str) -> "_Handle":
+        return _Handle(self._inputs, name)
+
+    def get_output_handle(self, name: str) -> "_Handle":
+        return _Handle(self._outputs, name)
+
+    def run(self, prompts=None, **generate_kwargs) -> List[np.ndarray]:
+        """Serve a batch: list/array of prompts (or the ``input_0``
+        handle), continuous batching under the hood, outputs in submit
+        order.  ``output_0`` holds an EOS-padded rectangular [B, T]
+        array for handle-style callers; the return value keeps exact
+        per-request lengths."""
+        if prompts is None:
+            prompts = self._inputs.get("input_0")
+            if prompts is None:
+                raise ValueError("no prompts: pass run(prompts) or "
+                                 "copy_from_cpu into input_0")
+        kwargs = {**self._defaults, **generate_kwargs}
+        outs = self.engine.generate(list(np.asarray(p).reshape(-1)
+                                         for p in prompts), **kwargs)
+        pad = kwargs.get("eos_token_id") or 0
+        width = max(o.size for o in outs)
+        rect = np.full((len(outs), width), pad, np.int32)
+        for i, o in enumerate(outs):
+            rect[i, :o.size] = o
+        self._outputs["output_0"] = rect
+        return outs
+
+    # --------------------------------------------------------- streaming
+    def submit(self, prompt, **kwargs) -> Request:
+        return self.engine.submit(prompt, **{**self._defaults, **kwargs})
+
+    def poll(self) -> bool:
+        """One engine iteration; True while work remains."""
+        return self.engine.step()
+
+    def drain(self) -> Dict[str, Request]:
+        return self.engine.run_until_complete()
+
+    def result(self, req: Request) -> Optional[np.ndarray]:
+        return req.output_ids() if req.state == FINISHED else None
+
+    def metrics(self) -> dict:
+        return self.engine.stats()
+
+
+class _Handle:
+    """ZeroCopyTensor-shaped view over an Endpoint io dict."""
+
+    def __init__(self, store: dict, name: str):
+        self._store = store
+        self.name = name
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, data):
+        self._store[self.name] = np.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._store[self.name])
+
+    @property
+    def shape(self):
+        a = self._store.get(self.name)
+        return list(a.shape) if a is not None else None

@@ -1,0 +1,320 @@
+# lint-tpu: disable-file=L004 -- serving drives the compiled decode/
+# prefill steps over raw device buffers (like models/); new backend code
+# belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
+"""Continuous-batching inference engine (PAPERS.md: Orca's
+iteration-level scheduling + vLLM's paged KV cache) over the compiled
+steps of models/generation.py.
+
+The engine keeps a fixed BUCKET of ``max_batch_size`` decode slots.
+Every iteration it (1) retires finished sequences, (2) admits waiting
+requests into free slots — one compiled prefill per prompt, bucketed to
+block multiples — and (3) runs ONE compiled decode step over the whole
+bucket: token ids [S, 1], the shared block pools, block tables
+[S, max_blocks] and per-slot frontiers [S].  Because every array shape
+is fixed by the config, the decode step compiles exactly once; idle
+slots decode into the reserved garbage block instead of branching.
+Requests therefore enter and leave at TOKEN granularity — no
+batch-completion barrier, which is what turns the static decode step
+into a serving engine.
+
+Correctness contract: greedy outputs are token-exact with sequential
+``generate()`` for the same prompts (tests/test_serving.py), including
+across preemption (recompute-from-prompt is deterministic under
+greedy).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generation import (_cache_dims, make_paged_decode_step,
+                                 make_prefill_step,
+                                 normalize_stop_sequences)
+from .. import profiler
+from .cache import BlockKVPool, PoolExhausted
+from .metrics import ServingMetrics
+from .scheduler import (FINISHED, RUNNING, AdmissionError, Request,
+                        Scheduler)
+
+
+def _trace(name: str):
+    """Profiler range for the serving hot path — a no-op unless a
+    profiler session is recording (RecordEvent buffers until drained, so
+    unconditional use would grow host memory for the engine's lifetime)."""
+    if profiler.current_profiler() is not None:
+        return profiler.RecordEvent(name)
+    return contextlib.nullcontext()
+
+
+@dataclass
+class ServingConfig:
+    """Engine tuning knobs (README "Serving" documents each)."""
+
+    max_batch_size: int = 8       # decode-bucket slots
+    block_size: int = 16          # KV-cache tokens per block
+    num_blocks: int = 128         # pool size incl. reserved block 0
+    max_queue_len: int = 64       # bounded wait queue (backpressure)
+    max_model_len: Optional[int] = None   # default: model max positions
+    # raise RuntimeError if the compiled decode step ever retraces after
+    # warmup (the H101-style jit cache-key check; cheap, keep on)
+    strict_no_retrace: bool = True
+
+
+class Engine:
+    """Continuous-batching engine for any causal LM following the
+    cache contract of models/llama.py (StaticKVCache + PagedKVCache)."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        self.model = model
+        self.config = cfg = config or ServingConfig()
+        kv_heads, head_dim, dtype = _cache_dims(model)
+        model_max = getattr(model.config, "max_position_embeddings", None)
+        self.max_model_len = min(
+            cfg.max_model_len or model_max or 1 << 30,
+            model_max or 1 << 30)
+        self.max_blocks_per_seq = -(-self.max_model_len // cfg.block_size)
+        self.pool = BlockKVPool(
+            model.config.num_hidden_layers, cfg.num_blocks, cfg.block_size,
+            kv_heads, head_dim, dtype)
+        self.scheduler = Scheduler(self.pool,
+                                   max_queue_len=cfg.max_queue_len)
+        self.metrics = ServingMetrics()
+        S = cfg.max_batch_size
+        self._slots: List[Optional[Request]] = [None] * S
+        self._block_tables = np.zeros((S, self.max_blocks_per_seq),
+                                      np.int32)
+        self._lengths = np.zeros((S,), np.int32)
+        self._pending = np.zeros((S,), np.int32)  # next token to decode
+        self._decode_step = make_paged_decode_step(model)
+        self._prefill_step = make_prefill_step(model)
+        self._decode_warm = False
+        self._finished: Dict[str, Request] = {}
+        self._ids = itertools.count()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, stop_sequences=None,
+               tokenizer=None, request_id: Optional[str] = None,
+               temperature: float = 0.0, do_sample: bool = False
+               ) -> Request:
+        """Queue one request; returns its :class:`Request` handle.
+        Raises :class:`AdmissionError` when the wait queue is full or
+        the sequence can never fit the pool (backpressure: callers
+        retry or shed load).
+
+        ``temperature``/``do_sample`` exist for ``generate()`` call-site
+        parity only: the engine decodes greedily (one shared compiled
+        step for the whole bucket), so greedy settings are accepted and
+        a sampling request is a loud :class:`ValueError` rather than a
+        silently different decode."""
+        if do_sample or (temperature is not None
+                         and float(temperature) != 0.0):
+            raise ValueError(
+                "the serving engine decodes greedily; sampling "
+                "(do_sample=True or temperature>0) is not supported — "
+                "use temperature=0.0, generate()'s greedy contract")
+        prompt = np.asarray(
+            prompt.numpy() if hasattr(prompt, "numpy") else prompt,
+            np.int32).reshape(-1)
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+            stop_sequences=normalize_stop_sequences(stop_sequences,
+                                                    tokenizer),
+            request_id=request_id or f"req-{next(self._ids)}")
+        if req.prompt_len + req.max_new_tokens > self.max_model_len:
+            self.metrics.on_reject()
+            raise AdmissionError(
+                f"{req.request_id}: prompt ({req.prompt_len}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"max_model_len ({self.max_model_len})")
+        try:
+            self.scheduler.enqueue(req)
+        except AdmissionError:
+            self.metrics.on_reject()
+            raise
+        self.metrics.on_submit(req.request_id)
+        return req
+
+    # ------------------------------------------------------------- step
+    def step(self) -> bool:
+        """One engine iteration: retire/admit at token granularity, then
+        one compiled decode step over the bucket.  Returns True while
+        there is work left (running or waiting)."""
+        self._admit()
+        if any(r is not None for r in self._slots):
+            self._decode_iteration()
+        return self.has_work()
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.waiting) or \
+            any(r is not None for r in self._slots)
+
+    def run_until_complete(self) -> Dict[str, Request]:
+        """Drain queue + bucket; returns {request_id: Request} of every
+        request finished during this drain."""
+        while self.step():
+            pass
+        done, self._finished = self._finished, {}
+        return done
+
+    def generate(self, prompts, **submit_kwargs) -> List[np.ndarray]:
+        """Batch convenience mirroring ``generate()``: submit every
+        prompt, drain, return outputs (prompt + generated) in order."""
+        reqs = [self.submit(p, **submit_kwargs) for p in prompts]
+        self.run_until_complete()
+        return [r.output_ids() for r in reqs]
+
+    # -------------------------------------------------------- admission
+    def _admit(self):
+        free_slots = [i for i, r in enumerate(self._slots) if r is None]
+        while free_slots:
+            req = self.scheduler.next_admittable()
+            if req is None:
+                break
+            self._prefill(req, free_slots.pop(0))
+
+    def _prefill(self, req: Request, slot: int):
+        bs = self.config.block_size
+        n = self.pool.blocks_for(req.prompt_len)
+        blocks = self.pool.allocate(req.request_id, n)
+        self.metrics.on_admit(req.request_id)
+        with _trace(f"serving::prefill:{req.request_id}"):
+            ids = np.zeros((1, n * bs), np.int32)
+            ids[0, :req.prompt_len] = req.prompt
+            z = jnp.zeros((1, n * bs, self.pool.kv_heads,
+                           self.pool.head_dim), self.pool.dtype)
+            caches = [(z, z) for _ in range(self.pool.num_layers)]
+            last, caches = self._prefill_step(
+                ids, caches, np.int32(req.prompt_len - 1))
+            self.pool.install_prefill(blocks, caches)
+        first_tok = int(np.argmax(np.asarray(last)[0]))
+        req.state = RUNNING
+        req.slot = slot
+        req.blocks = blocks
+        req.generated = [first_tok]
+        self.scheduler.running.append(req)
+        self.metrics.on_first_token(req.request_id)
+        self._slots[slot] = req
+        self._block_tables[slot] = 0
+        self._block_tables[slot, :n] = blocks
+        self._lengths[slot] = req.prompt_len
+        self._pending[slot] = first_tok
+        # the prefill's token may already terminate the request
+        self._maybe_retire(req)
+
+    # ---------------------------------------------------------- decode
+    def _ensure_blocks(self):
+        """Every live slot needs a block for its next write position;
+        allocate, preempting YOUNGEST-first when the pool is dry —
+        oldest first, so a starving old request evicts young ones, never
+        the reverse (a young request that cannot get a block preempts
+        ITSELF before touching older work)."""
+        for req in sorted(self.scheduler.running,
+                          key=lambda r: r.ordinal):
+            if req.slot is None:        # preempted earlier in this pass
+                continue
+            need = self.pool.blocks_for(int(self._lengths[req.slot]) + 1)
+            while len(req.blocks) < need:
+                try:
+                    new = self.pool.allocate(req.request_id, 1)
+                except PoolExhausted:
+                    victim = self.scheduler.pick_victim()
+                    if victim is None:
+                        # unreachable: enqueue() capacity check
+                        # guarantees a sole-running request always fits
+                        raise
+                    self._preempt(victim)
+                    if victim is req:
+                        break
+                    continue
+                self._block_tables[req.slot, len(req.blocks)] = new[0]
+                req.blocks.extend(new)
+
+    def _preempt(self, victim: Request):
+        """Evict-and-requeue (recompute mode): free everything, head of
+        the queue, original FCFS ordinal."""
+        slot = victim.slot
+        self.scheduler.running.remove(victim)
+        self.pool.free_request(victim.request_id)
+        victim.preemptions += 1
+        self.metrics.on_preempt(victim.request_id)
+        self._slots[slot] = None
+        self._block_tables[slot] = 0
+        self._lengths[slot] = 0
+        self._pending[slot] = 0
+        self.scheduler.requeue_preempted(victim)
+
+    def _decode_iteration(self):
+        self._ensure_blocks()
+        active = [r for r in self._slots if r is not None]
+        if not active:
+            return
+        with _trace("serving::decode_step"):
+            logits, new_pools = self._decode_step(
+                self._pending[:, None], self.pool.layers,
+                self._block_tables, self._lengths)
+            self.pool.layers = [(k, v) for k, v in new_pools]
+            logits = np.asarray(logits)
+        self.metrics.on_decode_iteration(
+            len(active), self.config.max_batch_size,
+            self.pool.utilization())
+        if self.config.strict_no_retrace:
+            # the H101-style cache-key check: the jit cache must not
+            # grow past THIS engine's warmup size (the step is cached on
+            # the model, so another engine config may own other entries)
+            size = self._decode_step._cache_size()
+            if not self._decode_warm:
+                self._warm_cache_size = size
+                self._decode_warm = True
+            elif size > self._warm_cache_size:
+                raise RuntimeError(
+                    f"decode step retraced after warmup (jit cache grew "
+                    f"{self._warm_cache_size}→{size}) — an engine input "
+                    "changed shape/dtype; on TPU this recompiles per "
+                    "token (H101)")
+        for req in active:
+            slot = req.slot
+            # the pending token was written at position lengths[slot]
+            self._lengths[slot] += 1
+            next_tok = int(np.argmax(logits[slot]))
+            req.generated.append(next_tok)
+            self._pending[slot] = next_tok
+            self._maybe_retire(req)
+
+    # ----------------------------------------------------------- retire
+    def _maybe_retire(self, req: Request):
+        reason = self.scheduler.finish_reason(req)
+        if reason is None:
+            return
+        slot = req.slot
+        req.state = FINISHED
+        req.finish_reason = reason
+        if req in self.scheduler.running:
+            self.scheduler.running.remove(req)
+        self.pool.free_request(req.request_id)
+        req.slot = None
+        self._slots[slot] = None
+        self._block_tables[slot] = 0
+        self._lengths[slot] = 0
+        self._pending[slot] = 0
+        self.metrics.on_finish(req.request_id, req.num_generated, reason)
+        self._finished[req.request_id] = req
+
+    # ------------------------------------------------------------ misc
+    def decode_cache_size(self) -> int:
+        """Entries in the compiled decode step's jit cache — 1 after
+        warmup, forever (the no-retrace contract)."""
+        return self._decode_step._cache_size()
+
+    def stats(self) -> dict:
+        d = self.metrics.as_dict()
+        d["pool"] = self.pool.stats()
+        d["queue_depth"] = len(self.scheduler.waiting)
+        return d

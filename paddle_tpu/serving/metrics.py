@@ -1,0 +1,176 @@
+"""Request-level observability for the serving engine.
+
+Per-request timings (TTFT, TPOT, queue time, tokens generated) plus
+engine-level counters and gauges (batch occupancy, cache utilization,
+preemptions), exportable three ways:
+
+- ``as_dict()`` — everything, JSON-ready (the metrics schema in
+  README "Serving");
+- live host ranges into an ACTIVE ``paddle_tpu.profiler`` session
+  (request lifecycle spans land in the same chrome trace as the
+  framework's host ranges and the XLA device lanes);
+- ``export_chrome(path)`` — standalone chrome://tracing JSON of the
+  recorded request spans when no profiler session was running.
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def _now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+@dataclass
+class RequestTimeline:
+    """Wall-clock milestones of one request (perf_counter_ns)."""
+
+    submitted_ns: int = 0
+    admitted_ns: int = 0          # last admission (re-set on re-admit)
+    first_token_ns: int = 0
+    finished_ns: int = 0
+    tokens_generated: int = 0
+    preemptions: int = 0
+    finish_reason: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        ttft = (self.first_token_ns - self.submitted_ns) / 1e9 \
+            if self.first_token_ns else None
+        queue_time = (self.admitted_ns - self.submitted_ns) / 1e9 \
+            if self.admitted_ns else None
+        # time-per-output-token over the decode phase (tokens after the
+        # first, which prefill produced)
+        tpot = None
+        if self.finished_ns and self.tokens_generated > 1:
+            tpot = ((self.finished_ns - self.first_token_ns) / 1e9
+                    / (self.tokens_generated - 1))
+        return {
+            "ttft_s": ttft,
+            "tpot_s": tpot,
+            "queue_time_s": queue_time,
+            "e2e_s": ((self.finished_ns - self.submitted_ns) / 1e9
+                      if self.finished_ns else None),
+            "tokens_generated": self.tokens_generated,
+            "preemptions": self.preemptions,
+            "finish_reason": self.finish_reason,
+        }
+
+
+class ServingMetrics:
+    def __init__(self):
+        # counters
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.preempted = 0          # preemption EVENTS (re-admits recount)
+        self.tokens_generated = 0
+        self.decode_iterations = 0
+        self.prefills = 0
+        # gauge accumulators (sampled once per decode iteration)
+        self._occupancy_sum = 0.0
+        self._cache_util_sum = 0.0
+        self._gauge_samples = 0
+        self.last_batch_occupancy = 0.0
+        self.last_cache_utilization = 0.0
+        # per-request
+        self.requests: Dict[str, RequestTimeline] = {}
+        # chrome spans: (name, start_ns, end_ns, category)
+        self._spans: List[tuple] = []
+
+    # ------------------------------------------------------- lifecycle
+    def on_submit(self, request_id: str):
+        self.submitted += 1
+        self.requests[request_id] = RequestTimeline(submitted_ns=_now_ns())
+
+    def on_reject(self):
+        self.rejected += 1
+
+    def on_admit(self, request_id: str):
+        t = self.requests[request_id]
+        was = t.admitted_ns
+        t.admitted_ns = _now_ns()
+        self.prefills += 1
+        if was == 0:
+            self._span(f"queued:{request_id}", t.submitted_ns,
+                       t.admitted_ns)
+
+    def on_first_token(self, request_id: str):
+        t = self.requests[request_id]
+        if t.first_token_ns == 0:
+            t.first_token_ns = _now_ns()
+
+    def on_preempt(self, request_id: str):
+        self.preempted += 1
+        self.requests[request_id].preemptions += 1
+
+    def on_finish(self, request_id: str, tokens: int, reason: str):
+        self.completed += 1
+        self.tokens_generated += tokens
+        t = self.requests[request_id]
+        t.finished_ns = _now_ns()
+        t.tokens_generated = tokens
+        t.finish_reason = reason
+        self._span(f"decode:{request_id}", t.first_token_ns, t.finished_ns)
+
+    def on_decode_iteration(self, active: int, batch_size: int,
+                            cache_utilization: float):
+        self.decode_iterations += 1
+        occ = active / batch_size if batch_size else 0.0
+        self.last_batch_occupancy = occ
+        self.last_cache_utilization = cache_utilization
+        self._occupancy_sum += occ
+        self._cache_util_sum += cache_utilization
+        self._gauge_samples += 1
+
+    # --------------------------------------------------------- export
+    def _span(self, name: str, start_ns: int, end_ns: int,
+              category: str = "serving"):
+        if not start_ns or end_ns < start_ns:
+            return
+        self._spans.append((name, start_ns, end_ns, category))
+        # mirror into a live profiler session, if one is recording —
+        # request spans then interleave with the framework's host
+        # ranges and XLA device lanes in ONE chrome trace
+        from .. import profiler
+
+        if profiler.current_profiler() is not None:
+            profiler.record_host_range(name, start_ns, end_ns,
+                                       category=category)
+
+    def as_dict(self) -> dict:
+        n = max(self._gauge_samples, 1)
+        return {
+            "counters": {
+                "requests_submitted": self.submitted,
+                "requests_rejected": self.rejected,
+                "requests_completed": self.completed,
+                "preemptions": self.preempted,
+                "tokens_generated": self.tokens_generated,
+                "decode_iterations": self.decode_iterations,
+                "prefills": self.prefills,
+            },
+            "gauges": {
+                "batch_occupancy": self.last_batch_occupancy,
+                "batch_occupancy_avg": round(self._occupancy_sum / n, 4),
+                "cache_utilization": self.last_cache_utilization,
+                "cache_utilization_avg": round(
+                    self._cache_util_sum / n, 4),
+            },
+            "requests": {rid: t.to_dict()
+                         for rid, t in self.requests.items()},
+        }
+
+    def export_chrome(self, path: str) -> str:
+        """Standalone chrome://tracing JSON of the request spans (use a
+        live ``paddle_tpu.profiler.Profiler`` session instead to merge
+        them with host/device lanes)."""
+        events = [{"name": name, "cat": cat, "ph": "X",
+                   "ts": start / 1000.0, "dur": (end - start) / 1000.0,
+                   "pid": 0, "tid": 0}
+                  for name, start, end, cat in self._spans]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path

@@ -1,0 +1,172 @@
+"""Request lifecycle + scheduling policy (PAPERS.md: Orca's
+iteration-level scheduling).
+
+Policy, in one paragraph: admission is FCFS by arrival ordinal over a
+BOUNDED wait queue (a full queue rejects at submit time — backpressure
+instead of unbounded latency).  A request is admitted only when the
+block pool can hold its prompt plus one decode block (capacity-based
+admission control).  When a running sequence needs a block and the pool
+is dry, the YOUNGEST running request is preempted — evict-and-requeue
+at the queue head, keeping its original ordinal — so the oldest work
+always finishes first and no request starves (the fairness half of
+"FCFS + fairness").  Preemption drops the victim's generated tokens and
+recomputes from the prompt on re-admission (vLLM's "recompute" mode);
+under greedy decoding the final output is unchanged.
+
+Termination is the SAME check ``generate()`` uses:
+``models.generation.match_stop`` over the generated suffix, plus
+eos_token_id and max_new_tokens.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from ..models.generation import match_stop, normalize_stop_sequences
+
+
+class AdmissionError(Exception):
+    """Request rejected at submit time (backpressure or impossible fit)."""
+
+
+# request states
+QUEUED = "queued"
+RUNNING = "running"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+
+_ordinal = itertools.count()
+
+
+@dataclass(eq=False)
+class Request:
+    """One generation request and its runtime state.  Identity equality
+    (``eq=False``): requests are mutable runtime objects living in
+    scheduler lists — field comparison over numpy prompts is both
+    ambiguous and wrong."""
+
+    prompt: np.ndarray                      # 1-D int32 token ids
+    max_new_tokens: int = 32
+    eos_token_id: Optional[int] = None
+    stop_sequences: List[List[int]] = field(default_factory=list)
+    request_id: str = ""
+    # runtime (engine-owned)
+    ordinal: int = field(default_factory=lambda: next(_ordinal))
+    state: str = QUEUED
+    slot: Optional[int] = None
+    blocks: List[int] = field(default_factory=list)
+    generated: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None     # "eos" | "stop" | "length"
+    preemptions: int = 0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if not self.request_id:
+            self.request_id = f"req-{self.ordinal}"
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.size)
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.generated)
+
+    @property
+    def total_len(self) -> int:
+        """Current cache frontier: prompt + tokens already written."""
+        return self.prompt_len + self.num_generated
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens (terminator included)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class Scheduler:
+    """FCFS + fairness policy over a bounded wait queue (module
+    docstring).  The scheduler DECIDES (admit / victim / finished); the
+    engine executes (prefill, decode, block moves)."""
+
+    def __init__(self, pool, max_queue_len: int = 64):
+        self.pool = pool
+        self.max_queue_len = max_queue_len
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []
+
+    # -------------------------------------------------------- admission
+    def enqueue(self, req: Request):
+        """Accept into the wait queue, or raise AdmissionError.  A
+        request whose full sequence can never fit the pool is rejected
+        outright — queuing it would deadlock the head of the queue."""
+        total = self.pool.blocks_for(req.prompt_len + req.max_new_tokens)
+        if total > self.pool.capacity_blocks:
+            raise AdmissionError(
+                f"{req.request_id}: needs {total} blocks at full length, "
+                f"pool capacity is {self.pool.capacity_blocks}")
+        if len(self.waiting) >= self.max_queue_len:
+            raise AdmissionError(
+                f"wait queue full ({self.max_queue_len}); retry later")
+        self.waiting.append(req)
+
+    def requeue_preempted(self, req: Request):
+        """Victim goes to the HEAD of the queue with its original
+        ordinal: it is the next admitted, so preemption never reorders
+        completion past FCFS."""
+        req.state = PREEMPTED
+        req.slot = None
+        req.blocks = []
+        req.generated = []
+        self.waiting.appendleft(req)
+
+    def next_admittable(self) -> Optional[Request]:
+        """Head of the queue if the pool can hold its prompt + one
+        decode block right now; None otherwise (strict FCFS: a blocked
+        head blocks the tail, so completions stay in arrival order)."""
+        if not self.waiting:
+            return None
+        head = self.waiting[0]
+        # prompt blocks + room for the first generated token's write
+        # position (a new block only when the prompt fills its last one)
+        need = self.pool.blocks_for(head.prompt_len + 1)
+        if not self.pool.can_allocate(need):
+            return None
+        return self.waiting.popleft()
+
+    # ------------------------------------------------------- preemption
+    def pick_victim(self) -> Optional[Request]:
+        """Youngest running request — the least completed work lost, and
+        the last in FCFS order anyway.  The requester itself may be the
+        victim (it self-preempts rather than evicting older work)."""
+        if not self.running:
+            return None
+        return max(self.running, key=lambda r: r.ordinal)
+
+    # ------------------------------------------------------ termination
+    @staticmethod
+    def finish_reason(req: Request) -> Optional[str]:
+        """Termination check over the request's generated tokens —
+        shared semantics with ``generate()`` (same match_stop)."""
+        if not req.generated:
+            return None
+        if req.eos_token_id is not None \
+                and req.generated[-1] == req.eos_token_id:
+            return "eos"
+        if req.stop_sequences and match_stop(req.generated,
+                                             req.stop_sequences):
+            return "stop"
+        if req.num_generated >= req.max_new_tokens:
+            return "length"
+        return None
+
+
+__all__ = ["AdmissionError", "Request", "Scheduler", "QUEUED", "RUNNING",
+           "PREEMPTED", "FINISHED", "normalize_stop_sequences"]

@@ -302,3 +302,54 @@ class TestAstLint:
 
         assert param_names("add") == ["x", "y", "name"]
         assert param_names("einsum") == ["equation", "*operands"]
+
+
+class TestDecodeStepHazards:
+    """H106: host work inside registered serving decode steps (the
+    per-token hot loop paddle_tpu.serving drives)."""
+
+    def test_host_sync_and_branching_flagged(self):
+        from paddle_tpu.models.generation import register_decode_step
+
+        @register_decode_step
+        def bad_step(tok, caches, offset):
+            if int(offset) > 0:          # python branch in the hot loop
+                v = tok.item()           # host sync per generated token
+                return v
+            return tok
+
+        diags = analysis.scan_decode_step(bad_step)
+        sev = {(d.code, d.severity) for d in diags}
+        assert ("H106", "error") in sev      # .item()
+        assert ("H106", "warning") in sev    # if-branch
+
+    def test_builtin_steps_are_clean(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.generation import (make_decode_step,
+                                                  make_paged_decode_step,
+                                                  make_prefill_step)
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        for make in (make_decode_step, make_prefill_step,
+                     make_paged_decode_step):
+            assert analysis.scan_decode_step(make(model)) == []
+
+    def test_registry_scan_aggregates_and_prunes(self):
+        from paddle_tpu.models.generation import (register_decode_step,
+                                                  registered_decode_steps)
+
+        @register_decode_step
+        def leaky_step(tok):
+            return tok.numpy()
+
+        assert any(f is leaky_step for f in registered_decode_steps())
+        diags = analysis.scan_decode_steps()
+        assert any(d.code == "H106" and d.severity == "error"
+                   and "leaky_step" in d.message for d in diags)
+        del leaky_step  # weak registry: dead steps are pruned
+        import gc
+
+        gc.collect()
+        assert all(getattr(f, "__name__", "") != "leaky_step"
+                   for f in registered_decode_steps())

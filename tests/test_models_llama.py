@@ -285,3 +285,103 @@ class TestGeneration:
                                 np.int32(t))
         assert step._cache_size() == 1
         assert last.shape == (2, model.config.vocab_size)
+
+
+class TestTermination:
+    """EOS + stop-sequence termination in generate() (shared with the
+    serving scheduler via models.generation.match_stop)."""
+
+    def _model(self):
+        paddle.seed(0)
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        return LlamaForCausalLM(LlamaConfig.tiny())
+
+    def test_mixed_length_eos_pads_and_exits_early(self):
+        """Regression: a batch where rows hit eos at DIFFERENT steps
+        must pad each finished row with eos while the others keep
+        decoding — and exit the loop the moment all rows are done
+        instead of paying max_new_tokens of compute."""
+        model = self._model()
+        ids = paddle.to_tensor(np.random.RandomState(4).randint(
+            0, 100, (2, 4)).astype(np.int32))
+        ref = model.generate(ids, max_new_tokens=10,
+                             temperature=0.0).numpy()
+        # eos = row0's 2nd generated token; row1 continues past it
+        eos = int(ref[0, 5])
+        assert eos not in ref[1, 4:6], "seed picked a degenerate stream"
+        out = model.generate(ids, max_new_tokens=10, temperature=0.0,
+                             eos_token_id=eos).numpy()
+        # row0: matches the reference through its eos, eos-padded after
+        np.testing.assert_array_equal(out[0, :6], ref[0, :6])
+        assert (out[0, 6:] == eos).all()
+        # row1: termination of row0 must not perturb its stream
+        np.testing.assert_array_equal(out[1, :out.shape[1]],
+                                      ref[1, :out.shape[1]])
+        if eos not in ref[1, 4:]:
+            # row1 never finishes -> the loop ran to max_new_tokens
+            assert out.shape[1] == 4 + 10
+
+    def test_eos_early_exit_shortens_output(self):
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[7, 8, 9]], np.int32))
+        ref = model.generate(ids, max_new_tokens=8,
+                             temperature=0.0).numpy()
+        gen = ref[0, 3:]
+        # a later token value != the first, so eos fires mid-stream
+        eos = next(int(t) for t in gen[1:] if t != gen[0])
+        k = int(np.where(gen == eos)[0][0])  # first occurrence
+        assert 0 < k < 7, "seed picked a degenerate stream"
+        out = model.generate(ids, max_new_tokens=8, temperature=0.0,
+                             eos_token_id=eos).numpy()
+        assert out.shape[1] == 3 + k + 1  # exited early at the eos
+        np.testing.assert_array_equal(out[0], ref[0, :3 + k + 1])
+
+    def test_stop_sequence_token_ids(self):
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[5, 6, 7, 8]], np.int32))
+        ref = model.generate(ids, max_new_tokens=8,
+                             temperature=0.0).numpy()
+        stop = [int(ref[0, 5]), int(ref[0, 6])]  # generated bigram
+        out = model.generate(ids, max_new_tokens=8, temperature=0.0,
+                             stop_sequences=[stop]).numpy()
+        assert out.shape[1] == 7  # stopped right after the bigram
+        np.testing.assert_array_equal(out[0], ref[0, :7])
+
+    def test_stop_sequence_string_with_tokenizer(self):
+        class Tok:
+            def encode(self, s):
+                return [ord(c) % 256 for c in s]
+
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[5, 6, 7, 8]], np.int32))
+        ref = model.generate(ids, max_new_tokens=6,
+                             temperature=0.0).numpy()
+        text = chr(int(ref[0, 5]))  # 1st generated token as a "string"
+        out = model.generate(ids, max_new_tokens=6, temperature=0.0,
+                             stop_sequences=text, tokenizer=Tok()).numpy()
+        assert out.shape[1] == 6
+        np.testing.assert_array_equal(out[0], ref[0, :6])
+
+    def test_stop_sequences_rejected_with_beam_search(self):
+        model = self._model()
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+        with pytest.raises(ValueError, match="beam"):
+            model.generate(ids, max_new_tokens=3, num_beams=2,
+                           do_sample=False, stop_sequences=[[1]])
+
+    def test_normalize_and_match_stop_helpers(self):
+        from paddle_tpu.models.generation import (match_stop,
+                                                  normalize_stop_sequences)
+
+        assert normalize_stop_sequences(None) == []
+        assert normalize_stop_sequences(7) == [[7]]
+        assert normalize_stop_sequences([1, 2]) == [[1, 2]]
+        assert normalize_stop_sequences([[1, 2], 3]) == [[1, 2], [3]]
+        with pytest.raises(ValueError, match="tokenizer"):
+            normalize_stop_sequences("stop")
+        with pytest.raises(ValueError, match="empty"):
+            normalize_stop_sequences([[]])
+        assert match_stop([4, 1, 2], [[1, 2]])
+        assert not match_stop([1, 2, 4], [[1, 2]])
+        assert not match_stop([2], [[1, 2]])

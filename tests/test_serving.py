@@ -1,0 +1,341 @@
+"""paddle_tpu.serving — continuous-batching engine, block pool,
+scheduler, metrics, endpoint.
+
+The ISSUE 2 done bar lives here: greedy engine outputs are TOKEN-EXACT
+with sequential ``generate()`` (including across preemption), the
+compiled decode step never retraces after warmup, and the block pool
+round-trips every block through a full workload.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (FINISHED, QUEUED, AdmissionError,
+                                BlockKVPool, Engine, PoolExhausted,
+                                Request, ServingConfig)
+
+
+# One model for the whole module: every compiled step (prefill per
+# bucket, decode per engine config) is cached on it by weights
+# fingerprint, so tests share executables instead of recompiling.
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompts(lengths, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(L,)).astype(np.int32)
+            for L in lengths]
+
+
+def _reference(model, prompt, **kw):
+    """Sequential greedy generate() — the parity oracle."""
+    out = model.generate(paddle.to_tensor(prompt[None, :]),
+                         temperature=0.0, use_static_cache=True, **kw)
+    return np.asarray(out.numpy())[0]
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_queue_len", 16)
+    return ServingConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# BlockKVPool
+# ---------------------------------------------------------------------------
+
+class TestBlockKVPool:
+    def _pool(self, num_blocks=8, block_size=4):
+        return BlockKVPool(num_layers=2, num_blocks=num_blocks,
+                           block_size=block_size, kv_heads=2, head_dim=4)
+
+    def test_block0_reserved(self):
+        pool = self._pool()
+        got = pool.allocate("r", pool.capacity_blocks)
+        assert 0 not in got
+        assert pool.num_free == 0
+
+    def test_allocate_free_roundtrip(self):
+        pool = self._pool()
+        a = pool.allocate("a", 3)
+        b = pool.allocate("b", 2)
+        assert pool.num_used == 5
+        assert sorted(pool.owned_by("a")) == sorted(a)
+        pool.free_request("a")
+        pool.free(b)
+        assert pool.num_free == pool.capacity_blocks
+        pool.check_leaks()
+
+    def test_double_free_raises(self):
+        pool = self._pool()
+        blocks = pool.allocate("a", 1)
+        pool.free(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            pool.free(blocks)
+
+    def test_exhaustion_raises_and_keeps_state(self):
+        pool = self._pool(num_blocks=4)
+        pool.allocate("a", 2)
+        with pytest.raises(PoolExhausted):
+            pool.allocate("b", 2)
+        assert pool.num_free == 1  # failed allocation took nothing
+
+    def test_blocks_for_ceil_division(self):
+        pool = self._pool(block_size=4)
+        assert [pool.blocks_for(n) for n in (1, 4, 5, 8, 9)] == \
+            [1, 1, 2, 2, 3]
+
+    def test_check_leaks_reports_owner(self):
+        pool = self._pool()
+        pool.allocate("leaky", 1)
+        with pytest.raises(AssertionError, match="leaky"):
+            pool.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# Engine: the parity + no-retrace done bar
+# ---------------------------------------------------------------------------
+
+class TestEngineParity:
+    def test_greedy_parity_mixed_lengths(self, model):
+        """Continuous-batched greedy == sequential generate(), token for
+        token, across prompt lengths that pad to different buckets."""
+        prompts = _prompts([3, 7, 5, 11, 4, 6])
+        refs = [_reference(model, p, max_new_tokens=8) for p in prompts]
+        eng = Engine(model, _config())
+        outs = eng.generate(prompts, max_new_tokens=8)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+
+    def test_never_retraces_after_warmup(self, model):
+        """The compiled decode step holds ONE jit cache entry no matter
+        how requests churn through the bucket (the H101 property the
+        engine asserts every iteration under strict_no_retrace)."""
+        eng = Engine(model, _config())
+        eng.generate(_prompts([3, 5]), max_new_tokens=4)
+        warm = eng.decode_cache_size()
+        eng.generate(_prompts([9, 2, 7], seed=3), max_new_tokens=6)
+        assert eng.decode_cache_size() == warm
+
+    def test_no_block_leaks_after_workload(self, model):
+        eng = Engine(model, _config())
+        eng.generate(_prompts([3, 7, 5, 11, 4]), max_new_tokens=6)
+        eng.pool.check_leaks()
+        assert eng.pool.num_free == eng.pool.capacity_blocks
+
+    def test_eos_terminates_request(self, model):
+        p = _prompts([5])[0]
+        ref = _reference(model, p, max_new_tokens=8)
+        eos = int(ref[5 + 2])  # third generated token
+        ref_eos = _reference(model, p, max_new_tokens=8, eos_token_id=eos)
+        eng = Engine(model, _config())
+        req = eng.submit(p, max_new_tokens=8, eos_token_id=eos)
+        eng.run_until_complete()
+        assert req.finish_reason == "eos"
+        np.testing.assert_array_equal(req.output_ids(), ref_eos)
+
+    def test_stop_sequence_terminates_request(self, model):
+        p = _prompts([4])[0]
+        ref = _reference(model, p, max_new_tokens=8)
+        stop = [int(ref[4 + 1]), int(ref[4 + 2])]  # generated bigram
+        ref_stop = _reference(model, p, max_new_tokens=8,
+                              stop_sequences=[stop])
+        eng = Engine(model, _config())
+        req = eng.submit(p, max_new_tokens=8, stop_sequences=[stop])
+        eng.run_until_complete()
+        assert req.finish_reason == "stop"
+        assert req.generated[-2:] == stop
+        np.testing.assert_array_equal(req.output_ids(), ref_stop)
+
+    def test_single_token_request_finishes_at_prefill(self, model):
+        eng = Engine(model, _config())
+        [out] = eng.generate(_prompts([5]), max_new_tokens=1)
+        ref = _reference(model, _prompts([5])[0], max_new_tokens=1)
+        np.testing.assert_array_equal(out, ref)
+        assert eng.stats()["counters"]["decode_iterations"] == 0
+
+
+class TestAdmissionControl:
+    def test_bounded_queue_rejects(self, model):
+        eng = Engine(model, _config(max_queue_len=2))
+        for _ in range(2):
+            eng.submit(_prompts([3])[0], max_new_tokens=2)
+        with pytest.raises(AdmissionError, match="queue full"):
+            eng.submit(_prompts([3])[0], max_new_tokens=2)
+        assert eng.stats()["counters"]["requests_rejected"] == 1
+        eng.run_until_complete()
+
+    def test_impossible_fit_rejected_outright(self, model):
+        # capacity 3 blocks * 4 tokens = 12; this request needs 16
+        eng = Engine(model, _config(num_blocks=4))
+        with pytest.raises(AdmissionError, match="capacity"):
+            eng.submit(_prompts([8])[0], max_new_tokens=8)
+
+    def test_max_model_len_enforced(self, model):
+        eng = Engine(model, _config())
+        with pytest.raises(AdmissionError, match="max_model_len"):
+            eng.submit(_prompts([4])[0],
+                       max_new_tokens=eng.max_model_len)
+
+    def test_sampling_rejected_greedy_accepted(self, model):
+        # generate() call-site parity: temperature=0.0 (greedy) is fine,
+        # a sampling request fails loudly instead of decoding differently
+        eng = Engine(model, _config())
+        eng.submit(_prompts([3])[0], max_new_tokens=2, temperature=0.0)
+        with pytest.raises(ValueError, match="greedily"):
+            eng.submit(_prompts([3])[0], max_new_tokens=2,
+                       temperature=0.7)
+        with pytest.raises(ValueError, match="greedily"):
+            eng.submit(_prompts([3])[0], max_new_tokens=2,
+                       do_sample=True)
+        eng.run_until_complete()
+
+    def test_fcfs_completion_order(self, model):
+        """One slot: requests retire strictly in arrival order."""
+        eng = Engine(model, _config(max_batch_size=1))
+        reqs = [eng.submit(p, max_new_tokens=3)
+                for p in _prompts([3, 5, 4])]
+        done = eng.run_until_complete()
+        assert list(done) == [r.request_id for r in reqs]
+
+
+class TestPreemption:
+    def test_preempt_requeue_roundtrip_keeps_parity(self, model):
+        """Pool sized so two admitted requests cannot BOTH reach full
+        length: the younger is evicted mid-decode, requeued, recomputed
+        — and still produces token-exact greedy output."""
+        prompts = _prompts([4, 4], seed=7)
+        refs = [_reference(model, p, max_new_tokens=10) for p in prompts]
+        # capacity 5 blocks * 4 = 20 token-positions; each request needs
+        # ceil((4+10)/4)=4 blocks at full length but only 2 to admit, so
+        # both admit and later collide on the 5th block.
+        eng = Engine(model, _config(max_batch_size=2, num_blocks=6))
+        reqs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        eng.run_until_complete()
+        for req, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(req.output_ids(), ref)
+        st = eng.stats()
+        assert st["counters"]["preemptions"] >= 1
+        # FCFS fairness: the YOUNGER request is the victim
+        assert reqs[1].preemptions >= 1 and reqs[0].preemptions == 0
+        assert st["requests"][reqs[1].request_id]["preemptions"] >= 1
+        eng.pool.check_leaks()
+
+    def test_victim_is_youngest_and_head_of_queue(self, model):
+        from paddle_tpu.serving.scheduler import Scheduler
+
+        pool = BlockKVPool(2, 8, 4, 2, 4)
+        sched = Scheduler(pool)
+        a = Request(prompt=np.ones(4, np.int32), max_new_tokens=2)
+        b = Request(prompt=np.ones(4, np.int32), max_new_tokens=2)
+        sched.running = [a, b]
+        assert sched.pick_victim() is b
+        b.generated = [1, 2]
+        sched.requeue_preempted(b)
+        assert sched.waiting[0] is b
+        assert b.generated == [] and b.blocks == []
+        # re-admission keeps the original FCFS ordinal
+        assert b.ordinal > a.ordinal
+
+
+class TestMetrics:
+    def test_request_timings_and_counters(self, model):
+        eng = Engine(model, _config())
+        reqs = [eng.submit(p, max_new_tokens=4) for p in _prompts([3, 6])]
+        eng.run_until_complete()
+        st = eng.stats()
+        c = st["counters"]
+        assert c["requests_submitted"] == 2
+        assert c["requests_completed"] == 2
+        assert c["prefills"] == 2
+        assert c["tokens_generated"] == sum(r.num_generated for r in reqs)
+        assert c["decode_iterations"] >= 3
+        for req in reqs:
+            t = st["requests"][req.request_id]
+            assert t["ttft_s"] is not None and t["ttft_s"] >= 0
+            assert t["tpot_s"] is not None and t["tpot_s"] >= 0
+            assert t["queue_time_s"] >= 0
+            assert t["e2e_s"] >= t["ttft_s"]
+            assert t["tokens_generated"] == 4
+            assert t["finish_reason"] == "length"
+        g = st["gauges"]
+        assert 0 < g["batch_occupancy_avg"] <= 1
+        assert 0 <= g["cache_utilization_avg"] <= 1
+
+    def test_chrome_export(self, model, tmp_path):
+        import json
+
+        eng = Engine(model, _config())
+        eng.generate(_prompts([3]), max_new_tokens=3)
+        path = eng.metrics.export_chrome(str(tmp_path / "trace.json"))
+        events = json.load(open(path))["traceEvents"]
+        names = {e["name"] for e in events}
+        assert any(n.startswith("decode:") for n in names)
+        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+
+
+class TestEndpoint:
+    def test_predictor_parity_handles(self, model):
+        from paddle_tpu.inference import create_serving_endpoint
+
+        ep = create_serving_endpoint(model, _config(), max_new_tokens=4)
+        assert ep.get_input_names() == ["input_0"]
+        prompts = np.stack(_prompts([5, 5]))
+        ep.get_input_handle("input_0").copy_from_cpu(prompts)
+        outs = ep.run()
+        rect = ep.get_output_handle("output_0").copy_to_cpu()
+        assert rect.shape == (2, 9)
+        for i, p in enumerate(prompts):
+            ref = _reference(model, p, max_new_tokens=4)
+            np.testing.assert_array_equal(outs[i], ref)
+            np.testing.assert_array_equal(rect[i], ref)
+
+    def test_streaming_submit_poll_result(self, model):
+        from paddle_tpu.serving import Endpoint
+
+        ep = Endpoint(model, _config(), max_new_tokens=3)
+        req = ep.submit(_prompts([4])[0])
+        assert ep.result(req) is None and req.state == QUEUED
+        while ep.poll():
+            pass
+        assert req.state == FINISHED
+        ref = _reference(model, _prompts([4])[0], max_new_tokens=3)
+        np.testing.assert_array_equal(ep.result(req), ref)
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching win (slow: wall-clock-free, but extra decodes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestThroughput:
+    def test_staggered_workload_fewer_decode_iterations(self, model):
+        """8 staggered requests: the engine interleaves them in one
+        bucket, so TOTAL decode iterations stay well under the
+        sequential sum — the continuous-batching claim, measured in
+        iterations (deterministic) instead of wall clock (flaky)."""
+        prompts = _prompts([3, 5, 4, 6, 3, 7, 5, 4], seed=11)
+        max_new = 8
+        eng = Engine(model, _config(max_batch_size=8, num_blocks=128))
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(eng.submit(p, max_new_tokens=max_new))
+            eng.step()   # requests arrive WHILE others are decoding
+        eng.run_until_complete()
+        refs = [_reference(model, p, max_new_tokens=max_new)
+                for p in prompts]
+        for req, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(req.output_ids(), ref)
+        engine_iters = eng.stats()["counters"]["decode_iterations"]
+        # sequential: each request alone pays max_new - 1 decode steps
+        sequential_iters = len(prompts) * (max_new - 1)
+        assert engine_iters < sequential_iters, \
+            (engine_iters, sequential_iters)
