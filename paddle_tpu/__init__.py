@@ -98,6 +98,7 @@ from .nn import utils as _nn_utils  # noqa: F401
 from .models import bert as _bert_models  # noqa: F401
 from . import models  # noqa: F401
 from . import serving  # noqa: F401
+from . import resilience  # noqa: F401
 
 # paddle.linalg namespace is the ops.linalg module re-exported; register
 # it in sys.modules so `import paddle_tpu.linalg` works like the reference

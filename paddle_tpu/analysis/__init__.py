@@ -23,8 +23,9 @@ from typing import Any, List, Optional, Sequence
 
 from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
-from .hazards import (scan, scan_decode_step, scan_decode_steps,
-                      scan_function, scan_program, scan_static_function)
+from .hazards import (scan, scan_checkpoint_writes, scan_decode_step,
+                      scan_decode_steps, scan_function, scan_program,
+                      scan_static_function)
 from . import astlint
 
 __all__ = [
@@ -37,6 +38,7 @@ __all__ = [
     "scan_static_function",
     "scan_decode_step",
     "scan_decode_steps",
+    "scan_checkpoint_writes",
     "set_pass_verification",
     "pass_verification",
     "verify_after_pass",

@@ -53,6 +53,7 @@ __all__ = [
     "scan_static_function",
     "scan_decode_step",
     "scan_decode_steps",
+    "scan_checkpoint_writes",
     "scan",
 ]
 
@@ -329,6 +330,134 @@ def scan_decode_steps() -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     for fn in registered_decode_steps():
         diags.extend(scan_decode_step(fn))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-write scans (resilience)
+# ---------------------------------------------------------------------------
+
+_CKPT_PATH_HINTS = ("ckpt", "checkpoint")
+# modules allowed to write checkpoint bytes directly: the atomic
+# writers themselves
+_CKPT_SANCTIONED = ("resilience/checkpoint.py", "distributed/checkpoint.py",
+                    "framework/io.py")
+
+
+def _mentions_checkpoint(node) -> bool:
+    """Any identifier/attribute/string inside the expression smells like
+    a checkpoint path (``ckpt``/``checkpoint`` substring)."""
+    for n in ast.walk(node):
+        text = None
+        if isinstance(n, ast.Name):
+            text = n.id
+        elif isinstance(n, ast.Attribute):
+            text = n.attr
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            text = n.value
+        if text is not None and any(h in text.lower()
+                                    for h in _CKPT_PATH_HINTS):
+            return True
+    return False
+
+
+class _CheckpointWriteScanner(ast.NodeVisitor):
+    """H107: checkpoint bytes written OUTSIDE the atomic writer.  A
+    direct ``np.save``/``open(..., 'wb')`` on a checkpoint path commits
+    non-atomically and unverified — a crash mid-write destroys the only
+    copy (the exact defect ``resilience.ResilientCheckpointer`` and the
+    ``distributed.checkpoint`` temp+rename fallback exist to prevent)."""
+
+    def __init__(self, filename: str, firstline: int = 1):
+        self.filename = filename
+        self.firstline = firstline
+        self.diags: List[Diagnostic] = []
+
+    def _where(self, node) -> str:
+        return f"{self.filename}:{self.firstline + node.lineno - 1}"
+
+    def add(self, severity, message, node):
+        self.diags.append(
+            Diagnostic("H107", severity, message, self._where(node)))
+
+    def visit_Call(self, node):
+        fn = node.func
+        # np.save / np.savez / np.savez_compressed(ckpt_path, ...)
+        if isinstance(fn, ast.Attribute) \
+                and fn.attr in ("save", "savez", "savez_compressed") \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("np", "numpy") \
+                and node.args and _mentions_checkpoint(node.args[0]):
+            self.add(
+                ERROR,
+                f"{fn.value.id}.{fn.attr}(...) writes a checkpoint path "
+                "directly — non-atomic, no integrity manifest; a crash "
+                "mid-write destroys the only copy.  Route through "
+                "resilience.ResilientCheckpointer (or temp file + "
+                "os.replace at minimum)", node)
+        # open(ckpt_path, "wb"/"w")
+        elif isinstance(fn, ast.Name) and fn.id == "open" and node.args:
+            mode = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if "w" in mode and _mentions_checkpoint(node.args[0]):
+                self.add(
+                    ERROR,
+                    f"open(..., {mode!r}) on a checkpoint path bypasses "
+                    "the atomic writer — the write is torn by any crash "
+                    "and never checksummed.  Route through "
+                    "resilience.ResilientCheckpointer (or temp file + "
+                    "os.replace at minimum)", node)
+        # <anything>.save(obj, ckpt_path) / save(obj, ckpt_path) —
+        # pickle-style direct save onto a checkpoint path
+        elif ((isinstance(fn, ast.Attribute) and fn.attr == "save")
+              or (isinstance(fn, ast.Name) and fn.id in ("save", "fsave"))) \
+                and len(node.args) >= 2 \
+                and _mentions_checkpoint(node.args[1]):
+            name = fn.attr if isinstance(fn, ast.Attribute) else fn.id
+            self.add(
+                WARNING,
+                f"{name}(..., <checkpoint path>) commits without temp-"
+                "file+rename or a checksum manifest; prefer "
+                "resilience.ResilientCheckpointer so a torn save cannot "
+                "shadow the last good checkpoint", node)
+        self.generic_visit(node)
+
+
+def scan_checkpoint_writes(paths, exclude=_CKPT_SANCTIONED
+                           ) -> List[Diagnostic]:
+    """H107-audit python sources for checkpoint writes that bypass the
+    atomic writer.  ``paths`` is a file, a directory (walked for
+    ``.py``), or a list of either; ``exclude`` suffixes name the
+    sanctioned writer modules themselves."""
+    import os
+
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in sorted(files):
+        norm = f.replace("\\", "/")
+        if any(norm.endswith(sfx) for sfx in exclude):
+            continue
+        try:
+            with open(f, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            continue
+        scanner = _CheckpointWriteScanner(f)
+        scanner.visit(tree)
+        diags.extend(scanner.diags)
     return diags
 
 

@@ -31,7 +31,13 @@ def _to_arrays(state_dict: Dict[str, Any]):
 
 
 def save_state_dict(state_dict, path, async_save=False):
-    """Sharded save via orbax; falls back to pickle when orbax is absent."""
+    """Sharded save via orbax; falls back to pickle when orbax is absent.
+
+    The fallback commits ATOMICALLY (temp file + ``os.replace``): a
+    crash mid-save must never destroy the previous checkpoint at
+    ``path`` — the torn-save half of the resilience fault model
+    (README "Resilience"; orbax gets the same property from its own
+    commit-marker protocol)."""
     try:
         import orbax.checkpoint as ocp
 
@@ -43,7 +49,14 @@ def save_state_dict(state_dict, path, async_save=False):
     except ImportError:
         from ..framework.io import save as fsave
 
-        fsave(state_dict, path)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            fsave(state_dict, tmp)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
 
 
 def load_state_dict(path, target_state_dict=None):
@@ -89,20 +102,33 @@ class AsyncCheckpointer:
             _to_arrays(state_dict)))
 
     def restore_latest(self, template_state=None):
+        """Restore the newest checkpoint that actually LOADS, walking
+        older steps when the latest is unreadable or corrupt (truncated
+        shards, missing metadata) instead of raising — a crash must not
+        strand a run behind its own torn checkpoint.  Returns
+        ``(None, None)`` when no step restores."""
+        import sys
+
         import orbax.checkpoint as ocp
 
-        step = self.manager.latest_step()
-        if step is None:
-            return None, None
-        if template_state is not None:
-            template = _to_arrays(template_state)
-            restored = self.manager.restore(
-                step, args=ocp.args.StandardRestore(template))
-        else:
-            restored = self.manager.restore(step)
-        wrapped = jax.tree_util.tree_map(
-            lambda v: Tensor(v) if hasattr(v, "shape") else v, restored)
-        return step, wrapped
+        template = _to_arrays(template_state) \
+            if template_state is not None else None
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            try:
+                if template is not None:
+                    restored = self.manager.restore(
+                        step, args=ocp.args.StandardRestore(template))
+                else:
+                    restored = self.manager.restore(step)
+            except Exception as e:  # noqa: BLE001 — any unreadable step
+                print(f"[paddle_tpu.distributed.checkpoint] skipping "
+                      f"unreadable checkpoint step {step}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                continue
+            wrapped = jax.tree_util.tree_map(
+                lambda v: Tensor(v) if hasattr(v, "shape") else v, restored)
+            return step, wrapped
+        return None, None
 
     def wait(self):
         self.manager.wait_until_finished()

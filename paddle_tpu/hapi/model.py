@@ -25,6 +25,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._skip_batch = False
         self._train_step_fn = None
 
     # ------------------------------------------------------------- prepare
@@ -156,6 +157,13 @@ class Model:
                 if num_iters is not None and step >= num_iters:
                     break
                 cb_list.on_train_batch_begin(step)
+                if self._skip_batch:
+                    # a resume-capable callback (resilience.
+                    # ResilienceCallback) fast-forwards batches already
+                    # baked into restored weights: consume from the
+                    # stream, don't execute
+                    self._skip_batch = False
+                    continue
                 inputs, labels = self._split_batch(batch)
                 loss = self.train_batch(inputs, labels)
                 logs = {"loss": loss}

@@ -1,0 +1,56 @@
+"""paddle_tpu.resilience — fault-injected, checkpoint-recoverable
+training and serving.
+
+The ROADMAP north star (production traffic from millions of users) is
+unreachable without surviving preemption, disk corruption, and poisoned
+inputs — and without a harness that PROVES we survive them.  This
+package is both halves:
+
+- :mod:`chaos`      — deterministic, seeded fault injection
+  (:class:`FaultPlan`): NaN/Inf batches, crash-mid-checkpoint,
+  truncated/bit-flipped checkpoint files, delayed/killed/SIGTERMed
+  training steps, poisoned serving requests.
+- :mod:`checkpoint` — :class:`ResilientCheckpointer`: atomic
+  rename-commit saves, per-file sha256 manifests, ``restore_latest``
+  that falls back to the newest VALID checkpoint, bounded async save
+  queue with backpressure, SIGTERM save-and-exit.
+- :mod:`sentry`     — :class:`Sentry`: NaN/Inf loss and grad-norm
+  detection, skip-with-exponential-backoff, rewind after K consecutive
+  bad steps.
+- :mod:`callback`   — :class:`ResilienceCallback` wiring all of the
+  above into ``hapi.Model.fit`` (resume + fast-forward, periodic atomic
+  saves, rollback on poison, graceful preemption stop).
+
+Serving hardening (per-request deadlines, poison-request isolation)
+lives in :mod:`paddle_tpu.serving` and consults :mod:`chaos` hooks.
+
+Recovery guarantees (README "Resilience" documents the fault model):
+under injected kill/corruption faults, a resumed run reaches final
+weights bit-identical to an uninterrupted one, and a corrupt checkpoint
+is never restored — both asserted by ``tests/test_resilience.py``.
+"""
+from __future__ import annotations
+
+from . import chaos
+from .callback import ResilienceCallback
+from .chaos import ChaosError, FaultPlan, SimulatedPreemption
+from .checkpoint import (CheckpointCorruption, ResilientCheckpointer,
+                         apply_state, collect_state, host_snapshot)
+from .sentry import OK, REWIND, SKIP, Sentry
+
+__all__ = [
+    "FaultPlan",
+    "ChaosError",
+    "SimulatedPreemption",
+    "chaos",
+    "ResilientCheckpointer",
+    "CheckpointCorruption",
+    "collect_state",
+    "apply_state",
+    "host_snapshot",
+    "Sentry",
+    "OK",
+    "SKIP",
+    "REWIND",
+    "ResilienceCallback",
+]

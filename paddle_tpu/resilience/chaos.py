@@ -1,0 +1,268 @@
+"""Deterministic fault injection for resilience testing.
+
+The reference Paddle proves its elastic story by SIGKILLing real trainer
+processes (SURVEY.md §4); that is faithful but slow and non-deterministic.
+Here faults are a seeded :class:`FaultPlan` — a *schedule* of injections
+(NaN gradients at step S, a crash mid-checkpoint on save N, a truncated
+or bit-flipped checkpoint file, a delayed or killed training step) that
+instrumented code consults through module-level hooks.  The hooks are
+no-ops unless a plan is ACTIVE (``with FaultPlan(...):``), so production
+paths pay one ``is None`` check.
+
+Determinism is the point: a chaos test that reproduces bit-identical
+final weights across kill/resume (tests/test_resilience.py) is only
+meaningful if the fault fires at exactly the same step with exactly the
+same corruption every run.  All randomness (NaN positions, flipped bits)
+derives from ``FaultPlan.seed``.
+
+Instrumented sites:
+
+- ``on_step(step)``        — training loop, once per batch (delay/kill)
+- ``on_save(site)``        — checkpoint writers, mid-commit (crash)
+- ``after_save(path)``     — checkpoint writers, post-commit (disk rot)
+- ``maybe_fail_request(request_id)`` — serving prefill (poison request)
+- ``poison_batch(step, arrays)``     — data path (NaN/Inf gradients)
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "FaultPlan",
+    "ChaosError",
+    "SimulatedPreemption",
+    "active_plan",
+    "on_step",
+    "on_save",
+    "after_save",
+    "maybe_fail_request",
+    "poison_batch",
+    "truncate_file",
+    "bitflip_file",
+]
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (crash-mid-save, poisoned request, ...)."""
+
+
+class SimulatedPreemption(ChaosError):
+    """An injected kill of the training process at a scheduled step —
+    catch it where the real preemption (SIGTERM) would end the run."""
+
+
+_ACTIVE: Optional["FaultPlan"] = None
+
+
+def active_plan() -> Optional["FaultPlan"]:
+    return _ACTIVE
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of fault injections.
+
+    Use as a context manager; entering activates the plan for every
+    instrumented site in the process (one plan at a time — nesting
+    raises, because two overlapping schedules cannot be deterministic).
+
+    Parameters
+    ----------
+    seed: drives NaN positions and bit-flip offsets.
+    nan_batch_steps: global steps whose batch is poisoned with NaN
+        (``poison_batch``; float arrays only).
+    inf_batch_steps: same, with +inf (a different non-finite pathology).
+    kill_at_step: raise :class:`SimulatedPreemption` at this step's
+        ``on_step`` — the in-process stand-in for SIGKILL.
+    sigterm_at_step: deliver a REAL ``SIGTERM`` to this process at the
+        step — exercises the checkpointer's preemption handler.
+    delay_steps: {step: seconds} — sleep before the step runs.
+    crash_on_save: 1-based ordinal of the ``on_save`` call that raises
+        :class:`ChaosError` mid-commit (before the manifest/rename).
+    corrupt_after_save: {1-based save ordinal: "truncate" | "bitflip"}
+        — silently damage one committed checkpoint file on disk, the
+        bit-rot / torn-write case integrity checking must catch.
+    fail_request_ids: serving request ids whose prefill raises
+        :class:`ChaosError` (the poison-request case).
+    """
+
+    def __init__(self, seed: int = 0,
+                 nan_batch_steps: Iterable[int] = (),
+                 inf_batch_steps: Iterable[int] = (),
+                 kill_at_step: Optional[int] = None,
+                 sigterm_at_step: Optional[int] = None,
+                 delay_steps: Optional[Dict[int, float]] = None,
+                 crash_on_save: Optional[int] = None,
+                 corrupt_after_save: Optional[Dict[int, str]] = None,
+                 fail_request_ids: Iterable[str] = ()):
+        self.seed = seed
+        self.nan_batch_steps = frozenset(nan_batch_steps)
+        self.inf_batch_steps = frozenset(inf_batch_steps)
+        self.kill_at_step = kill_at_step
+        self.sigterm_at_step = sigterm_at_step
+        self.delay_steps = dict(delay_steps or {})
+        self.crash_on_save = crash_on_save
+        self.corrupt_after_save = dict(corrupt_after_save or {})
+        for kind in self.corrupt_after_save.values():
+            if kind not in ("truncate", "bitflip"):
+                raise ValueError(f"unknown corruption kind {kind!r}")
+        self.fail_request_ids = frozenset(fail_request_ids)
+        # observability: what actually fired (tests assert on these)
+        self.injected: list = []
+        self._save_calls = 0
+
+    # ------------------------------------------------------------ scope
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("a FaultPlan is already active; chaos "
+                               "schedules do not nest")
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        _ACTIVE = None
+        return False
+
+    # ------------------------------------------------------------ hooks
+    def on_step(self, step: int):
+        delay = self.delay_steps.get(step)
+        if delay:
+            import time
+
+            self.injected.append(("delay", step))
+            time.sleep(delay)
+        if self.sigterm_at_step == step:
+            import signal
+
+            self.injected.append(("sigterm", step))
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self.kill_at_step == step:
+            self.injected.append(("kill", step))
+            raise SimulatedPreemption(f"injected kill at step {step}")
+
+    def on_save(self, site: str):
+        self._save_calls += 1
+        if self.crash_on_save == self._save_calls:
+            self.injected.append(("crash_save", site))
+            raise ChaosError(
+                f"injected crash during checkpoint save #{self._save_calls} "
+                f"({site})")
+
+    def after_save(self, path: str):
+        kind = self.corrupt_after_save.get(self._save_calls)
+        if kind is None:
+            return
+        victim = _largest_payload_file(path)
+        if victim is None:
+            return
+        if kind == "truncate":
+            truncate_file(victim)
+        else:
+            bitflip_file(victim, seed=self.seed)
+        self.injected.append((kind, victim))
+
+    def maybe_fail_request(self, request_id: str):
+        if request_id in self.fail_request_ids:
+            self.injected.append(("fail_request", request_id))
+            raise ChaosError(f"injected prefill failure for {request_id}")
+
+    def poison_batch(self, step: int, arrays):
+        """Return ``arrays`` (a list/tuple of numpy arrays) with NaN/Inf
+        written into the float entries when ``step`` is scheduled;
+        positions are seeded, so reruns poison identically."""
+        bad = (np.nan if step in self.nan_batch_steps
+               else np.inf if step in self.inf_batch_steps else None)
+        if bad is None:
+            return arrays
+        rng = np.random.RandomState(self.seed * 100003 + step)
+        out = []
+        poisoned = False
+        for a in arrays:
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.floating) and a.size:
+                a = a.copy()
+                flat = a.reshape(-1)
+                k = max(1, flat.size // 8)
+                flat[rng.choice(flat.size, size=k, replace=False)] = bad
+                poisoned = True
+            out.append(a)
+        if poisoned:
+            self.injected.append(("poison", step))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module-level hooks (what instrumented code actually calls)
+# ---------------------------------------------------------------------------
+
+def on_step(step: int):
+    if _ACTIVE is not None:
+        _ACTIVE.on_step(step)
+
+
+def on_save(site: str):
+    if _ACTIVE is not None:
+        _ACTIVE.on_save(site)
+
+
+def after_save(path: str):
+    if _ACTIVE is not None:
+        _ACTIVE.after_save(path)
+
+
+def maybe_fail_request(request_id: str):
+    if _ACTIVE is not None:
+        _ACTIVE.maybe_fail_request(request_id)
+
+
+def poison_batch(step: int, arrays):
+    if _ACTIVE is None:
+        return arrays
+    return _ACTIVE.poison_batch(step, arrays)
+
+
+# ---------------------------------------------------------------------------
+# disk corruption utilities (also usable directly from tests)
+# ---------------------------------------------------------------------------
+
+def _largest_payload_file(path: str) -> Optional[str]:
+    """The biggest non-manifest file under ``path`` (or ``path`` itself
+    when it is a file) — the state payload a torn write would hit."""
+    if os.path.isfile(path):
+        return path
+    best, best_size = None, -1
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f == "manifest.json":
+                continue
+            p = os.path.join(root, f)
+            size = os.path.getsize(p)
+            if size > best_size:
+                best, best_size = p, size
+    return best
+
+
+def truncate_file(path: str, keep_frac: float = 0.5):
+    """Truncate ``path`` to ``keep_frac`` of its size (a torn write)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, int(size * keep_frac)))
+
+
+def bitflip_file(path: str, nbits: int = 8, seed: int = 0):
+    """Flip ``nbits`` seeded-random bits in ``path`` (silent bit rot)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    rng = np.random.RandomState(seed)
+    with open(path, "r+b") as f:
+        for _ in range(nbits):
+            off = int(rng.randint(0, size))
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ (1 << int(rng.randint(0, 8)))]))

@@ -1,0 +1,105 @@
+"""Training-loop guard against numeric poisoning.
+
+One NaN batch — a corrupt example, an overflowed loss scale, a flaky
+device — poisons every weight through the fused ``backward + step``
+program, and every step after that is wasted.  The reference framework's
+answer is ``FLAGS_check_nan_inf`` (detect and abort); a production run
+that must survive preemption cannot afford abort-on-first-NaN.
+
+:class:`Sentry` classifies each observed step:
+
+- ``OK``     — finite loss/grad-norm; the consecutive-bad counter resets.
+- ``SKIP``   — non-finite: the batch should be dropped and the update
+  rolled back (the ``ResilienceCallback`` restores its in-memory
+  snapshot of the pre-step state), after an exponential backoff pause
+  (transient infra faults — a flaky remote device, a mid-migration VM —
+  heal with time; immediate retry just burns the next batch too).
+- ``REWIND`` — K consecutive bad steps: the poison is persistent
+  (corrupted weights, a bad data shard), so roll state back to the last
+  good on-disk checkpoint instead of skipping forever.
+
+The sentry only CLASSIFIES; state movement belongs to the callback (or
+any custom loop driving :meth:`observe` directly).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Sentry", "OK", "SKIP", "REWIND", "is_finite"]
+
+OK = "ok"
+SKIP = "skip"
+REWIND = "rewind"
+
+
+def is_finite(value) -> bool:
+    """Finiteness of a loss/grad-norm in whatever form the loop has it:
+    Tensor, jax/numpy array, python float, or None (vacuously finite)."""
+    if value is None:
+        return True
+    if hasattr(value, "numpy"):
+        value = value.numpy()
+    try:
+        return bool(np.isfinite(np.asarray(value)).all())
+    except TypeError:
+        return True
+
+
+class Sentry:
+    def __init__(self, max_consecutive_bad: int = 3,
+                 backoff_base_s: float = 0.0,
+                 backoff_factor: float = 2.0,
+                 backoff_max_s: float = 30.0):
+        if max_consecutive_bad < 1:
+            raise ValueError("max_consecutive_bad must be >= 1")
+        self.max_consecutive_bad = max_consecutive_bad
+        self.backoff_base_s = backoff_base_s
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        # counters
+        self.steps_seen = 0
+        self.bad_steps = 0
+        self.skips = 0
+        self.rewinds = 0
+        self.consecutive_bad = 0
+        self.last_backoff_s = 0.0
+
+    def observe(self, loss=None, grad_norm=None) -> str:
+        """Classify one training step; returns ``OK``/``SKIP``/``REWIND``."""
+        self.steps_seen += 1
+        if is_finite(loss) and is_finite(grad_norm):
+            self.consecutive_bad = 0
+            return OK
+        self.bad_steps += 1
+        self.consecutive_bad += 1
+        self._backoff()
+        if self.consecutive_bad >= self.max_consecutive_bad:
+            self.rewinds += 1
+            self.consecutive_bad = 0
+            return REWIND
+        self.skips += 1
+        return SKIP
+
+    def _backoff(self):
+        if self.backoff_base_s <= 0:
+            self.last_backoff_s = 0.0
+            return
+        delay = min(
+            self.backoff_base_s
+            * self.backoff_factor ** (self.consecutive_bad - 1),
+            self.backoff_max_s)
+        self.last_backoff_s = delay
+        time.sleep(delay)
+
+    def stats(self) -> dict:
+        return {
+            "steps_seen": self.steps_seen,
+            "bad_steps": self.bad_steps,
+            "skips": self.skips,
+            "rewinds": self.rewinds,
+            "consecutive_bad": self.consecutive_bad,
+            "last_backoff_s": self.last_backoff_s,
+        }

@@ -101,12 +101,19 @@ class Engine:
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, stop_sequences=None,
                tokenizer=None, request_id: Optional[str] = None,
-               temperature: float = 0.0, do_sample: bool = False
+               temperature: float = 0.0, do_sample: bool = False,
+               deadline_s: Optional[float] = None
                ) -> Request:
         """Queue one request; returns its :class:`Request` handle.
         Raises :class:`AdmissionError` when the wait queue is full or
         the sequence can never fit the pool (backpressure: callers
         retry or shed load).
+
+        ``deadline_s`` is a wall-clock SLO measured from submission:
+        once exceeded the request is retired with
+        ``finish_reason="timeout"`` (partial tokens kept) — whether it
+        is still queued or mid-decode — instead of occupying a slot
+        other requests could use.
 
         ``temperature``/``do_sample`` exist for ``generate()`` call-site
         parity only: the engine decodes greedily (one shared compiled
@@ -127,7 +134,8 @@ class Engine:
             eos_token_id=eos_token_id,
             stop_sequences=normalize_stop_sequences(stop_sequences,
                                                     tokenizer),
-            request_id=request_id or f"req-{next(self._ids)}")
+            request_id=request_id or f"req-{next(self._ids)}",
+            deadline_s=deadline_s)
         if req.prompt_len + req.max_new_tokens > self.max_model_len:
             self.metrics.on_reject()
             raise AdmissionError(
@@ -173,6 +181,11 @@ class Engine:
 
     # -------------------------------------------------------- admission
     def _admit(self):
+        # deadline sweep over the WAIT queue: an expired request must
+        # not consume a prefill + slot it can no longer use
+        for req in [r for r in self.scheduler.waiting if r.expired()]:
+            self.scheduler.waiting.remove(req)
+            self._retire(req, "timeout")
         free_slots = [i for i, r in enumerate(self._slots) if r is None]
         while free_slots:
             req = self.scheduler.next_admittable()
@@ -185,16 +198,26 @@ class Engine:
         n = self.pool.blocks_for(req.prompt_len)
         blocks = self.pool.allocate(req.request_id, n)
         self.metrics.on_admit(req.request_id)
-        with _trace(f"serving::prefill:{req.request_id}"):
-            ids = np.zeros((1, n * bs), np.int32)
-            ids[0, :req.prompt_len] = req.prompt
-            z = jnp.zeros((1, n * bs, self.pool.kv_heads,
-                           self.pool.head_dim), self.pool.dtype)
-            caches = [(z, z) for _ in range(self.pool.num_layers)]
-            last, caches = self._prefill_step(
-                ids, caches, np.int32(req.prompt_len - 1))
-            self.pool.install_prefill(blocks, caches)
-        first_tok = int(np.argmax(np.asarray(last)[0]))
+        try:
+            from ..resilience import chaos
+
+            chaos.maybe_fail_request(req.request_id)
+            with _trace(f"serving::prefill:{req.request_id}"):
+                ids = np.zeros((1, n * bs), np.int32)
+                ids[0, :req.prompt_len] = req.prompt
+                z = jnp.zeros((1, n * bs, self.pool.kv_heads,
+                               self.pool.head_dim), self.pool.dtype)
+                caches = [(z, z) for _ in range(self.pool.num_layers)]
+                last, caches = self._prefill_step(
+                    ids, caches, np.int32(req.prompt_len - 1))
+                self.pool.install_prefill(blocks, caches)
+            first_tok = int(np.argmax(np.asarray(last)[0]))
+        except Exception as e:  # noqa: BLE001 — poison-request isolation
+            # ONE malformed request must not kill the engine loop: fail
+            # and retire it, free its blocks, keep serving the rest
+            req.error = f"{type(e).__name__}: {e}"
+            self._retire(req, "error")
+            return
         req.state = RUNNING
         req.slot = slot
         req.blocks = blocks
@@ -291,8 +314,12 @@ class Engine:
     # ----------------------------------------------------------- retire
     def _maybe_retire(self, req: Request):
         reason = self.scheduler.finish_reason(req)
-        if reason is None:
-            return
+        if reason is not None:
+            self._retire(req, reason)
+
+    def _retire(self, req: Request, reason: str):
+        """Finish ``req`` for ``reason`` from ANY state — running in a
+        slot, or never admitted (queued timeout / failed prefill)."""
         slot = req.slot
         req.state = FINISHED
         req.finish_reason = reason
@@ -300,10 +327,11 @@ class Engine:
             self.scheduler.running.remove(req)
         self.pool.free_request(req.request_id)
         req.slot = None
-        self._slots[slot] = None
-        self._block_tables[slot] = 0
-        self._lengths[slot] = 0
-        self._pending[slot] = 0
+        if slot is not None:
+            self._slots[slot] = None
+            self._block_tables[slot] = 0
+            self._lengths[slot] = 0
+            self._pending[slot] = 0
         self.metrics.on_finish(req.request_id, req.num_generated, reason)
         self._finished[req.request_id] = req
 

@@ -64,7 +64,9 @@ class ServingMetrics:
         # counters
         self.submitted = 0
         self.rejected = 0
-        self.completed = 0
+        self.completed = 0          # every retirement, any finish_reason
+        self.timed_out = 0          # retired past their deadline_s
+        self.failed = 0             # retired with finish_reason "error"
         self.preempted = 0          # preemption EVENTS (re-admits recount)
         self.tokens_generated = 0
         self.decode_iterations = 0
@@ -108,6 +110,10 @@ class ServingMetrics:
 
     def on_finish(self, request_id: str, tokens: int, reason: str):
         self.completed += 1
+        if reason == "timeout":
+            self.timed_out += 1
+        elif reason == "error":
+            self.failed += 1
         self.tokens_generated += tokens
         t = self.requests[request_id]
         t.finished_ns = _now_ns()
@@ -147,6 +153,8 @@ class ServingMetrics:
                 "requests_submitted": self.submitted,
                 "requests_rejected": self.rejected,
                 "requests_completed": self.completed,
+                "requests_timed_out": self.timed_out,
+                "requests_failed": self.failed,
                 "preemptions": self.preempted,
                 "tokens_generated": self.tokens_generated,
                 "decode_iterations": self.decode_iterations,

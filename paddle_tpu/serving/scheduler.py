@@ -20,6 +20,7 @@ eos_token_id and max_new_tokens.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, List, Optional
@@ -54,14 +55,21 @@ class Request:
     eos_token_id: Optional[int] = None
     stop_sequences: List[List[int]] = field(default_factory=list)
     request_id: str = ""
+    # wall-clock SLO: the request is retired with finish_reason
+    # "timeout" once deadline_s seconds have passed since submission,
+    # whether it is still queued or mid-decode (partial tokens kept)
+    deadline_s: Optional[float] = None
     # runtime (engine-owned)
     ordinal: int = field(default_factory=lambda: next(_ordinal))
     state: str = QUEUED
     slot: Optional[int] = None
     blocks: List[int] = field(default_factory=list)
     generated: List[int] = field(default_factory=list)
-    finish_reason: Optional[str] = None     # "eos" | "stop" | "length"
+    # "eos" | "stop" | "length" | "timeout" | "error"
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None             # set with finish_reason "error"
     preemptions: int = 0
+    deadline_t: Optional[float] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -71,6 +79,15 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.deadline_s is not None:
+            if self.deadline_s < 0:
+                raise ValueError("deadline_s must be >= 0")
+            self.deadline_t = time.monotonic() + self.deadline_s
+
+    def expired(self) -> bool:
+        """Past the per-request deadline (monotonic clock)."""
+        return self.deadline_t is not None \
+            and time.monotonic() >= self.deadline_t
 
     @property
     def prompt_len(self) -> int:
@@ -154,7 +171,11 @@ class Scheduler:
     @staticmethod
     def finish_reason(req: Request) -> Optional[str]:
         """Termination check over the request's generated tokens —
-        shared semantics with ``generate()`` (same match_stop)."""
+        shared semantics with ``generate()`` (same match_stop) — plus
+        the wall-clock deadline (a hard SLO: it wins over eos/stop and
+        fires even before the first token)."""
+        if req.expired():
+            return "timeout"
         if not req.generated:
             return None
         if req.eos_token_id is not None \

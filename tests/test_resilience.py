@@ -1,0 +1,641 @@
+"""paddle_tpu.resilience — chaos harness, atomic checkpointer, sentry,
+fit-loop callback, serving hardening, H107.
+
+The ISSUE 3 done bar lives here: a training run killed at step N
+resumes to final weights BIT-IDENTICAL with an uninterrupted run (zero
+corrupt-checkpoint restores along the way), and a poisoned serving
+request is retired with an error finish_reason while every other
+request in the batch completes token-exact.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.optimizer import Adam
+from paddle_tpu.resilience import (OK, REWIND, SKIP, ChaosError, FaultPlan,
+                                   ResilienceCallback, ResilientCheckpointer,
+                                   Sentry, SimulatedPreemption, chaos,
+                                   collect_state)
+from paddle_tpu.resilience.checkpoint import CheckpointCorruption
+
+
+# ---------------------------------------------------------------------------
+# shared tiny-regression harness (deterministic per-step data)
+# ---------------------------------------------------------------------------
+
+def _make_model(seed=0, lr=0.01):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(Adam(lr, parameters=net.parameters()), nn.MSELoss())
+    return model
+
+
+def _batches(n=10, bs=8, seed=1):
+    """A fixed LIST of (x, y) batches — the same data at the same step
+    every run, the precondition for bit-identical resume."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 2).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = rng.randn(bs, 4).astype(np.float32)
+        out.append((x, (x @ w).astype(np.float32)))
+    return out
+
+
+def _weights(model):
+    return {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+            for k, v in model.network.state_dict().items()}
+
+
+def _train_uninterrupted(batches, **model_kw):
+    model = _make_model(**model_kw)
+    model.fit(train_data=batches, epochs=1, verbose=0)
+    return _weights(model)
+
+
+def _state(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"model": {"w": rng.randn(64, 8).astype(np.float32)},
+            "optimizer": {"m": rng.randn(n).astype(np.float32)}}
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_hooks_noop_when_inactive(self):
+        assert chaos.active_plan() is None
+        chaos.on_step(0)
+        chaos.on_save("x")
+        chaos.maybe_fail_request("r")
+        arrays = [np.ones(4, np.float32)]
+        assert chaos.poison_batch(0, arrays) is arrays
+
+    def test_no_nesting(self):
+        with FaultPlan():
+            with pytest.raises(RuntimeError, match="nest"):
+                with FaultPlan():
+                    pass
+        assert chaos.active_plan() is None
+
+    def test_exit_clears_on_exception(self):
+        with pytest.raises(ChaosError):
+            with FaultPlan(kill_at_step=0):
+                chaos.on_step(0)
+        assert chaos.active_plan() is None
+
+    def test_poison_batch_deterministic(self):
+        x = np.arange(32, dtype=np.float32).reshape(4, 8)
+        y = np.arange(4, dtype=np.int64)  # ints are never poisoned
+        with FaultPlan(seed=7, nan_batch_steps=[2]) as plan:
+            a1, b1 = chaos.poison_batch(2, [x, y])
+            clean_x, clean_y = chaos.poison_batch(3, [x, y])
+        with FaultPlan(seed=7, nan_batch_steps=[2]):
+            a2, _ = chaos.poison_batch(2, [x, y])
+        assert np.isnan(a1).any() and not np.isnan(x).any()
+        np.testing.assert_array_equal(a1, a2)  # seeded == reproducible
+        np.testing.assert_array_equal(b1, y)
+        np.testing.assert_array_equal(clean_x, x)
+        assert ("poison", 2) in plan.injected
+
+    def test_inf_poisoning(self):
+        x = np.zeros(16, np.float32)
+        with FaultPlan(inf_batch_steps=[0]):
+            (out,) = chaos.poison_batch(0, [x])
+        assert np.isinf(out).any() and not np.isnan(out).any()
+
+    def test_corruption_utilities(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"\x00" * 100)
+        chaos.truncate_file(p, keep_frac=0.5)
+        assert os.path.getsize(p) == 50
+        chaos.bitflip_file(p, nbits=4, seed=3)
+        assert open(p, "rb").read() != b"\x00" * 50
+
+
+# ---------------------------------------------------------------------------
+# ResilientCheckpointer
+# ---------------------------------------------------------------------------
+
+class TestResilientCheckpointer:
+    def test_roundtrip_and_manifest(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        state = _state()
+        d = ck.save(3, state)
+        manifest = json.load(open(os.path.join(d, "manifest.json")))
+        assert manifest["step"] == 3
+        assert sorted(manifest["files"]) == ["model.pkl", "optimizer.pkl"]
+        step, restored = ck.restore_latest()
+        assert step == 3
+        np.testing.assert_array_equal(restored["model"]["w"],
+                                      state["model"]["w"])
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp-")]
+
+    def test_truncated_latest_falls_back(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        ck.save(1, _state(seed=1))
+        ck.save(2, _state(seed=2))
+        victim = os.path.join(ck._step_dir(2), "model.pkl")
+        chaos.truncate_file(victim)
+        step, restored = ck.restore_latest()
+        assert step == 1 and ck.corrupt_skipped == 1
+        np.testing.assert_array_equal(restored["model"]["w"],
+                                      _state(seed=1)["model"]["w"])
+
+    def test_bitflip_detected(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        ck.save(1, _state())
+        chaos.bitflip_file(os.path.join(ck._step_dir(1), "model.pkl"))
+        with pytest.raises(CheckpointCorruption, match="sha256"):
+            ck.restore(1)
+        assert ck.restore_latest() == (None, None)
+
+    def test_crash_mid_save_leaves_previous_intact(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        ck.save(1, _state(seed=1))
+        # within the plan, save #2 makes on_save calls 1-3 (two payload
+        # writes + the commit); crash the 2nd payload write
+        with FaultPlan(crash_on_save=2):
+            with pytest.raises(ChaosError, match="injected crash"):
+                ck.save(2, _state(seed=2))
+        assert ck.steps() == [1]           # no torn step_2 directory
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp-")]
+        step, _ = ck.restore_latest()
+        assert step == 1 and ck.corrupt_skipped == 0
+
+    def test_gc_keeps_max_to_keep(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), max_to_keep=2)
+        for s in range(5):
+            ck.save(s, _state(seed=s))
+        assert ck.steps() == [3, 4]
+
+    def test_async_save_commits_and_backpressure_bound(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path), max_to_keep=10,
+                                   max_pending=2)
+        for s in range(6):
+            ck.save_async(s, _state(seed=s))
+            assert ck.stats()["pending_async"] <= 2
+        ck.wait()
+        assert ck.steps() == list(range(6))
+        step, restored = ck.restore_latest()
+        assert step == 5
+        np.testing.assert_array_equal(restored["model"]["w"],
+                                      _state(seed=5)["model"]["w"])
+        ck.close()
+
+    def test_async_error_surfaces_on_wait(self, tmp_path):
+        ck = ResilientCheckpointer(str(tmp_path))
+        with FaultPlan(crash_on_save=1):
+            ck.save_async(1, _state())
+            with pytest.raises(ChaosError, match="injected crash"):
+                ck.wait()
+        ck.close()
+        assert ck.steps() == []
+
+    def test_preemption_flag_latches(self, tmp_path):
+        import signal
+
+        ck = ResilientCheckpointer(str(tmp_path))
+        ck.install_preemption_handler()
+        try:
+            assert not ck.preemption_requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert ck.preemption_requested
+        finally:
+            ck.uninstall_preemption_handler()
+
+    def test_stale_tmp_reaped_on_init(self, tmp_path):
+        os.makedirs(str(tmp_path / ".tmp-9-1-dead"))
+        ck = ResilientCheckpointer(str(tmp_path))
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(".tmp-")]
+        assert ck.steps() == []
+
+
+# ---------------------------------------------------------------------------
+# Sentry
+# ---------------------------------------------------------------------------
+
+class TestSentry:
+    def test_classification(self):
+        s = Sentry(max_consecutive_bad=3)
+        assert s.observe(1.0) == OK
+        assert s.observe(float("nan")) == SKIP
+        assert s.observe(float("inf")) == SKIP
+        assert s.observe(float("nan")) == REWIND   # 3rd consecutive
+        assert s.consecutive_bad == 0              # reset after rewind
+        assert s.observe(0.5) == OK
+        assert (s.skips, s.rewinds, s.bad_steps) == (2, 1, 3)
+
+    def test_good_step_resets_streak(self):
+        s = Sentry(max_consecutive_bad=2)
+        assert s.observe(float("nan")) == SKIP
+        assert s.observe(1.0) == OK
+        assert s.observe(float("nan")) == SKIP     # streak restarted
+
+    def test_grad_norm_checked_too(self):
+        s = Sentry()
+        assert s.observe(1.0, grad_norm=float("inf")) == SKIP
+
+    def test_tensor_and_array_inputs(self):
+        s = Sentry()
+        assert s.observe(paddle.to_tensor(np.float32(2.0))) == OK
+        assert s.observe(np.array([1.0, np.nan])) == SKIP
+
+    def test_backoff_grows_exponentially(self):
+        s = Sentry(max_consecutive_bad=10, backoff_base_s=1e-4,
+                   backoff_factor=2.0)
+        s.observe(float("nan"))
+        first = s.last_backoff_s
+        s.observe(float("nan"))
+        assert s.last_backoff_s == pytest.approx(first * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# the done bar: kill at step N → bit-identical resume
+# ---------------------------------------------------------------------------
+
+class TestKillResume:
+    def _killed_then_resumed(self, tmp_path, batches, kill_at,
+                             async_save=False):
+        ckdir = str(tmp_path / "ck")
+        model = _make_model()
+        cb = ResilienceCallback(ckdir, save_every=1, async_save=async_save)
+        with pytest.raises(SimulatedPreemption):
+            with FaultPlan(kill_at_step=kill_at):
+                model.fit(train_data=batches, epochs=1, verbose=0,
+                          callbacks=[cb])
+        # a fresh process: new model object, same deterministic data
+        model2 = _make_model()
+        cb2 = ResilienceCallback(ckdir, save_every=1)
+        model2.fit(train_data=batches, epochs=1, verbose=0, callbacks=[cb2])
+        return model2, cb2
+
+    def test_bit_identical_resume(self, tmp_path):
+        batches = _batches(n=10)
+        reference = _train_uninterrupted(batches)
+        model2, cb2 = self._killed_then_resumed(tmp_path, batches,
+                                                kill_at=6)
+        assert ("resume", 6) in cb2.events        # steps 0..5 completed
+        assert cb2.checkpointer.corrupt_skipped == 0
+        resumed = _weights(model2)
+        assert resumed.keys() == reference.keys()
+        for k in reference:
+            np.testing.assert_array_equal(resumed[k], reference[k],
+                                          err_msg=k)
+
+    def test_bit_identical_resume_async_saves(self, tmp_path):
+        """The kill path flushes the bounded async queue before dying, so
+        async checkpointing loses no committed step."""
+        batches = _batches(n=8)
+        reference = _train_uninterrupted(batches)
+        model2, cb2 = self._killed_then_resumed(tmp_path, batches,
+                                                kill_at=5, async_save=True)
+        assert ("resume", 5) in cb2.events
+        for k, v in _weights(model2).items():
+            np.testing.assert_array_equal(v, reference[k], err_msg=k)
+
+    def test_resume_after_truncated_latest(self, tmp_path):
+        """Corrupting the newest checkpoint falls back to the previous
+        valid one; replaying from there still lands bit-identical."""
+        batches = _batches(n=10)
+        reference = _train_uninterrupted(batches)
+        ckdir = str(tmp_path / "ck")
+        model = _make_model()
+        cb = ResilienceCallback(ckdir, save_every=1, max_to_keep=3)
+        with pytest.raises(SimulatedPreemption):
+            with FaultPlan(kill_at_step=6):
+                model.fit(train_data=batches, epochs=1, verbose=0,
+                          callbacks=[cb])
+        latest = cb.checkpointer._step_dir(6)
+        chaos.truncate_file(os.path.join(latest, "model.pkl"))
+        model2 = _make_model()
+        cb2 = ResilienceCallback(ckdir, save_every=1)
+        model2.fit(train_data=batches, epochs=1, verbose=0,
+                   callbacks=[cb2])
+        assert ("resume", 5) in cb2.events        # fell back one step
+        assert cb2.checkpointer.corrupt_skipped == 1
+        for k, v in _weights(model2).items():
+            np.testing.assert_array_equal(v, reference[k], err_msg=k)
+
+    def test_sigterm_saves_and_stops_then_resumes(self, tmp_path):
+        batches = _batches(n=10)
+        reference = _train_uninterrupted(batches)
+        ckdir = str(tmp_path / "ck")
+        model = _make_model()
+        # save_every high: the preemption save is the ONLY checkpoint
+        cb = ResilienceCallback(ckdir, save_every=100)
+        with FaultPlan(sigterm_at_step=4):
+            model.fit(train_data=batches, epochs=1, verbose=0,
+                      callbacks=[cb])
+        assert model.stop_training
+        assert ("preempt-save", 5) in cb.events   # steps 0..4 done
+        model2 = _make_model()
+        cb2 = ResilienceCallback(ckdir, save_every=100)
+        model2.fit(train_data=batches, epochs=1, verbose=0,
+                   callbacks=[cb2])
+        assert ("resume", 5) in cb2.events
+        for k, v in _weights(model2).items():
+            np.testing.assert_array_equal(v, reference[k], err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# NaN-batch skip + rewind (the sentry wired into fit)
+# ---------------------------------------------------------------------------
+
+class _PoisonLoader:
+    """List-of-batches loader that routes every batch through the chaos
+    poison hook — the injection point a real data path would own."""
+
+    def __init__(self, batches):
+        self.batches = batches
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        for i, (x, y) in enumerate(self.batches):
+            x, y = chaos.poison_batch(i, [x, y])
+            yield x, y
+
+
+class TestSentryInFit:
+    def test_nan_batch_skipped_and_training_survives(self, tmp_path):
+        batches = _batches(n=8)
+        model = _make_model()
+        cb = ResilienceCallback(str(tmp_path / "ck"), save_every=2)
+        with FaultPlan(nan_batch_steps=[3]) as plan:
+            hist = model.fit(train_data=_PoisonLoader(batches), epochs=1,
+                             verbose=0, callbacks=[cb])
+        assert ("poison", 3) in plan.injected
+        assert cb.sentry.skips == 1 and cb.sentry.rewinds == 0
+        assert ("skip", 3) in cb.events
+        # the poisoned update was rolled back: weights stayed finite and
+        # the run finished with a finite loss
+        assert np.isfinite(hist["loss"][-1])
+        for k, v in _weights(model).items():
+            assert np.isfinite(v).all(), k
+
+    def test_persistent_poison_rewinds_to_checkpoint(self, tmp_path):
+        batches = _batches(n=10)
+        model = _make_model()
+        sentry = Sentry(max_consecutive_bad=3)
+        cb = ResilienceCallback(str(tmp_path / "ck"), save_every=1,
+                                sentry=sentry)
+        with FaultPlan(nan_batch_steps=[4, 5, 6]):
+            model.fit(train_data=_PoisonLoader(batches), epochs=1,
+                      verbose=0, callbacks=[cb])
+        assert sentry.skips == 2 and sentry.rewinds == 1
+        kinds = [k for k, _ in cb.events]
+        assert "rewind" in kinds
+        for k, v in _weights(model).items():
+            assert np.isfinite(v).all(), k
+
+
+# ---------------------------------------------------------------------------
+# serving hardening: deadlines + poison-request isolation
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
+from paddle_tpu.serving import Engine, ServingConfig  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompts(lengths, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(L,)).astype(np.int32)
+            for L in lengths]
+
+
+def _reference(model, prompt, **kw):
+    out = model.generate(paddle.to_tensor(prompt[None, :]),
+                         temperature=0.0, use_static_cache=True, **kw)
+    return np.asarray(out.numpy())[0]
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_queue_len", 16)
+    return ServingConfig(**kw)
+
+
+class TestServingDeadlines:
+    def test_queued_request_times_out(self, model):
+        eng = Engine(model, _config())
+        (p_live, p_dead) = _prompts([6, 6])
+        live = eng.submit(p_live, max_new_tokens=4)
+        dead = eng.submit(p_dead, max_new_tokens=4, deadline_s=0.0)
+        done = eng.run_until_complete()
+        assert done[dead.request_id].finish_reason == "timeout"
+        assert dead.num_generated == 0            # never prefilled
+        assert done[live.request_id].finish_reason == "length"
+        np.testing.assert_array_equal(
+            live.output_ids(), _reference(model, p_live, max_new_tokens=4))
+        counters = eng.stats()["counters"]
+        assert counters["requests_timed_out"] == 1
+        assert counters["requests_completed"] == 2
+        eng.pool.check_leaks()
+
+    def test_running_request_times_out_keeps_partial(self, model):
+        eng = Engine(model, _config())
+        (p,) = _prompts([5], seed=3)
+        req = eng.submit(p, max_new_tokens=64, deadline_s=3600.0)
+        eng.step()
+        eng.step()
+        assert req.num_generated >= 2
+        req.deadline_t = time.monotonic() - 1.0   # force expiry mid-decode
+        eng.run_until_complete()
+        assert req.finish_reason == "timeout"
+        assert 2 <= req.num_generated < 64        # partial tokens kept
+        eng.pool.check_leaks()
+
+    def test_deadline_validation(self, model):
+        eng = Engine(model, _config())
+        (p,) = _prompts([4])
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.submit(p, deadline_s=-1.0)
+
+
+class TestPoisonRequestIsolation:
+    def test_failed_prefill_isolated_others_token_exact(self, model):
+        eng = Engine(model, _config())
+        prompts = _prompts([5, 7, 6], seed=4)
+        reqs = [eng.submit(p, max_new_tokens=6,
+                           request_id=f"iso-{i}")
+                for i, p in enumerate(prompts)]
+        with FaultPlan(fail_request_ids=["iso-1"]) as plan:
+            done = eng.run_until_complete()
+        poisoned = done["iso-1"]
+        assert poisoned.finish_reason == "error"
+        assert "ChaosError" in poisoned.error
+        assert ("fail_request", "iso-1") in plan.injected
+        for i in (0, 2):
+            req = done[f"iso-{i}"]
+            assert req.finish_reason == "length"
+            np.testing.assert_array_equal(
+                req.output_ids(),
+                _reference(model, prompts[i], max_new_tokens=6))
+        assert eng.stats()["counters"]["requests_failed"] == 1
+        eng.pool.check_leaks()                    # poison blocks freed
+        assert all(r is None for r in eng._slots)
+        assert reqs[1].state == "finished"
+
+
+# ---------------------------------------------------------------------------
+# H107: checkpoint writes that bypass the atomic writer
+# ---------------------------------------------------------------------------
+
+class TestH107CheckpointWrites:
+    def _scan_src(self, tmp_path, src):
+        from paddle_tpu.analysis import scan_checkpoint_writes
+
+        p = os.path.join(str(tmp_path), "mod.py")
+        with open(p, "w") as f:
+            f.write(src)
+        return scan_checkpoint_writes(p)
+
+    def test_flags_np_save_and_open_wb(self, tmp_path):
+        diags = self._scan_src(tmp_path, (
+            "import numpy as np\n"
+            "def save_all(state, ckpt_path, ckpt_dir):\n"
+            "    np.save(ckpt_path, state)\n"
+            "    with open(ckpt_dir + '/shard0.bin', 'wb') as f:\n"
+            "        f.write(state)\n"))
+        assert [d.code for d in diags] == ["H107", "H107"]
+        assert all(d.severity == "error" for d in diags)
+
+    def test_warns_pickle_style_save(self, tmp_path):
+        diags = self._scan_src(tmp_path, (
+            "def f(paddle, state, checkpoint_path):\n"
+            "    paddle.save(state, checkpoint_path)\n"))
+        assert len(diags) == 1 and diags[0].severity == "warning"
+
+    def test_ignores_non_checkpoint_paths_and_reads(self, tmp_path):
+        diags = self._scan_src(tmp_path, (
+            "import numpy as np\n"
+            "def f(state, out_path, ckpt_path):\n"
+            "    np.save(out_path, state)\n"       # no ckpt hint
+            "    data = open(ckpt_path, 'rb').read()\n"  # read, not write
+            "    return data\n"))
+        assert diags == []
+
+    def test_repo_is_clean(self):
+        from paddle_tpu.analysis import scan_checkpoint_writes
+
+        import paddle_tpu
+
+        root = os.path.dirname(paddle_tpu.__file__)
+        errors = [d for d in scan_checkpoint_writes(root)
+                  if d.severity == "error"]
+        assert errors == [], errors
+
+
+# ---------------------------------------------------------------------------
+# distributed/checkpoint.py satellite fixes
+# ---------------------------------------------------------------------------
+
+class TestDistributedCheckpointFixes:
+    def test_pickle_fallback_is_atomic(self, tmp_path, monkeypatch):
+        import sys
+
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+        path = str(tmp_path / "state.pkl")
+        save_state_dict({"w": np.arange(4.0)}, path)
+        restored = load_state_dict(path)["w"]
+        if hasattr(restored, "numpy"):
+            restored = restored.numpy()
+        np.testing.assert_array_equal(np.asarray(restored), np.arange(4.0))
+        assert os.listdir(str(tmp_path)) == ["state.pkl"]  # no tmp residue
+
+    def test_pickle_fallback_crash_preserves_previous(self, tmp_path,
+                                                      monkeypatch):
+        import sys
+
+        import paddle_tpu.framework.io as fio
+        from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                       save_state_dict)
+
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+        path = str(tmp_path / "state.pkl")
+        save_state_dict({"w": np.float64(1.0)}, path)
+
+        real_save = fio.save
+
+        def torn_save(obj, p, **kw):
+            real_save(obj, p, **kw)       # the temp file got written...
+            raise OSError("disk died")    # ...then the process crashed
+
+        monkeypatch.setattr(fio, "save", torn_save)
+        with pytest.raises(OSError, match="disk died"):
+            save_state_dict({"w": np.float64(2.0)}, path)
+        monkeypatch.setattr(fio, "save", real_save)
+        assert os.listdir(str(tmp_path)) == ["state.pkl"]
+        assert float(np.asarray(load_state_dict(path)["w"])) == 1.0
+
+    def test_async_checkpointer_skips_unreadable_latest(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+
+        ck = AsyncCheckpointer(str(tmp_path), max_to_keep=4)
+        ck.save(1, {"w": np.full((4,), 1.0, np.float32)})
+        ck.save(2, {"w": np.full((4,), 2.0, np.float32)})
+        ck.wait()
+        # rot every payload byte of the NEWEST step on disk (orbax names
+        # step dirs "2" or "step_2" depending on its step-name format)
+        step2 = next(os.path.join(str(tmp_path), n)
+                     for n in os.listdir(str(tmp_path))
+                     if os.path.isdir(os.path.join(str(tmp_path), n))
+                     and n.split("_")[-1].lstrip("0") == "2")
+        for root, _dirs, files in os.walk(step2):
+            for f in files:
+                with open(os.path.join(root, f), "wb") as fh:
+                    fh.write(b"rotten")
+        step, state = ck.restore_latest(
+            template_state={"w": np.zeros((4,), np.float32)})
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(state["w"].numpy()),
+                                      np.full((4,), 1.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# collect/apply round-trip sanity
+# ---------------------------------------------------------------------------
+
+class TestStateRoundTrip:
+    def test_collect_apply_restores_exactly(self):
+        model = _make_model()
+        batches = _batches(n=3)
+        model.fit(train_data=batches, epochs=1, verbose=0)
+        snap = collect_state(model.network, model._optimizer)
+        before = _weights(model)
+        model.fit(train_data=batches, epochs=1, verbose=0)  # mutate
+        changed = any(not np.array_equal(v, before[k])
+                      for k, v in _weights(model).items())
+        assert changed
+        from paddle_tpu.resilience import apply_state
+
+        apply_state(snap, model.network, model._optimizer)
+        for k, v in _weights(model).items():
+            np.testing.assert_array_equal(v, before[k], err_msg=k)
