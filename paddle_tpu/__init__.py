@@ -61,6 +61,9 @@ from .ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
 from .ops.random import check_shape  # noqa: F401  (reference: paddle.check_shape)
 
 # --- subsystems (grown as they land; see SURVEY.md §7 layer order) --------
+# observability first: pure stdlib, no framework imports, and every
+# later subsystem may mirror metrics into it
+from . import observability  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
 from . import nn  # noqa: F401

@@ -21,6 +21,13 @@ Rules (all ERROR severity unless noted):
 - **L005 mutable-default** — no mutable default arguments
   (``def f(x=[])``): shared-state bugs plus retrace hazards when the
   default rides a trace signature.
+- **L006 dynamic-metric-name** — the metric NAME passed to a
+  ``Counter(...)``/``Gauge(...)``/``Histogram(...)`` constructor or a
+  ``.counter()``/``.gauge()``/``.histogram()`` registry factory must be
+  a static string, not an f-string/``%``/``.format``/concatenation:
+  per-value names are unbounded metric cardinality (one time series per
+  request id).  Varying dimensions belong in LABELS, which the
+  observability registry caps per metric.
 
 Suppressions (documented in README):
 
@@ -65,7 +72,12 @@ RULES: Dict[str, str] = {
     "L004": "jax imported outside sanctioned modules "
             "(core/, ops/, kernels/, static/, distributed/)",
     "L005": "mutable default argument",
+    "L006": "metric name built from a formatted string at a "
+            "Counter/Gauge/Histogram call site (unbounded cardinality)",
 }
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
 _SANCTIONED_ROOTS = ("core", "ops", "kernels", "static", "distributed")
 _OPS_SUBMODULES = ("creation", "math", "manipulation", "logic", "linalg",
@@ -239,6 +251,54 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_Lambda(self, node):
         self._check_defaults(node, node.args)
+        self.generic_visit(node)
+
+    # -- L006: dynamic metric names -------------------------------------
+    @staticmethod
+    def _is_dynamic_str(node) -> bool:
+        """A string expression whose VALUE varies at runtime: f-string
+        with interpolations, %-format off a literal, ``"...".format()``,
+        or concatenation involving a string piece (fully-constant
+        expressions don't count)."""
+        d = _FileLinter._is_dynamic_str
+        if isinstance(node, ast.JoinedStr):
+            return any(isinstance(v, ast.FormattedValue)
+                       for v in node.values)
+
+        def is_str_const(n):
+            return isinstance(n, ast.Constant) and isinstance(n.value, str)
+
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Mod, ast.Add)):
+            sides = (node.left, node.right)
+            has_str = any(is_str_const(s) or d(s) for s in sides)
+            all_const = all(is_str_const(s) for s in sides)
+            return has_str and not all_const
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "format" and \
+                is_str_const(node.func.value):
+            return True
+        return False
+
+    def visit_Call(self, node):
+        func = node.func
+        is_metric = (isinstance(func, ast.Name)
+                     and func.id in _METRIC_CTORS) or \
+                    (isinstance(func, ast.Attribute)
+                     and func.attr in (_METRIC_CTORS | _METRIC_FACTORIES))
+        if is_metric:
+            name_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            if name_arg is not None and self._is_dynamic_str(name_arg):
+                what = func.id if isinstance(func, ast.Name) else func.attr
+                self.add(node, "L006",
+                         f"metric name passed to '{what}(...)' is built "
+                         "from a formatted string — every distinct value "
+                         "becomes its own time series (unbounded "
+                         "cardinality); use a fixed name and put the "
+                         "varying dimension in a label")
         self.generic_visit(node)
 
     def _check_op_schema(self, node):

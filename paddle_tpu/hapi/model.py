@@ -13,6 +13,7 @@ import numpy as np
 from ..core.tensor import Tensor, to_tensor
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from .. import observability
 from .callbacks import CallbackList, LRScheduler, ModelCheckpoint, ProgBarLogger
 
 
@@ -58,7 +59,11 @@ class Model:
             optimizer.clear_grad()
             return losses, outputs
 
-        self._train_step_fn = jit.to_static(train_step)
+        # compile accounting over the one entry point fit() drives: a
+        # shape-stable loader compiles this exactly once; a churning one
+        # shows up in observability.compile_stats() / xla_compiles_total
+        self._train_step_fn = observability.track_compiles(
+            jit.to_static(train_step), label="hapi::train_step")
 
         def eval_step(inputs, labels):
             outputs = network(*inputs)
@@ -148,12 +153,19 @@ class Model:
         self.stop_training = False
         cb_list.on_train_begin()
         history = {"loss": []}
+        # step telemetry (steps/sec, tokens/sec, data- vs device-wait,
+        # loss) — only when a sink armed the registry; otherwise fit()
+        # keeps its bare enumerate and pays nothing
+        timer = observability.StepTimer() if observability.enabled() \
+            else None
         for epoch in range(epochs):
             cb_list.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             epoch_logs = {}
-            for step, batch in enumerate(train_loader):
+            batches = enumerate(train_loader) if timer is None \
+                else timer.timed_enumerate(train_loader)
+            for step, batch in batches:
                 if num_iters is not None and step >= num_iters:
                     break
                 cb_list.on_train_batch_begin(step)
@@ -166,6 +178,8 @@ class Model:
                     continue
                 inputs, labels = self._split_batch(batch)
                 loss = self.train_batch(inputs, labels)
+                if timer is not None:
+                    timer.step(loss=loss, inputs=inputs)
                 logs = {"loss": loss}
                 for m in self._metrics:
                     names = m.name()

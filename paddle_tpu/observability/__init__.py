@@ -1,0 +1,67 @@
+"""paddle_tpu.observability — unified telemetry for the framework.
+
+One process-global :class:`MetricsRegistry` is the single pane of glass
+over every producer in the repo:
+
+- ``hapi.Model.fit`` (via :class:`StepTimer`: steps/sec, tokens/sec,
+  data-wait vs device-wait, loss);
+- the serving engine (TTFT/TPOT/occupancy/preemptions mirrored from
+  ``serving.metrics``);
+- resilience (checkpoint save latency, corrupt checkpoints skipped);
+- any jit entry point wrapped with :func:`track_compiles` /
+  :func:`warn_on_retrace` (runtime compile and retrace accounting —
+  the dynamic half of the H101 hazard).
+
+Telemetry is OFF by default: every producer call sites checks
+:func:`enabled` first, so an untelemetered run pays ~nothing.  Turning
+it on is one line — ``FileSink(dir).start()`` (periodic Prometheus +
+JSON dumps), or :func:`enable` plus an explicit
+:func:`prometheus_text` / :func:`to_json` export.
+
+Pure stdlib; importable from anywhere in the framework without cycles.
+"""
+from .registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricSnapshot,
+    MetricsRegistry,
+    collect,
+    disable,
+    enable,
+    enabled,
+    get_registry,
+)
+from .exporters import (  # noqa: F401
+    FileSink,
+    prometheus_text,
+    to_json,
+    write_json,
+    write_prometheus,
+)
+from .compile_tracker import (  # noqa: F401
+    RetraceError,
+    RetraceWarning,
+    TrackedFunction,
+    compile_stats,
+    jit_cache_size,
+    track_compiles,
+    warn_on_retrace,
+)
+from .step_metrics import StepTimer, count_tokens  # noqa: F401
+
+__all__ = [
+    # registry
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricSnapshot",
+    "MetricsRegistry", "collect", "disable", "enable", "enabled",
+    "get_registry",
+    # exporters
+    "FileSink", "prometheus_text", "to_json", "write_json",
+    "write_prometheus",
+    # compile tracking
+    "RetraceError", "RetraceWarning", "TrackedFunction", "compile_stats",
+    "jit_cache_size", "track_compiles", "warn_on_retrace",
+    # step metrics
+    "StepTimer", "count_tokens",
+]

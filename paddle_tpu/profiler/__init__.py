@@ -80,6 +80,15 @@ class _HostEventRecorder:
             from . import host_tracer
 
             out = list(host_tracer.drain())
+        else:
+            from . import host_tracer
+
+            # events recorded through host_tracer's pure-Python fallback
+            # (direct begin/end/emit users while the native lib is
+            # unavailable) merge here; fallback_active() short-circuits
+            # before _load(), so this never triggers the JIT compile
+            if host_tracer.fallback_active():
+                out = list(host_tracer.drain())
         with self._lock:
             for tid, buf in self._all_buffers:
                 out.extend((tid,) + e for e in buf)

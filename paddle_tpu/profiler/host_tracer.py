@@ -4,15 +4,26 @@ paddle/fluid/platform/profiler/host_event_recorder.h).
 
 Event begin/end on the hot path happens in C++ (clock read + vector push);
 Python only interns names once and drains snapshots at profiler stop.
+
+When the native library cannot be loaded (no compiler in the container,
+unsupported platform), a pure-Python :class:`_PyRecorder` takes over with
+the SAME semantics — ``begin``/``end`` gated by the enable flag, ``emit``
+unconditional, per-thread open-range stacks, one shared intern table —
+so host ranges degrade to slower instead of silently vanishing
+(``available()`` still reports only the native path; use
+:func:`fallback_active` to detect the degraded mode).
 """
 from __future__ import annotations
 
 import ctypes
-from typing import List, Tuple
+import threading
+import time
+from typing import Dict, List, Tuple
 
 _lib = None
 _lib_failed = False
 _intern_cache: dict = {}
+_py_recorder = None
 
 
 def _load():
@@ -46,8 +57,86 @@ def _load():
     return _lib
 
 
+class _PyRecorder:
+    """Pure-Python stand-in for host_tracer.cc: same intern-table and
+    per-thread buffer design, one process-wide lock instead of the
+    native per-thread mutexes (the fallback trades hot-path cost for
+    existing at all)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._intern: Dict[str, int] = {}
+        self._names: List[str] = []
+        # tid -> closed events [(name_id, start_ns, end_ns)] / open stack
+        self._events: Dict[int, List[Tuple[int, int, int]]] = {}
+        self._open: Dict[int, List[Tuple[int, int]]] = {}
+        self.enabled = False
+
+    def intern(self, name: str) -> int:
+        with self._lock:
+            nid = self._intern.get(name)
+            if nid is None:
+                nid = len(self._names)
+                self._names.append(name)
+                self._intern[name] = nid
+            return nid
+
+    # begin/end honor the enable gate, exactly like ht_begin/ht_end
+    def begin(self, name_id: int):
+        if not self.enabled:
+            return
+        tid = threading.get_native_id()
+        with self._lock:
+            self._open.setdefault(tid, []).append(
+                (name_id, time.perf_counter_ns()))
+
+    def end(self):
+        if not self.enabled:
+            return
+        tid = threading.get_native_id()
+        with self._lock:
+            stack = self._open.get(tid)
+            if not stack:
+                return
+            name_id, start = stack.pop()
+            self._events.setdefault(tid, []).append(
+                (name_id, start, time.perf_counter_ns()))
+
+    # emit records unconditionally, exactly like ht_emit
+    def emit(self, name_id: int, start_ns: int, end_ns: int):
+        tid = threading.get_native_id()
+        with self._lock:
+            self._events.setdefault(tid, []).append(
+                (name_id, start_ns, end_ns))
+
+    def drain(self) -> List[Tuple[int, str, int, int, str]]:
+        with self._lock:
+            out = [(tid, self._names[nid], s, e, "host")
+                   for tid, events in self._events.items()
+                   for nid, s, e in events]
+            self._events.clear()
+            return out
+
+
+def _fallback() -> _PyRecorder:
+    global _py_recorder
+    if _py_recorder is None:
+        _py_recorder = _PyRecorder()
+        # ids handed out before the load failure belong to no table;
+        # restart interning so fallback ids stay self-consistent
+        _intern_cache.clear()
+    return _py_recorder
+
+
 def available() -> bool:
+    """True only for the NATIVE recorder (the fallback is always
+    available; see :func:`fallback_active`)."""
     return _load() is not None
+
+
+def fallback_active() -> bool:
+    """True once the pure-Python recorder has taken over."""
+    return _py_recorder is not None and _load() is None
 
 
 def intern(name: str) -> int:
@@ -55,8 +144,9 @@ def intern(name: str) -> int:
     if nid is None:
         lib = _load()
         if lib is None:
-            return 0
-        nid = lib.ht_intern(name.encode())
+            nid = _fallback().intern(name)
+        else:
+            nid = lib.ht_intern(name.encode())
         _intern_cache[name] = nid
     return nid
 
@@ -65,31 +155,39 @@ def enable(on: bool = True):
     lib = _load()
     if lib is not None:
         lib.ht_enable(1 if on else 0)
+    else:
+        _fallback().enabled = bool(on)
 
 
 def emit(name: str, start_ns: int, end_ns: int):
     lib = _load()
     if lib is not None:
         lib.ht_emit(intern(name), start_ns, end_ns)
+    else:
+        _fallback().emit(intern(name), start_ns, end_ns)
 
 
 def begin(name: str):
     lib = _load()
     if lib is not None:
         lib.ht_begin(intern(name))
+    else:
+        _fallback().begin(intern(name))
 
 
 def end():
     lib = _load()
     if lib is not None:
         lib.ht_end()
+    else:
+        _fallback().end()
 
 
 def drain() -> List[Tuple[int, str, int, int, str]]:
     """(tid, name, start_ns, end_ns, 'host') tuples, clearing the buffers."""
     lib = _load()
     if lib is None:
-        return []
+        return _fallback().drain()
     n = lib.ht_snapshot()
     out = []
     name_id = ctypes.c_uint32()

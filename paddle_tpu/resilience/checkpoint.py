@@ -40,12 +40,14 @@ import shutil
 import signal
 import sys
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from . import chaos
+from ..observability import registry as _obsreg
 
 __all__ = [
     "CheckpointCorruption",
@@ -177,6 +179,7 @@ class ResilientCheckpointer:
         if not isinstance(state, dict) or not state:
             raise ValueError("state must be a non-empty dict of "
                              "{name: subtree}")
+        t0 = time.perf_counter()
         self._reap_stale_tmp()
         tmp = os.path.join(self.directory,
                            f".tmp-{step}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
@@ -207,6 +210,13 @@ class ResilientCheckpointer:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         self.saves += 1
+        if _obsreg.enabled():
+            reg = _obsreg.get_registry()
+            reg.counter("checkpoint_saves_total",
+                        "checkpoints committed (atomic renames)").inc()
+            reg.histogram("checkpoint_save_seconds",
+                          "stage+fsync+commit wall time per checkpoint"
+                          ).observe(time.perf_counter() - t0)
         chaos.after_save(final)
         self._gc()
         return final
@@ -309,6 +319,11 @@ class ResilientCheckpointer:
                 return step, self._load_verified(step)
             except CheckpointCorruption as e:
                 self.corrupt_skipped += 1
+                if _obsreg.enabled():
+                    _obsreg.get_registry().counter(
+                        "checkpoint_corrupt_skipped_total",
+                        "corrupt checkpoints skipped during restore"
+                    ).inc()
                 print(f"[paddle_tpu.resilience] skipping corrupt "
                       f"checkpoint: {e}", file=sys.stderr)
         return None, None

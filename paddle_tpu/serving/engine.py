@@ -36,6 +36,7 @@ import numpy as np
 from ..models.generation import (_cache_dims, make_paged_decode_step,
                                  make_prefill_step,
                                  normalize_stop_sequences)
+from ..observability import track_compiles, warn_on_retrace
 from .. import profiler
 from .cache import BlockKVPool, PoolExhausted
 from .metrics import ServingMetrics
@@ -61,8 +62,10 @@ class ServingConfig:
     num_blocks: int = 128         # pool size incl. reserved block 0
     max_queue_len: int = 64       # bounded wait queue (backpressure)
     max_model_len: Optional[int] = None   # default: model max positions
-    # raise RuntimeError if the compiled decode step ever retraces after
-    # warmup (the H101-style jit cache-key check; cheap, keep on)
+    # raise (observability.RetraceError, a RuntimeError) if the compiled
+    # decode step ever retraces after warmup — the H101-style jit
+    # cache-key check via observability.warn_on_retrace; cheap, keep on.
+    # When False, retraces are still counted (engine._decode_step.retraces)
     strict_no_retrace: bool = True
 
 
@@ -91,9 +94,18 @@ class Engine:
                                       np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._pending = np.zeros((S,), np.int32)  # next token to decode
-        self._decode_step = make_paged_decode_step(model)
-        self._prefill_step = make_prefill_step(model)
-        self._decode_warm = False
+        # compile accounting wraps both compiled entry points.  The
+        # decode step carries the no-retrace contract: its ONE allowed
+        # compile is this engine's warmup; any cache growth past it seen
+        # through this wrapper is a retrace (the step is cached on the
+        # model, so another engine's entries never count against us).
+        self._decode_step = warn_on_retrace(
+            make_paged_decode_step(model), after=1,
+            label="serving::decode_step",
+            on_retrace="raise" if cfg.strict_no_retrace else "count")
+        # prefill legitimately compiles once per bucketed prompt length
+        self._prefill_step = track_compiles(
+            make_prefill_step(model), label="serving::prefill_step")
         self._finished: Dict[str, Request] = {}
         self._ids = itertools.count()
 
@@ -288,20 +300,6 @@ class Engine:
         self.metrics.on_decode_iteration(
             len(active), self.config.max_batch_size,
             self.pool.utilization())
-        if self.config.strict_no_retrace:
-            # the H101-style cache-key check: the jit cache must not
-            # grow past THIS engine's warmup size (the step is cached on
-            # the model, so another engine config may own other entries)
-            size = self._decode_step._cache_size()
-            if not self._decode_warm:
-                self._warm_cache_size = size
-                self._decode_warm = True
-            elif size > self._warm_cache_size:
-                raise RuntimeError(
-                    f"decode step retraced after warmup (jit cache grew "
-                    f"{self._warm_cache_size}→{size}) — an engine input "
-                    "changed shape/dtype; on TPU this recompiles per "
-                    "token (H101)")
         for req in active:
             slot = req.slot
             # the pending token was written at position lengths[slot]

@@ -10,7 +10,14 @@ preemptions), exportable three ways:
   (request lifecycle spans land in the same chrome trace as the
   framework's host ranges and the XLA device lanes);
 - ``export_chrome(path)`` — standalone chrome://tracing JSON of the
-  recorded request spans when no profiler session was running.
+  recorded request spans when no profiler session was running;
+- the shared ``paddle_tpu.observability`` registry — every lifecycle
+  event is mirrored (``serving_*`` counters/gauges, TTFT/TPOT/queue/e2e
+  latency histograms) whenever telemetry is enabled, so serving shows
+  up in the same Prometheus/JSON exports as training and resilience.
+
+The ``as_dict()`` schema is a contract (README "Serving") and is
+unchanged by the registry mirror.
 """
 from __future__ import annotations
 
@@ -18,6 +25,8 @@ import json
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from ..observability import registry as _obsreg
 
 
 def _now_ns() -> int:
@@ -82,13 +91,27 @@ class ServingMetrics:
         # chrome spans: (name, start_ns, end_ns, category)
         self._spans: List[tuple] = []
 
+    # handles are looked up per event (not cached) so a test calling
+    # ``registry.clear()`` never leaves a mirror pointing at dead metrics
+    @staticmethod
+    def _obs():
+        return _obsreg.get_registry() if _obsreg.enabled() else None
+
     # ------------------------------------------------------- lifecycle
     def on_submit(self, request_id: str):
         self.submitted += 1
         self.requests[request_id] = RequestTimeline(submitted_ns=_now_ns())
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_requests_submitted_total",
+                        "requests submitted to the engine").inc()
 
     def on_reject(self):
         self.rejected += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_requests_rejected_total",
+                        "requests rejected at admission").inc()
 
     def on_admit(self, request_id: str):
         t = self.requests[request_id]
@@ -98,15 +121,32 @@ class ServingMetrics:
         if was == 0:
             self._span(f"queued:{request_id}", t.submitted_ns,
                        t.admitted_ns)
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_prefills_total", "prefill passes").inc()
+            if was == 0:
+                reg.histogram(
+                    "serving_queue_seconds",
+                    "submit-to-first-admission wait").observe(
+                        (t.admitted_ns - t.submitted_ns) / 1e9)
 
     def on_first_token(self, request_id: str):
         t = self.requests[request_id]
         if t.first_token_ns == 0:
             t.first_token_ns = _now_ns()
+            reg = self._obs()
+            if reg is not None:
+                reg.histogram("serving_ttft_seconds",
+                              "time to first token").observe(
+                                  (t.first_token_ns - t.submitted_ns) / 1e9)
 
     def on_preempt(self, request_id: str):
         self.preempted += 1
         self.requests[request_id].preemptions += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_preemptions_total",
+                        "requests preempted out of the batch").inc()
 
     def on_finish(self, request_id: str, tokens: int, reason: str):
         self.completed += 1
@@ -120,6 +160,28 @@ class ServingMetrics:
         t.tokens_generated = tokens
         t.finish_reason = reason
         self._span(f"decode:{request_id}", t.first_token_ns, t.finished_ns)
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_requests_completed_total",
+                        "requests retired, by finish reason").inc(
+                            reason=reason)
+            if reason == "timeout":
+                reg.counter("serving_requests_timed_out_total",
+                            "requests retired past their deadline").inc()
+            elif reason == "error":
+                reg.counter("serving_requests_failed_total",
+                            "requests retired with an error").inc()
+            reg.counter("serving_tokens_generated_total",
+                        "tokens produced by decode").inc(tokens)
+            d = t.to_dict()
+            if d["tpot_s"] is not None:
+                reg.histogram("serving_tpot_seconds",
+                              "time per output token (decode phase)"
+                              ).observe(d["tpot_s"])
+            if d["e2e_s"] is not None:
+                reg.histogram("serving_e2e_seconds",
+                              "submit-to-finish request latency"
+                              ).observe(d["e2e_s"])
 
     def on_decode_iteration(self, active: int, batch_size: int,
                             cache_utilization: float):
@@ -130,6 +192,15 @@ class ServingMetrics:
         self._occupancy_sum += occ
         self._cache_util_sum += cache_utilization
         self._gauge_samples += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_decode_iterations_total",
+                        "decode loop iterations").inc()
+            reg.gauge("serving_batch_occupancy",
+                      "active slots / batch size, last iteration").set(occ)
+            reg.gauge("serving_cache_utilization",
+                      "paged KV cache pages in use, last iteration").set(
+                          cache_utilization)
 
     # --------------------------------------------------------- export
     def _span(self, name: str, start_ns: int, end_ns: int,

@@ -303,6 +303,34 @@ class TestAstLint:
         assert param_names("add") == ["x", "y", "name"]
         assert param_names("einsum") == ["equation", "*operands"]
 
+    # -- L006: dynamic metric names -------------------------------------
+    def test_dynamic_metric_names_L006(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            'Counter(f"requests_{user}_total")\n'
+            'Gauge("occupancy_%s" % slot)\n'
+            'reg.histogram("latency_{}".format(route))\n'
+            'reg.counter("errors_" + kind)\n'
+            'Counter(name=f"x_{rid}")\n')
+        assert [f.code for f in findings] == ["L006"] * 5
+
+    def test_static_metric_names_ok_L006(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/ok.py",
+            'Counter("requests_total")\n'
+            'Gauge("a" + "b")\n'               # constant-folded: static
+            'reg.histogram("latency_seconds")\n'
+            'Counter(some_variable)\n'         # can't prove dynamic
+            # collections.Counter over an iterable is not a metric name
+            'Counter(w for w in words)\n')
+        assert findings == []
+
+    def test_L006_suppression(self, tmp_path):
+        findings = self._lint_src(
+            tmp_path, "paddle_tpu/models/bad.py",
+            'Counter(f"a_{b}")  # lint-tpu: disable=L006\n')
+        assert findings == []
+
 
 class TestDecodeStepHazards:
     """H106: host work inside registered serving decode steps (the

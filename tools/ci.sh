@@ -36,6 +36,7 @@ python examples/export_and_serve.py
 python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py
 python examples/resilient_train.py --steps 8 --kill-at 5
+python examples/observe_train.py --steps 20
 
 echo "== multichip dryrun =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
