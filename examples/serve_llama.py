@@ -7,8 +7,16 @@ and retires them at every decode iteration over one fixed-shape compiled
 step.  Compare the engine's total decode iterations with what serving
 the requests one at a time would cost.
 
-Run:  python examples/serve_llama.py
+With ``--prefix-cache`` the demo switches to a shared-system-prompt
+workload: every request carries the same long prefix, the first
+admission seeds the pool's content-addressed block index, and every
+later admission reuses those blocks — prefilling only its unique tail
+in fixed-shape chunks (ONE compiled prefill program for all lengths).
+
+Run:  python examples/serve_llama.py [--prefix-cache]
 """
+import argparse
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -16,11 +24,7 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import Engine, ServingConfig
 
 
-def main():
-    paddle.seed(0)
-    model = LlamaForCausalLM(LlamaConfig.tiny())
-    model.eval()
-
+def staggered_demo(model):
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, 256, size=(L,)).astype(np.int32)
                for L in (3, 8, 5, 12, 4, 9, 6, 7)]
@@ -52,6 +56,57 @@ def main():
           f"(never retraces)")
     assert iters < sequential
     assert eng.decode_cache_size() == 1
+
+
+def prefix_cache_demo(model):
+    rng = np.random.RandomState(0)
+    system = rng.randint(1, 256, size=(48,)).astype(np.int32)
+    tails = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+             for L in (5, 3, 7, 4, 6, 2)]
+    prompts = [np.concatenate([system, t]) for t in tails]
+
+    eng = Engine(model, ServingConfig(max_batch_size=2, block_size=8,
+                                      num_blocks=64, chunk_tokens=16,
+                                      enable_prefix_cache=True))
+    for prompt in prompts:      # sequential: each sees the warm cache
+        req = eng.submit(prompt, max_new_tokens=8)
+        eng.run_until_complete()
+        print(f"{req.request_id}: prompt={req.prompt_len:2d} "
+              f"cached={req.cached_tokens:2d} "
+              f"prefill_chunks={req.prefill_chunks} "
+              f"-> {req.output_ids()[req.prompt_len:].tolist()}")
+
+    eng.pool.check_leaks()
+    c = eng.stats()["counters"]
+    g = eng.stats()["gauges"]
+    print(f"\nprefix cache: {c['prefix_cache_hits']} hits / "
+          f"{c['prefix_cache_misses']} miss, "
+          f"cached-token ratio {g['prefix_cached_token_ratio']:.2f}, "
+          f"{c['prefill_chunks']} prefill chunks total")
+    print(f"compiled prefill executables: {eng.prefill_cache_size()} "
+          f"(one fixed chunk shape for every prompt length)")
+    # the first request seeds the cache; every other one hits it and
+    # prefills only its tail (48 shared tokens = 6 blocks reused)
+    assert c["prefix_cache_hits"] == len(prompts) - 1
+    assert c["prefix_cache_misses"] == 1
+    assert eng.prefill_cache_size() == 1
+    assert eng._prefill_step.retraces == 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="shared-system-prompt workload exercising the "
+                         "content-addressed prefix cache")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+    if args.prefix_cache:
+        prefix_cache_demo(model)
+    else:
+        staggered_demo(model)
 
 
 if __name__ == "__main__":

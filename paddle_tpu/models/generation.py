@@ -322,6 +322,56 @@ def make_paged_decode_step(model):
     return step
 
 
+def make_chunked_prefill_step(model):
+    """Chunked prefill straight into the paged block pool: ONE fixed
+    chunk shape serves every prompt length, so prefill compiles O(1)
+    programs instead of one per length bucket (each bucket was a new
+    fused XLA program — the compile-cost term PAPERS.md's fusion
+    analysis quantifies).  step(ids[1, C] int32, pools [(k, v)] per
+    layer, block_table[1, max_blocks] int32, start[1] int32,
+    last_index int32 scalar) -> (logits[1, V] f32, new_pools).
+
+    The chunk's tokens occupy absolute positions ``start .. start+C-1``
+    of the sequence; their k/v land in the pool at block offsets through
+    the block table.  ``last_index`` is the TRACED index of the last
+    REAL token within the chunk: positions past it are padding, whose
+    pool writes the model redirects to the reserved garbage block via
+    the validity mask, and whose logits are never returned — the
+    gathered row is always the last real one, so the final chunk of a
+    prompt yields the first generated token.  Both ``start`` and
+    ``last_index`` are traced, so every chunk of every prompt hits the
+    SAME executable (the serving engine asserts this via
+    ``warn_on_retrace``)."""
+    step = getattr(model, "_chunked_prefill_step", None)
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_chunked_prefill_step_fp", None)):
+        return step
+    fp = _weights_fingerprint(model)
+
+    from .llama import PagedKVCache
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    @register_decode_step
+    def step(ids, pools, block_table, start, last_index):
+        with no_grad_ctx():
+            wrapped = [PagedKVCache(k, v, block_table) for k, v in pools]
+            valid = (jnp.arange(ids.shape[1]) <= last_index)[None, :]
+            logits, new_caches = model(Tensor(ids),
+                                       attn_mask=Tensor(valid),
+                                       caches=wrapped,
+                                       position_offset=start)
+            last = jax.lax.dynamic_index_in_dim(
+                logits._value, last_index, axis=1, keepdims=False)
+            return (last.astype(jnp.float32),
+                    [(c.k, c.v) for c in new_caches])
+
+    model._chunked_prefill_step = step
+    model._chunked_prefill_step_fp = fp
+    return step
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
              eos_token_id=None, seed=None, use_static_cache=False,

@@ -251,24 +251,42 @@ class LlamaAttention(nn.Layer):
         k = apply("rope", _rope_fn, k)
 
         if isinstance(cache, PagedKVCache):
-            # serving decode: T == 1, position_offset is a [B] vector of
-            # per-sequence frontiers.  Write this token's k/v into each
-            # sequence's current block, then attend over the gathered
-            # block views — all fixed shapes, one executable forever.
-            assert T == 1, "PagedKVCache supports single-token decode only"
+            # serving decode (T == 1) or a chunked-prefill chunk (T ==
+            # chunk size): position_offset is a [B] vector of
+            # per-sequence frontiers.  Write the chunk's k/v into each
+            # sequence's blocks, then attend over the gathered block
+            # views — all fixed shapes, one executable forever.  When
+            # attn_mask is given it is the [B, T] WRITE-VALIDITY mask of
+            # a padded chunk: padded positions scatter into the reserved
+            # garbage block 0 instead of a live block, and causal
+            # masking hides them from attention (their rope/score junk
+            # is never read by a real query).
             bs = cache.k.shape[1]
             bt = cache.block_table
             offsets = jnp.asarray(position_offset)
+            pos = offsets[:, None] + jnp.arange(T)          # [B, T]
+            wmask = None
+            if attn_mask is not None:
+                m = attn_mask._value if isinstance(attn_mask, Tensor) \
+                    else attn_mask
+                wmask = jnp.asarray(m).astype(bool)         # [B, T]
 
             def _scatter(pool, new):
-                # pool [nb, bs, kvh, hd]; new [B, 1, kvh, hd] → flat row
-                # index block_table[b, off//bs]*bs + off%bs per sequence
+                # pool [nb, bs, kvh, hd]; new [B, T, kvh, hd] → flat row
+                # index block_table[b, pos//bs]*bs + pos%bs per position.
+                # The column clamp keeps padded positions past the table
+                # width in range (their write is already redirected to
+                # garbage by wmask before it could land anywhere real).
                 nb = pool.shape[0]
-                rows = jnp.arange(bt.shape[0])
-                blk = bt[rows, offsets // bs]
-                idx = blk * bs + offsets % bs
+                rows = jnp.arange(bt.shape[0])[:, None]
+                col = jnp.minimum(pos // bs, bt.shape[1] - 1)
+                idx = bt[rows, col] * bs + pos % bs         # [B, T]
+                if wmask is not None:
+                    idx = jnp.where(wmask, idx, 0)
                 flat = pool.reshape(nb * bs, pool.shape[2], pool.shape[3])
-                flat = flat.at[idx].set(new[:, 0].astype(pool.dtype))
+                flat = flat.at[idx.reshape(-1)].set(
+                    new.reshape(-1, new.shape[2],
+                                new.shape[3]).astype(pool.dtype))
                 return flat.reshape(pool.shape)
 
             k_pool = apply("paged_kv_update", _scatter, Tensor(cache.k), k)
@@ -291,7 +309,7 @@ class LlamaAttention(nn.Layer):
                     "bthd,bshd->bhts", qv, kb,
                     preferred_element_type=jnp.float32)
                 scores = scores / math.sqrt(self.head_dim)
-                q_pos = offsets[:, None] + jnp.arange(qv.shape[1])  # [B, 1]
+                q_pos = pos                                 # [B, T]
                 k_pos = jnp.arange(kb.shape[1])
                 valid = k_pos[None, None, :] <= q_pos[:, :, None]
                 scores = jnp.where(valid[:, None], scores, -1e30)

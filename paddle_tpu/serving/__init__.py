@@ -34,7 +34,7 @@ from .cache import BlockKVPool, PoolExhausted
 from .endpoint import Endpoint
 from .engine import Engine, ServingConfig
 from .metrics import RequestTimeline, ServingMetrics
-from .scheduler import (FINISHED, PREEMPTED, QUEUED, RUNNING,
+from .scheduler import (FINISHED, PREEMPTED, PREFILLING, QUEUED, RUNNING,
                         AdmissionError, Request, Scheduler)
 
 __all__ = [
@@ -49,6 +49,7 @@ __all__ = [
     "ServingMetrics",
     "RequestTimeline",
     "QUEUED",
+    "PREFILLING",
     "RUNNING",
     "PREEMPTED",
     "FINISHED",

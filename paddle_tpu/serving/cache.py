@@ -1,17 +1,40 @@
 # lint-tpu: disable-file=L004 -- serving owns the block-pool device
 # buffers directly (like models/); new backend code belongs under core/
 # ops/ kernels/ static/ distributed/ (README: Repo lint)
-"""Block-based KV-cache pool (PAPERS.md: vLLM's PagedAttention memory
-manager, layered on models/llama.py StaticKVCache semantics).
+"""Block-based KV-cache pool with content-addressed prefix caching
+(PAPERS.md: vLLM's PagedAttention memory manager + RadixAttention-style
+prefix reuse, layered on models/llama.py PagedKVCache semantics).
 
 The pool owns per-layer (k, v) device buffers of shape
 ``[num_blocks, block_size, kv_heads, head_dim]``.  Sequences own
 BLOCKS, not contiguous buffer ranges: a free-list allocator hands out
 ``block_size``-token blocks one at a time as a sequence's frontier
 grows, so cache capacity is packed at block granularity instead of
-being reserved at worst-case length per request — the memory headroom
-that lets continuous batching run many more concurrent sequences than
-``max_batch * max_len`` preallocation would.
+being reserved at worst-case length per request.
+
+Prefix caching adds three structures on top of the free list:
+
+- **refcounts** — ``_owners[block]`` is the SET of request ids holding
+  the block, so two requests sharing a system prompt reference the same
+  physical blocks (``free`` decrements; the block is recycled only when
+  the last owner lets go);
+- **chained content hashes** — a full block of prompt tokens is indexed
+  by ``hash(parent_hash || block token ids)``, so a block's identity
+  encodes its whole prefix: matching block i implies blocks 0..i-1
+  matched too, exactly the chain vLLM/SGLang key their prefix caches
+  on.  Only FULL blocks are ever registered (a partial tail is private
+  to its request);
+- **LRU eviction** — a block whose last owner releases it but whose
+  content is still indexed parks in an LRU list instead of the free
+  list.  It stays matchable for free until ``allocate`` runs dry, at
+  which point the least-recently-parked cached block is evicted (index
+  entry dropped) and recycled.  Live-referenced blocks are NEVER
+  eviction candidates.
+
+Registered blocks are IMMUTABLE: a request that must write inside one
+(shared decode tail, or recomputing the last token of a fully-cached
+prompt) first breaks the share with :meth:`ensure_writable` — a
+copy-on-write device copy into a private block.
 
 Block 0 is a reserved garbage sink: idle engine slots decode with
 block-table entries pointing at it, so the compiled step never needs a
@@ -20,19 +43,23 @@ attention masks it, and the hot loop stays device-resident — H106).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class PoolExhausted(Exception):
-    """No free blocks: the caller must preempt or wait."""
+    """No free or evictable blocks: the caller must preempt or wait."""
 
 
 class BlockKVPool:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
-                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 enable_prefix_cache: bool = True):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the reserved "
                              "garbage sink)")
@@ -42,6 +69,7 @@ class BlockKVPool:
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.enable_prefix_cache = enable_prefix_cache
         z = jnp.zeros((num_blocks, block_size, kv_heads, head_dim), dtype)
         # per-layer (k, v) physical pools — the arrays handed to the
         # compiled decode step and rebound to its outputs every token
@@ -49,7 +77,17 @@ class BlockKVPool:
             (z, z) for _ in range(num_layers)]
         # LIFO free list over blocks 1..n-1 (block 0 reserved)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._owner: Dict[int, object] = {}   # block id -> request id
+        # block id -> set of owning request ids (refcount = len)
+        self._owners: Dict[int, Set] = {}
+        # content index: chain hash -> block id, and its reverse.
+        # Invariant: b in _block_hash  <=>  _hash_index[_block_hash[b]] == b
+        self._hash_index: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        # refcount-0 blocks still holding indexed content, oldest first —
+        # matchable for free, evictable when the free list runs dry
+        self._cached_free: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
+        self.cow_copies = 0
 
     # ------------------------------------------------------- accounting
     @property
@@ -59,11 +97,19 @@ class BlockKVPool:
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Blocks allocatable RIGHT NOW: truly free plus cached-but-
+        unreferenced (the latter evict on demand)."""
+        return len(self._free) + len(self._cached_free)
 
     @property
     def num_used(self) -> int:
-        return self.capacity_blocks - len(self._free)
+        """Blocks referenced by at least one live request."""
+        return self.capacity_blocks - self.num_free
+
+    @property
+    def num_cached(self) -> int:
+        """Unreferenced blocks kept alive only by the prefix index."""
+        return len(self._cached_free)
 
     def utilization(self) -> float:
         return self.num_used / self.capacity_blocks
@@ -73,71 +119,234 @@ class BlockKVPool:
         return -(-int(num_tokens) // self.block_size)
 
     def can_allocate(self, n: int) -> bool:
-        return len(self._free) >= n
+        return self.num_free >= n
 
     def owned_by(self, request_id) -> List[int]:
-        return [b for b, o in self._owner.items() if o == request_id]
+        return [b for b, o in self._owners.items() if request_id in o]
+
+    def refcount(self, block: int) -> int:
+        return len(self._owners.get(block, ()))
+
+    def is_shared(self, block: int) -> bool:
+        """True when a write into ``block`` would be observable outside
+        the writing request: another owner holds it, or the prefix index
+        still advertises its content to future requests."""
+        return len(self._owners.get(block, ())) > 1 \
+            or block in self._block_hash
 
     # ------------------------------------------------------- allocation
     def allocate(self, request_id, n: int = 1) -> List[int]:
-        if len(self._free) < n:
+        """Hand ``n`` private blocks to ``request_id``, evicting LRU
+        cached blocks if the free list alone cannot cover the request.
+        Raises :class:`PoolExhausted` (allocating nothing) otherwise."""
+        if self.num_free < n:
             raise PoolExhausted(
-                f"need {n} block(s), {len(self._free)} free "
+                f"need {n} block(s), {len(self._free)} free + "
+                f"{len(self._cached_free)} evictable "
                 f"(capacity {self.capacity_blocks})")
-        blocks = [self._free.pop() for _ in range(n)]
-        for b in blocks:
-            self._owner[b] = request_id
+        blocks = []
+        for _ in range(n):
+            b = self._free.pop() if self._free else self._evict_lru()
+            self._owners[b] = {request_id}
+            blocks.append(b)
         return blocks
 
-    def free(self, blocks: Sequence[int]):
-        for b in blocks:
-            owner = self._owner.pop(b, None)
-            if owner is None:
-                raise ValueError(f"double free of block {b}")
+    def _evict_lru(self) -> int:
+        """Drop the least-recently-parked cached block from the prefix
+        index and recycle it.  Only refcount-0 blocks ever sit in
+        ``_cached_free``, so a live request's block can never be chosen."""
+        b, _ = self._cached_free.popitem(last=False)
+        h = self._block_hash.pop(b, None)
+        if h is not None and self._hash_index.get(h) == b:
+            del self._hash_index[h]
+        self.evictions += 1
+        return b
+
+    def _release_block(self, b: int):
+        """Last owner gone: park indexed content in the LRU, recycle the
+        rest."""
+        self._owners.pop(b, None)
+        if self.enable_prefix_cache and b in self._block_hash:
+            self._cached_free[b] = None     # LRU tail = most recent
+        else:
             self._free.append(b)
 
+    def free(self, blocks: Sequence[int], request_id=None):
+        """Drop ``request_id``'s reference on each block (refcount
+        decrement); a block with no owners left is recycled.  Without a
+        ``request_id`` the block must be singly-owned (the pre-refcount
+        call shape); freeing a block the id does not own — or freeing an
+        unowned block — is the classic double free, reported with the
+        CURRENT owner set to ease debugging."""
+        for b in blocks:
+            owners = self._owners.get(b)
+            if owners is None:
+                raise ValueError(
+                    f"double free of block {b} (no current owner)")
+            if request_id is None:
+                if len(owners) > 1:
+                    raise ValueError(
+                        f"block {b} is shared (owned by "
+                        f"{sorted(map(str, owners))}); "
+                        f"free(..., request_id=...) required")
+                owners.clear()
+            else:
+                if request_id not in owners:
+                    raise ValueError(
+                        f"double free of block {b} by {request_id!r} "
+                        f"(owned by {sorted(map(str, owners))})")
+                owners.discard(request_id)
+            if not owners:
+                self._release_block(b)
+
     def free_request(self, request_id):
-        self.free(self.owned_by(request_id))
+        """Release every block ``request_id`` references.  A request
+        owning nothing (never prefilled, or already released) is a safe
+        no-op — retire paths call this unconditionally.
+
+        Blocks release in REVERSE acquisition order, so a prompt
+        chain's tail blocks park in the LRU before its head: under
+        pressure eviction then consumes leaves first, and the head —
+        which ANY extension of the prefix can reuse, where a tail only
+        serves exact matches — survives longest (the radix-tree
+        leaf-first eviction order of the prefix-caching literature)."""
+        blocks = self.owned_by(request_id)
+        if not blocks:
+            return
+        self.free(list(reversed(blocks)), request_id)
 
     def check_leaks(self):
-        """Raise if any block is still owned — used by tests and engine
-        shutdown to prove the free-list round-trips."""
-        if self._owner:
+        """Raise if any block is still owned by a request — used by
+        tests and engine shutdown to prove references round-trip.
+        Cached-but-unreferenced blocks are NOT leaks (they are
+        reclaimable on demand)."""
+        if self._owners:
             raise AssertionError(
-                f"leaked blocks: {sorted(self._owner.items())}")
+                "leaked blocks: "
+                f"{sorted((b, sorted(map(str, o))) for b, o in self._owners.items())}")
 
-    # ------------------------------------------------------ device data
-    def install_prefill(self, blocks: Sequence[int], prefill_caches):
-        """Copy a prompt's prefilled StaticKVCache buffers
-        (``[(k, v)]`` per layer, each ``[1, len(blocks)*block_size, kv,
-        hd]``) into the owned pool blocks.  Shapes vary only with
-        ``len(blocks)``, so jit holds one executable per prompt-block
-        count (prefill-side; the decode step itself never retraces)."""
-        idx = jnp.asarray(list(blocks), jnp.int32)
-        new = _install_impl(tuple(self.layers),
-                            tuple((k, v) for k, v in prefill_caches), idx)
+    # ---------------------------------------------------- prefix cache
+    @staticmethod
+    def _chain_hash(parent: bytes, tokens: np.ndarray) -> bytes:
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    def hash_chain(self, tokens) -> List[bytes]:
+        """Chained content hashes of every FULL block of ``tokens``:
+        ``chain[i] = H(chain[i-1] || tokens[i*bs:(i+1)*bs])``.  A match
+        on chain[i] therefore implies the entire prefix matched."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        out: List[bytes] = []
+        parent = b""
+        for i in range(len(tokens) // bs):
+            parent = self._chain_hash(parent, tokens[i * bs:(i + 1) * bs])
+            out.append(parent)
+        return out
+
+    def match_prefix(self, tokens) -> List[int]:
+        """Longest indexed prefix of ``tokens``, as a block-id list
+        (full blocks only; stops at the first miss).  Pure lookup: no
+        refcounts move until :meth:`acquire`."""
+        if not self.enable_prefix_cache:
+            return []
+        out: List[int] = []
+        for h in self.hash_chain(tokens):
+            b = self._hash_index.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def acquire(self, request_id, blocks: Sequence[int]):
+        """Add ``request_id``'s reference to already-populated blocks
+        (a prefix-cache hit).  Blocks parked in the LRU come back to
+        life; blocks some other request still owns just gain an owner."""
+        for b in blocks:
+            owners = self._owners.get(b)
+            if owners is not None:
+                owners.add(request_id)
+            elif b in self._cached_free:
+                del self._cached_free[b]
+                self._owners[b] = {request_id}
+            else:
+                raise ValueError(
+                    f"cannot acquire block {b}: neither owned nor cached")
+
+    def register_prefix(self, request_id, tokens, blocks: Sequence[int]
+                        ) -> int:
+        """Index ``request_id``'s prompt blocks by content so future
+        prompts can reuse them.  Dedupes against existing entries (first
+        writer wins — identical content, either block serves) and skips
+        blocks the request does not own (defensive: CoW may have
+        retired them mid-prefill).  Returns how many entries were added.
+        Registered blocks become immutable until evicted."""
+        if not self.enable_prefix_cache:
+            return 0
+        added = 0
+        for h, b in zip(self.hash_chain(tokens), blocks):
+            if h in self._hash_index or b in self._block_hash:
+                continue
+            owners = self._owners.get(b)
+            if owners is None or request_id not in owners:
+                continue
+            self._hash_index[h] = b
+            self._block_hash[b] = h
+            added += 1
+        return added
+
+    def ensure_writable(self, request_id, block: int) -> int:
+        """Copy-on-write guard: return a block ``request_id`` may write
+        in place — ``block`` itself when exclusively owned and not in
+        the prefix index, otherwise a fresh private copy (device copy of
+        all layers; the request's reference moves to the copy)."""
+        owners = self._owners.get(block)
+        if owners is None or request_id not in owners:
+            raise ValueError(
+                f"{request_id!r} does not own block {block}")
+        if len(owners) == 1 and block not in self._block_hash:
+            return block
+        new = self.allocate(request_id, 1)[0]
+        self._copy_block(block, new)
+        owners.discard(request_id)
+        if not owners:
+            self._release_block(block)
+        self.cow_copies += 1
+        return new
+
+    def _copy_block(self, src: int, dst: int):
+        new = _copy_block_impl(tuple(self.layers), np.int32(src),
+                               np.int32(dst))
         self.layers = [(k, v) for k, v in new]
+
+    def admission_plan(self, tokens, extra_tokens: int = 1):
+        """Admission-control view of one prompt: ``(matched_blocks,
+        new_blocks_needed, feasible_now)``.  Matched blocks that sit in
+        the evictable LRU are NOT double-counted as allocatable — the
+        hit consumes them."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        matched = self.match_prefix(tokens)
+        need = self.blocks_for(len(tokens) + extra_tokens) - len(matched)
+        need = max(need, 0)
+        from_lru = sum(1 for b in matched if b in self._cached_free)
+        return matched, need, need <= self.num_free - from_lru
 
     def stats(self) -> dict:
         return {
             "capacity_blocks": self.capacity_blocks,
             "used_blocks": self.num_used,
             "free_blocks": self.num_free,
+            "cached_blocks": self.num_cached,
             "block_size": self.block_size,
             "utilization": round(self.utilization(), 4),
+            "prefix_evictions": self.evictions,
+            "cow_copies": self.cow_copies,
         }
 
 
 @jax.jit
-def _install_impl(layers, prefill, idx):
-    out = []
-    for (pk, pv), (fk, fv) in zip(layers, prefill):
-        n = idx.shape[0]
-        bs = pk.shape[1]
-        out.append((
-            pk.at[idx].set(fk[0].reshape(n, bs, fk.shape[2], fk.shape[3])
-                           .astype(pk.dtype)),
-            pv.at[idx].set(fv[0].reshape(n, bs, fv.shape[2], fv.shape[3])
-                           .astype(pv.dtype)),
-        ))
-    return out
+def _copy_block_impl(layers, src, dst):
+    # one executable per pool geometry: src/dst ride in as traced scalars
+    return [(k.at[dst].set(k[src]), v.at[dst].set(v[src]))
+            for k, v in layers]

@@ -2,25 +2,30 @@
 # prefill steps over raw device buffers (like models/); new backend code
 # belongs under core/ ops/ kernels/ static/ distributed/ (README: Repo lint)
 """Continuous-batching inference engine (PAPERS.md: Orca's
-iteration-level scheduling + vLLM's paged KV cache) over the compiled
-steps of models/generation.py.
+iteration-level scheduling + vLLM's paged KV cache + Sarathi-style
+chunked prefill) over the compiled steps of models/generation.py.
 
 The engine keeps a fixed BUCKET of ``max_batch_size`` decode slots.
 Every iteration it (1) retires finished sequences, (2) admits waiting
-requests into free slots — one compiled prefill per prompt, bucketed to
-block multiples — and (3) runs ONE compiled decode step over the whole
-bucket: token ids [S, 1], the shared block pools, block tables
-[S, max_blocks] and per-slot frontiers [S].  Because every array shape
-is fixed by the config, the decode step compiles exactly once; idle
-slots decode into the reserved garbage block instead of branching.
-Requests therefore enter and leave at TOKEN granularity — no
-batch-completion barrier, which is what turns the static decode step
-into a serving engine.
+requests into free slots — attaching any prefix-cached blocks of the
+prompt and allocating only the uncached suffix — (3) advances admitted
+prompts by fixed-size prefill CHUNKS under a per-iteration token
+budget, and (4) runs ONE compiled decode step over the whole bucket:
+token ids [S, 1], the shared block pools, block tables [S, max_blocks]
+and per-slot frontiers [S].  Because every array shape is fixed by the
+config — including the prefill chunk's — the decode step AND the
+prefill step each compile exactly once; idle slots decode into the
+reserved garbage block instead of branching, and mid-prefill slots are
+masked out of the decode view the same way.  Requests therefore enter
+and leave at TOKEN granularity, and a long prompt no longer stalls
+running requests for its whole prefill — it yields the iteration back
+to decode after each chunk.
 
 Correctness contract: greedy outputs are token-exact with sequential
 ``generate()`` for the same prompts (tests/test_serving.py), including
 across preemption (recompute-from-prompt is deterministic under
-greedy).
+greedy), with the prefix cache on or off (shared blocks hold the exact
+bits a fresh prefill would produce; copy-on-write keeps them immutable).
 """
 from __future__ import annotations
 
@@ -30,18 +35,17 @@ from typing import Dict, List, Optional
 
 import contextlib
 
-import jax.numpy as jnp
 import numpy as np
 
-from ..models.generation import (_cache_dims, make_paged_decode_step,
-                                 make_prefill_step,
+from ..models.generation import (_cache_dims, make_chunked_prefill_step,
+                                 make_paged_decode_step,
                                  normalize_stop_sequences)
-from ..observability import track_compiles, warn_on_retrace
+from ..observability import warn_on_retrace
 from .. import profiler
 from .cache import BlockKVPool, PoolExhausted
 from .metrics import ServingMetrics
-from .scheduler import (FINISHED, RUNNING, AdmissionError, Request,
-                        Scheduler)
+from .scheduler import (FINISHED, PREFILLING, RUNNING, AdmissionError,
+                        Request, Scheduler)
 
 
 def _trace(name: str):
@@ -62,6 +66,16 @@ class ServingConfig:
     num_blocks: int = 128         # pool size incl. reserved block 0
     max_queue_len: int = 64       # bounded wait queue (backpressure)
     max_model_len: Optional[int] = None   # default: model max positions
+    # prefill chunk size in tokens: every prompt prefills as fixed
+    # [1, chunk_tokens] chunks, so prefill holds ONE compiled program
+    # for all prompt lengths (clamped to max_model_len)
+    chunk_tokens: int = 256
+    # content-addressed KV block reuse across requests sharing a prompt
+    # prefix (block-granular; LRU eviction of unreferenced blocks)
+    enable_prefix_cache: bool = True
+    # max prefill tokens computed per engine iteration before decode
+    # runs again (Sarathi-style interleave); None = one chunk's worth
+    prefill_token_budget: Optional[int] = None
     # raise (observability.RetraceError, a RuntimeError) if the compiled
     # decode step ever retraces after warmup — the H101-style jit
     # cache-key check via observability.warn_on_retrace; cheap, keep on.
@@ -82,9 +96,12 @@ class Engine:
             cfg.max_model_len or model_max or 1 << 30,
             model_max or 1 << 30)
         self.max_blocks_per_seq = -(-self.max_model_len // cfg.block_size)
+        self.chunk_tokens = max(1, min(cfg.chunk_tokens,
+                                       self.max_model_len))
         self.pool = BlockKVPool(
             model.config.num_hidden_layers, cfg.num_blocks, cfg.block_size,
-            kv_heads, head_dim, dtype)
+            kv_heads, head_dim, dtype,
+            enable_prefix_cache=cfg.enable_prefix_cache)
         self.scheduler = Scheduler(self.pool,
                                    max_queue_len=cfg.max_queue_len)
         self.metrics = ServingMetrics()
@@ -94,20 +111,25 @@ class Engine:
                                       np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._pending = np.zeros((S,), np.int32)  # next token to decode
-        # compile accounting wraps both compiled entry points.  The
-        # decode step carries the no-retrace contract: its ONE allowed
+        # compile accounting wraps both compiled entry points, and BOTH
+        # carry the no-retrace contract now: each one's single allowed
         # compile is this engine's warmup; any cache growth past it seen
-        # through this wrapper is a retrace (the step is cached on the
-        # model, so another engine's entries never count against us).
+        # through these wrappers is a retrace (the steps are cached on
+        # the model, so another engine's entries never count against us).
+        # Chunked prefill earns its wrapper by construction — one fixed
+        # [1, chunk_tokens] shape for EVERY prompt length, where the old
+        # bucketed prefill compiled one program per length bucket.
         self._decode_step = warn_on_retrace(
             make_paged_decode_step(model), after=1,
             label="serving::decode_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
-        # prefill legitimately compiles once per bucketed prompt length
-        self._prefill_step = track_compiles(
-            make_prefill_step(model), label="serving::prefill_step")
+        self._prefill_step = warn_on_retrace(
+            make_chunked_prefill_step(model), after=1,
+            label="serving::prefill_step",
+            on_retrace="raise" if cfg.strict_no_retrace else "count")
         self._finished: Dict[str, Request] = {}
         self._ids = itertools.count()
+        self._evictions_seen = 0    # pool counter already mirrored
 
     # ----------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -124,8 +146,8 @@ class Engine:
         ``deadline_s`` is a wall-clock SLO measured from submission:
         once exceeded the request is retired with
         ``finish_reason="timeout"`` (partial tokens kept) — whether it
-        is still queued or mid-decode — instead of occupying a slot
-        other requests could use.
+        is still queued, mid-prefill, or mid-decode — instead of
+        occupying a slot other requests could use.
 
         ``temperature``/``do_sample`` exist for ``generate()`` call-site
         parity only: the engine decodes greedily (one shared compiled
@@ -164,12 +186,15 @@ class Engine:
 
     # ------------------------------------------------------------- step
     def step(self) -> bool:
-        """One engine iteration: retire/admit at token granularity, then
-        one compiled decode step over the bucket.  Returns True while
-        there is work left (running or waiting)."""
+        """One engine iteration: retire/admit at token granularity,
+        advance admitted prompts by prefill chunks under the token
+        budget, then one compiled decode step over the bucket.  Returns
+        True while there is work left (running, prefilling or waiting)."""
         self._admit()
-        if any(r is not None for r in self._slots):
+        self._prefill_tick()
+        if any(r is not None and r.state == RUNNING for r in self._slots):
             self._decode_iteration()
+        self._sync_pool_metrics()
         return self.has_work()
 
     def has_work(self) -> bool:
@@ -203,59 +228,145 @@ class Engine:
             req = self.scheduler.next_admittable()
             if req is None:
                 break
-            self._prefill(req, free_slots.pop(0))
+            if not self._begin_prefill(req, free_slots[0]):
+                break
+            free_slots.pop(0)
 
-    def _prefill(self, req: Request, slot: int):
+    def _begin_prefill(self, req: Request, slot: int) -> bool:
+        """Admit ``req`` into ``slot``: attach prefix-cached blocks of
+        its prompt (refcount bump, zero compute), allocate blocks for
+        the uncached suffix, and mark it PREFILLING — chunks run in
+        ``_prefill_tick``.  At least the prompt's LAST token is always
+        recomputed, cached or not: its logits row is the first generated
+        token, which cached k/v alone cannot produce."""
+        matched, need, _ = self.pool.admission_plan(req.prompt,
+                                                    extra_tokens=0)
         bs = self.config.block_size
+        cached_len = min(len(matched) * bs, req.prompt_len - 1)
+        matched = matched[:self.pool.blocks_for(cached_len)] \
+            if cached_len else []
+        self.pool.acquire(req.request_id, matched)
         n = self.pool.blocks_for(req.prompt_len)
-        blocks = self.pool.allocate(req.request_id, n)
-        self.metrics.on_admit(req.request_id)
         try:
-            from ..resilience import chaos
-
-            chaos.maybe_fail_request(req.request_id)
-            with _trace(f"serving::prefill:{req.request_id}"):
-                ids = np.zeros((1, n * bs), np.int32)
-                ids[0, :req.prompt_len] = req.prompt
-                z = jnp.zeros((1, n * bs, self.pool.kv_heads,
-                               self.pool.head_dim), self.pool.dtype)
-                caches = [(z, z) for _ in range(self.pool.num_layers)]
-                last, caches = self._prefill_step(
-                    ids, caches, np.int32(req.prompt_len - 1))
-                self.pool.install_prefill(blocks, caches)
-            first_tok = int(np.argmax(np.asarray(last)[0]))
-        except Exception as e:  # noqa: BLE001 — poison-request isolation
-            # ONE malformed request must not kill the engine loop: fail
-            # and retire it, free its blocks, keep serving the rest
-            req.error = f"{type(e).__name__}: {e}"
-            self._retire(req, "error")
-            return
-        req.state = RUNNING
+            suffix = self.pool.allocate(req.request_id, n - len(matched))
+        except PoolExhausted:
+            # defensive (admission_plan just said yes): hand the blocks
+            # back and put the request at the head of the queue
+            self.pool.free_request(req.request_id)
+            self.scheduler.requeue_preempted(req)
+            return False
+        blocks = matched + suffix
+        req.state = PREFILLING
         req.slot = slot
         req.blocks = blocks
-        req.generated = [first_tok]
+        req.prefill_pos = cached_len
+        req.cached_tokens = cached_len
+        req.prefill_chunks = 0
         self.scheduler.running.append(req)
-        self.metrics.on_first_token(req.request_id)
         self._slots[slot] = req
         self._block_tables[slot] = 0
-        self._block_tables[slot, :n] = blocks
-        self._lengths[slot] = req.prompt_len
-        self._pending[slot] = first_tok
+        self._block_tables[slot, :len(blocks)] = blocks
+        # frontier/pending stay 0 until the prompt completes: the decode
+        # view masks this slot's block table to the garbage block
+        self._lengths[slot] = 0
+        self._pending[slot] = 0
+        self.metrics.on_admit(req.request_id)
+        self.metrics.on_prefix_lookup(req.request_id, cached_len,
+                                      req.prompt_len)
+        return True
+
+    def _prefill_tick(self):
+        """Advance PREFILLING requests by fixed-shape chunks, oldest
+        first, until the per-iteration token budget runs out (at least
+        one chunk always runs so prefill can never stall).  A request
+        whose final chunk completes gets its first token here and joins
+        the decode bucket this same iteration."""
+        budget = self.config.prefill_token_budget or self.chunk_tokens
+        prefilling = sorted(
+            (r for r in self.scheduler.running if r.state == PREFILLING),
+            key=lambda r: r.ordinal)
+        for req in prefilling:
+            if budget <= 0:
+                break
+            while budget > 0 and req.state == PREFILLING:
+                if req.expired():
+                    self._retire(req, "timeout")
+                    break
+                try:
+                    from ..resilience import chaos
+
+                    chaos.maybe_fail_request(req.request_id)
+                    with _trace(f"serving::prefill:{req.request_id}"):
+                        self._prefill_chunk(req)
+                except Exception as e:  # noqa: BLE001 — poison isolation
+                    # ONE malformed request must not kill the engine
+                    # loop: fail and retire it, free its blocks, keep
+                    # serving the rest
+                    req.error = f"{type(e).__name__}: {e}"
+                    self._retire(req, "error")
+                    break
+                budget -= self.chunk_tokens
+
+    def _prefill_chunk(self, req: Request):
+        """Run ONE [1, chunk_tokens] compiled prefill chunk for ``req``
+        at its current prompt position, copy-on-write-protecting every
+        block the chunk writes into."""
+        bs = self.config.block_size
+        C = self.chunk_tokens
+        start = req.prefill_pos
+        n_tok = min(C, req.prompt_len - start)
+        # blocks this chunk writes: CoW any that are shared/registered
+        # (a cache hit whose last block the final recompute token lands
+        # in, or blocks registered by a previous admission)
+        for bi in range(start // bs,
+                        self.pool.blocks_for(start + n_tok)):
+            new = self.pool.ensure_writable(req.request_id,
+                                            req.blocks[bi])
+            if new != req.blocks[bi]:
+                req.blocks[bi] = new
+                self._block_tables[req.slot, bi] = new
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n_tok] = req.prompt[start:start + n_tok]
+        bt = self._block_tables[req.slot:req.slot + 1]
+        last, new_pools = self._prefill_step(
+            ids, self.pool.layers, bt,
+            np.asarray([start], np.int32), np.int32(n_tok - 1))
+        self.pool.layers = [(k, v) for k, v in new_pools]
+        req.prefill_pos = start + n_tok
+        req.prefill_chunks += 1
+        if req.prefill_pos < req.prompt_len:
+            return
+        # prompt complete: the last chunk's logits row IS the first token
+        first_tok = int(np.argmax(np.asarray(last)[0]))
+        req.state = RUNNING
+        req.generated = [first_tok]
+        self._lengths[req.slot] = req.prompt_len
+        self._pending[req.slot] = first_tok
+        self.metrics.on_first_token(req.request_id)
+        self.metrics.on_prefill_complete(req.request_id,
+                                         req.prefill_chunks)
+        # publish the prompt's full blocks for future prefix hits (they
+        # become immutable; the decode frontier CoWs out as needed)
+        self.pool.register_prefix(req.request_id, req.prompt, req.blocks)
         # the prefill's token may already terminate the request
         self._maybe_retire(req)
 
     # ---------------------------------------------------------- decode
     def _ensure_blocks(self):
-        """Every live slot needs a block for its next write position;
-        allocate, preempting YOUNGEST-first when the pool is dry —
+        """Every RUNNING slot needs a WRITABLE block for its next write
+        position: allocate when the frontier crosses into a new block,
+        copy-on-write when it sits in a block the prefix cache shares.
+        Allocation preempts YOUNGEST-first when the pool is dry —
         oldest first, so a starving old request evicts young ones, never
         the reverse (a young request that cannot get a block preempts
         ITSELF before touching older work)."""
         for req in sorted(self.scheduler.running,
                           key=lambda r: r.ordinal):
-            if req.slot is None:        # preempted earlier in this pass
+            if req.slot is None or req.state != RUNNING:
                 continue
-            need = self.pool.blocks_for(int(self._lengths[req.slot]) + 1)
+            pos = int(self._lengths[req.slot])
+            need = self.pool.blocks_for(pos + 1)
+            preempted = False
             while len(req.blocks) < need:
                 try:
                     new = self.pool.allocate(req.request_id, 1)
@@ -267,10 +378,36 @@ class Engine:
                         raise
                     self._preempt(victim)
                     if victim is req:
+                        preempted = True
                         break
                     continue
                 self._block_tables[req.slot, len(req.blocks)] = new[0]
                 req.blocks.extend(new)
+            if preempted:
+                continue
+            # the frontier block may be shared (prefix-cache hit on the
+            # whole prompt, or a registered prompt tail): break the
+            # share before decode writes into it
+            fi = pos // self.config.block_size
+            while True:
+                try:
+                    new = self.pool.ensure_writable(req.request_id,
+                                                    req.blocks[fi])
+                except PoolExhausted:
+                    victim = self.scheduler.pick_victim()
+                    if victim is None:
+                        raise
+                    self._preempt(victim)
+                    if victim is req:
+                        preempted = True
+                        break
+                    continue
+                break
+            if preempted:
+                continue
+            if new != req.blocks[fi]:
+                req.blocks[fi] = new
+                self._block_tables[req.slot, fi] = new
 
     def _preempt(self, victim: Request):
         """Evict-and-requeue (recompute mode): free everything, head of
@@ -288,13 +425,24 @@ class Engine:
 
     def _decode_iteration(self):
         self._ensure_blocks()
-        active = [r for r in self._slots if r is not None]
+        active = [r for r in self._slots
+                  if r is not None and r.state == RUNNING]
         if not active:
             return
+        # decode view of the block tables: slots still mid-prefill are
+        # masked to the garbage block so the bucket-wide step can never
+        # write into (possibly shared) blocks of an unfinished prompt
+        bt = self._block_tables
+        if any(r is not None and r.state == PREFILLING
+               for r in self._slots):
+            bt = bt.copy()
+            for i, r in enumerate(self._slots):
+                if r is not None and r.state == PREFILLING:
+                    bt[i] = 0
         with _trace("serving::decode_step"):
             logits, new_pools = self._decode_step(
                 self._pending[:, None], self.pool.layers,
-                self._block_tables, self._lengths)
+                bt, self._lengths)
             self.pool.layers = [(k, v) for k, v in new_pools]
             logits = np.asarray(logits)
         self.metrics.on_decode_iteration(
@@ -317,7 +465,10 @@ class Engine:
 
     def _retire(self, req: Request, reason: str):
         """Finish ``req`` for ``reason`` from ANY state — running in a
-        slot, or never admitted (queued timeout / failed prefill)."""
+        slot, mid-prefill, or never admitted (queued timeout / failed
+        prefill).  Releasing its references may PARK prompt blocks in
+        the pool's prefix LRU rather than freeing them — that is the
+        cache, not a leak."""
         slot = req.slot
         req.state = FINISHED
         req.finish_reason = reason
@@ -334,10 +485,24 @@ class Engine:
         self._finished[req.request_id] = req
 
     # ------------------------------------------------------------ misc
+    def _sync_pool_metrics(self):
+        """Mirror pool-owned prefix-cache counters into the metrics
+        layer (delta-based: the pool counts, metrics accumulate)."""
+        d = self.pool.evictions - self._evictions_seen
+        if d:
+            self._evictions_seen = self.pool.evictions
+            self.metrics.on_evictions(d)
+
     def decode_cache_size(self) -> int:
         """Entries in the compiled decode step's jit cache — 1 after
         warmup, forever (the no-retrace contract)."""
         return self._decode_step._cache_size()
+
+    def prefill_cache_size(self) -> int:
+        """Entries in the compiled chunked-prefill step's jit cache — 1
+        after warmup, for EVERY prompt length (the bucket-explosion
+        fix)."""
+        return self._prefill_step._cache_size()
 
     def stats(self) -> dict:
         d = self.metrics.as_dict()

@@ -80,6 +80,13 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.decode_iterations = 0
         self.prefills = 0
+        # prefix cache / chunked prefill
+        self.prefix_cache_hits = 0      # admissions reusing >= 1 block
+        self.prefix_cache_misses = 0    # admissions reusing none
+        self.prefix_cache_evictions = 0
+        self.prefill_chunks = 0
+        self._cached_tokens_sum = 0
+        self._prompt_tokens_sum = 0
         # gauge accumulators (sampled once per decode iteration)
         self._occupancy_sum = 0.0
         self._cache_util_sum = 0.0
@@ -139,6 +146,49 @@ class ServingMetrics:
                 reg.histogram("serving_ttft_seconds",
                               "time to first token").observe(
                                   (t.first_token_ns - t.submitted_ns) / 1e9)
+
+    def on_prefix_lookup(self, request_id: str, cached_tokens: int,
+                         prompt_tokens: int):
+        """One admission's prefix-cache outcome: how many of the
+        prompt's tokens came from cached blocks (0 == miss)."""
+        if cached_tokens > 0:
+            self.prefix_cache_hits += 1
+        else:
+            self.prefix_cache_misses += 1
+        self._cached_tokens_sum += cached_tokens
+        self._prompt_tokens_sum += prompt_tokens
+        reg = self._obs()
+        if reg is not None:
+            if cached_tokens > 0:
+                reg.counter("serving_prefix_cache_hits_total",
+                            "admissions reusing cached prefix blocks"
+                            ).inc()
+            else:
+                reg.counter("serving_prefix_cache_misses_total",
+                            "admissions with no cached prefix").inc()
+            reg.gauge("serving_prefix_cached_token_ratio",
+                      "prompt tokens served from the prefix cache, "
+                      "cumulative ratio").set(
+                          self._cached_tokens_sum
+                          / max(self._prompt_tokens_sum, 1))
+
+    def on_prefill_complete(self, request_id: str, chunks: int):
+        """Prompt fully prefilled in ``chunks`` fixed-shape chunks."""
+        self.prefill_chunks += chunks
+        reg = self._obs()
+        if reg is not None:
+            reg.histogram("serving_prefill_chunks_per_request",
+                          "prefill chunks per admitted prompt",
+                          buckets=(1, 2, 4, 8, 16, 32, 64)
+                          ).observe(chunks)
+
+    def on_evictions(self, n: int):
+        """``n`` cached blocks evicted from the pool's prefix LRU."""
+        self.prefix_cache_evictions += n
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_prefix_cache_evictions_total",
+                        "prefix-cache blocks evicted (LRU)").inc(n)
 
     def on_preempt(self, request_id: str):
         self.preempted += 1
@@ -230,6 +280,10 @@ class ServingMetrics:
                 "tokens_generated": self.tokens_generated,
                 "decode_iterations": self.decode_iterations,
                 "prefills": self.prefills,
+                "prefix_cache_hits": self.prefix_cache_hits,
+                "prefix_cache_misses": self.prefix_cache_misses,
+                "prefix_cache_evictions": self.prefix_cache_evictions,
+                "prefill_chunks": self.prefill_chunks,
             },
             "gauges": {
                 "batch_occupancy": self.last_batch_occupancy,
@@ -237,6 +291,9 @@ class ServingMetrics:
                 "cache_utilization": self.last_cache_utilization,
                 "cache_utilization_avg": round(
                     self._cache_util_sum / n, 4),
+                "prefix_cached_token_ratio": round(
+                    self._cached_tokens_sum
+                    / max(self._prompt_tokens_sum, 1), 4),
             },
             "requests": {rid: t.to_dict()
                          for rid, t in self.requests.items()},

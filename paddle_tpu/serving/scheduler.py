@@ -36,6 +36,7 @@ class AdmissionError(Exception):
 
 # request states
 QUEUED = "queued"
+PREFILLING = "prefilling"   # admitted; prompt chunks still being computed
 RUNNING = "running"
 PREEMPTED = "preempted"
 FINISHED = "finished"
@@ -70,6 +71,12 @@ class Request:
     error: Optional[str] = None             # set with finish_reason "error"
     preemptions: int = 0
     deadline_t: Optional[float] = field(default=None, repr=False)
+    # chunked-prefill progress (engine-owned): tokens whose KV is
+    # already in the pool, how many of those came from the prefix cache,
+    # and how many prefill chunks this admission has run
+    prefill_pos: int = 0
+    cached_tokens: int = 0
+    prefill_chunks: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -142,19 +149,27 @@ class Scheduler:
         req.slot = None
         req.blocks = []
         req.generated = []
+        req.prefill_pos = 0
+        req.cached_tokens = 0
+        req.prefill_chunks = 0
         self.waiting.appendleft(req)
 
     def next_admittable(self) -> Optional[Request]:
         """Head of the queue if the pool can hold its prompt + one
         decode block right now; None otherwise (strict FCFS: a blocked
-        head blocks the tail, so completions stay in arrival order)."""
+        head blocks the tail, so completions stay in arrival order).
+        Prefix-cache hits shrink the bill: blocks matched in the pool's
+        content index need no fresh allocation (``admission_plan``
+        accounts for matched blocks parked in the evictable LRU)."""
         if not self.waiting:
             return None
         head = self.waiting[0]
-        # prompt blocks + room for the first generated token's write
-        # position (a new block only when the prompt fills its last one)
-        need = self.pool.blocks_for(head.prompt_len + 1)
-        if not self.pool.can_allocate(need):
+        # uncached prompt blocks + room for the first generated token's
+        # write position (a new block only when the prompt fills its
+        # last one)
+        _, _, feasible = self.pool.admission_plan(head.prompt,
+                                                  extra_tokens=1)
+        if not feasible:
             return None
         return self.waiting.popleft()
 
@@ -189,5 +204,6 @@ class Scheduler:
         return None
 
 
-__all__ = ["AdmissionError", "Request", "Scheduler", "QUEUED", "RUNNING",
-           "PREEMPTED", "FINISHED", "normalize_stop_sequences"]
+__all__ = ["AdmissionError", "Request", "Scheduler", "QUEUED",
+           "PREFILLING", "RUNNING", "PREEMPTED", "FINISHED",
+           "normalize_stop_sequences"]

@@ -353,15 +353,37 @@ class TestDecodeStepHazards:
 
     def test_builtin_steps_are_clean(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-        from paddle_tpu.models.generation import (make_decode_step,
-                                                  make_paged_decode_step,
-                                                  make_prefill_step)
+        from paddle_tpu.models.generation import (
+            make_chunked_prefill_step, make_decode_step,
+            make_paged_decode_step, make_prefill_step)
 
         paddle.seed(0)
         model = LlamaForCausalLM(LlamaConfig.tiny())
         for make in (make_decode_step, make_prefill_step,
-                     make_paged_decode_step):
+                     make_paged_decode_step, make_chunked_prefill_step):
             assert analysis.scan_decode_step(make(model)) == []
+
+    def test_chunked_prefill_host_sync_flagged(self):
+        """ISSUE 5 satellite: the chunked-prefill step is part of the
+        serving hot loop and registers like any decode step, so a host
+        sync hiding inside one is an H106 ERROR — per CHUNK, a sync
+        would serialize every prompt's prefill against the host."""
+        from paddle_tpu.models.generation import (register_decode_step,
+                                                  registered_decode_steps)
+
+        @register_decode_step
+        def bad_chunked_prefill(ids, pools, block_table, start, last_index):
+            n = last_index.item()        # host sync per prefill chunk
+            return ids[:, :n], pools
+
+        diags = analysis.scan_decode_step(bad_chunked_prefill)
+        assert any(d.code == "H106" and d.severity == "error"
+                   for d in diags)
+        # and the registry-wide scan sees it without being handed the fn
+        assert any(d.code == "H106" and "bad_chunked_prefill" in d.message
+                   for d in analysis.scan_decode_steps())
+        assert any(f is bad_chunked_prefill
+                   for f in registered_decode_steps())
 
     def test_registry_scan_aggregates_and_prunes(self):
         from paddle_tpu.models.generation import (register_decode_step,

@@ -390,10 +390,13 @@ class TestServingMirror:
     _CONTRACT_COUNTERS = {
         "requests_submitted", "requests_rejected", "requests_completed",
         "requests_timed_out", "requests_failed", "preemptions",
-        "tokens_generated", "decode_iterations", "prefills"}
+        "tokens_generated", "decode_iterations", "prefills",
+        "prefix_cache_hits", "prefix_cache_misses",
+        "prefix_cache_evictions", "prefill_chunks"}
     _CONTRACT_GAUGES = {
         "batch_occupancy", "batch_occupancy_avg",
-        "cache_utilization", "cache_utilization_avg"}
+        "cache_utilization", "cache_utilization_avg",
+        "prefix_cached_token_ratio"}
 
     def _run_workload(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

@@ -339,3 +339,269 @@ class TestThroughput:
         sequential_iters = len(prompts) * (max_new - 1)
         assert engine_iters < sequential_iters, \
             (engine_iters, sequential_iters)
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: pool semantics (refcounts, chain hashing, LRU, CoW)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCachePool:
+    def _pool(self, num_blocks=8, block_size=4, **kw):
+        return BlockKVPool(num_layers=2, num_blocks=num_blocks,
+                           block_size=block_size, kv_heads=2, head_dim=4,
+                           **kw)
+
+    def test_free_request_unowned_is_noop(self):
+        """Retire paths call free_request unconditionally — a request
+        that never got blocks (queued timeout, failed prefill) must not
+        blow up."""
+        pool = self._pool()
+        pool.free_request("never-admitted")   # no raise
+        a = pool.allocate("a", 2)
+        pool.free_request("a")
+        pool.free_request("a")                # second call: also a no-op
+        assert pool.num_free == pool.capacity_blocks
+        assert 0 not in a
+
+    def test_double_free_message_lists_owners(self):
+        pool = self._pool()
+        blocks = pool.allocate("alice", 1)
+        pool.acquire("bob", blocks)
+        with pytest.raises(ValueError, match="double free.*'carol'.*"
+                                             "alice.*bob"):
+            pool.free(blocks, request_id="carol")
+        with pytest.raises(ValueError, match="no current owner"):
+            pool.free([pool._free[-1]])
+
+    def test_refcount_shared_block_survives_one_owner(self):
+        pool = self._pool()
+        blocks = pool.allocate("a", 2)
+        pool.acquire("b", blocks)
+        assert all(pool.refcount(b) == 2 for b in blocks)
+        pool.free_request("a")
+        # b still holds them: nothing came back to the free list
+        assert pool.num_used == 2
+        assert sorted(pool.owned_by("b")) == sorted(blocks)
+        pool.free_request("b")
+        assert pool.num_free == pool.capacity_blocks
+        pool.check_leaks()
+
+    def test_chain_hash_match_semantics(self):
+        """Matching is chained: block i matches only when the WHOLE
+        prefix through block i matches, full blocks only, stopping at
+        the first divergence."""
+        pool = self._pool(num_blocks=16)
+        toks = np.arange(1, 13, dtype=np.int32)          # 3 full blocks
+        blocks = pool.allocate("a", 3)
+        pool.register_prefix("a", toks, blocks)
+        assert pool.match_prefix(toks) == blocks
+        assert pool.match_prefix(toks[:8]) == blocks[:2]
+        assert pool.match_prefix(toks[:7]) == blocks[:1]  # partial tail
+        # same 2nd block content after a DIFFERENT first block: no match
+        # past the divergence (the chain encodes the whole prefix)
+        other = toks.copy()
+        other[0] = 99
+        assert pool.match_prefix(other) == []
+        pool.free_request("a")
+        assert pool.match_prefix(toks) == blocks          # parked, still hot
+
+    def test_lru_eviction_never_touches_referenced_blocks(self):
+        """Under pressure the pool evicts ONLY unreferenced cached
+        blocks, oldest-parked first; live requests' blocks are
+        untouchable."""
+        pool = self._pool(num_blocks=6)
+        t1 = np.arange(1, 5, dtype=np.int32)
+        t2 = np.arange(11, 15, dtype=np.int32)
+        b1 = pool.allocate("a", 1)
+        pool.register_prefix("a", t1, b1)
+        b2 = pool.allocate("b", 1)
+        pool.register_prefix("b", t2, b2)
+        pool.free_request("a")        # parked first -> LRU victim
+        pool.free_request("b")
+        live = pool.allocate("live", 3)   # 3 truly-free blocks left
+        assert pool.num_cached == 2 and pool.evictions == 0
+        got = pool.allocate("live", 2)    # forces 2 evictions
+        assert pool.evictions == 2
+        assert set(got) == {b1[0], b2[0]}  # recycled cached blocks
+        assert pool.match_prefix(t1) == [] and pool.match_prefix(t2) == []
+        # live blocks never appeared as victims
+        assert sorted(pool.owned_by("live")) == sorted(live + got)
+        with pytest.raises(PoolExhausted):
+            pool.allocate("live", 1)
+        pool.free_request("live")
+        pool.check_leaks()
+
+    def test_cow_shared_and_registered_blocks(self):
+        pool = self._pool()
+        toks = np.arange(1, 5, dtype=np.int32)
+        b = pool.allocate("a", 1)
+        # exclusive + unregistered: in-place, no copy
+        assert pool.ensure_writable("a", b[0]) == b[0]
+        pool.register_prefix("a", toks, b)
+        # registered (immutable) even while exclusively owned: copy
+        nb = pool.ensure_writable("a", b[0])
+        assert nb != b[0] and pool.cow_copies == 1
+        assert pool.owned_by("a") == [nb]
+        # the registered original stays matchable (parked in the LRU)
+        assert pool.match_prefix(toks) == b
+        pool.acquire("b2", pool.match_prefix(toks))
+        nb2 = pool.ensure_writable("b2", b[0])   # shared again: copy
+        assert nb2 not in (b[0], nb) and pool.cow_copies == 2
+        pool.free_request("a")
+        pool.free_request("b2")
+        pool.check_leaks()
+
+    def test_acquire_revives_parked_block(self):
+        pool = self._pool()
+        toks = np.arange(1, 5, dtype=np.int32)
+        b = pool.allocate("a", 1)
+        pool.register_prefix("a", toks, b)
+        pool.free_request("a")
+        assert pool.num_cached == 1
+        pool.acquire("b", b)
+        assert pool.num_cached == 0 and pool.refcount(b[0]) == 1
+        pool.free_request("b")
+        pool.check_leaks()
+
+    def test_disabled_cache_never_matches_or_parks(self):
+        pool = self._pool(enable_prefix_cache=False)
+        toks = np.arange(1, 5, dtype=np.int32)
+        b = pool.allocate("a", 1)
+        assert pool.register_prefix("a", toks, b) == 0
+        assert pool.match_prefix(toks) == []
+        pool.free_request("a")
+        assert pool.num_cached == 0
+        assert pool.num_free == pool.capacity_blocks
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache + chunked prefill: engine-level done bar
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_cache_on_off_token_identical(self, model):
+        """ISSUE 5 parity obligation: greedy output is token-identical
+        with prefix cache + chunked prefill enabled vs disabled, and
+        both match sequential generate()."""
+        shared = _prompts([16], seed=21)[0]
+        tails = _prompts([3, 5, 2], seed=22)
+        prompts = [np.concatenate([shared, t]) for t in tails]
+        refs = [_reference(model, p, max_new_tokens=6) for p in prompts]
+        outs = {}
+        for enable in (False, True):
+            eng = Engine(model, _config(chunk_tokens=8,
+                                        enable_prefix_cache=enable))
+            outs[enable] = []
+            for p in prompts:       # sequential: later ones hit the cache
+                req = eng.submit(p, max_new_tokens=6)
+                eng.run_until_complete()
+                outs[enable].append(req.output_ids())
+            eng.pool.check_leaks()
+            if enable:
+                c = eng.metrics.as_dict()["counters"]
+                assert c["prefix_cache_hits"] == 2
+                assert c["prefix_cache_misses"] == 1
+        for off, on, ref in zip(outs[False], outs[True], refs):
+            np.testing.assert_array_equal(off, on)
+            np.testing.assert_array_equal(on, ref)
+
+    def test_full_prompt_hit_recomputes_last_token(self, model):
+        """Submitting the SAME prompt twice: the second admission may
+        reuse every full block, but must still recompute >= 1 token to
+        produce first-token logits — via a copy-on-write block, so the
+        cached original is never mutated."""
+        p = _prompts([8], seed=23)[0]      # exact multiple of block_size
+        ref = _reference(model, p, max_new_tokens=5)
+        eng = Engine(model, _config(chunk_tokens=8))
+        for _ in range(2):
+            req = eng.submit(p, max_new_tokens=5)
+            eng.run_until_complete()
+            np.testing.assert_array_equal(req.output_ids(), ref)
+        assert eng.metrics.prefix_cache_hits == 1
+        assert eng.pool.cow_copies >= 1
+        # the second request prefilled ONE 1-token chunk, not the prompt
+        assert req.cached_tokens == p.size - 1
+        eng.pool.check_leaks()
+
+    def test_constant_prefill_programs_across_lengths(self):
+        """ISSUE 5 acceptance: >= 4 distinct prompt lengths, ONE
+        compiled prefill program (the fixed-chunk shape), measured via
+        the compile tracker — the bucketed prefill would have compiled
+        one per length bucket."""
+        paddle.seed(0)
+        fresh = LlamaForCausalLM(LlamaConfig.tiny())
+        fresh.eval()
+        eng = Engine(fresh, _config(chunk_tokens=4))
+        prompts = _prompts([3, 7, 11, 14, 6], seed=24)
+        refs = [_reference(fresh, p, max_new_tokens=4) for p in prompts]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng._prefill_step.compiles == 1, \
+            eng._prefill_step.compiles
+        assert eng.prefill_cache_size() == 1
+        assert eng._prefill_step.retraces == 0
+        # multi-chunk accounting: ceil(L/4) chunks per prompt
+        assert eng.metrics.prefill_chunks == sum(
+            -(-p.size // 4) for p in prompts)
+
+    def test_eviction_under_pressure_keeps_parity(self, model):
+        """Tiny pool + repeated prompts: LRU evictions and preemptions
+        churn the cache, yet every output stays token-exact and no
+        live-referenced block is ever handed out twice (the leak check
+        would catch a double-owned block)."""
+        prompts = _prompts([4, 4, 8, 4], seed=7)
+        prompts.append(prompts[0].copy())    # full-hit after churn
+        refs = [_reference(model, p, max_new_tokens=10) for p in prompts]
+        eng = Engine(model, _config(max_batch_size=3, num_blocks=7,
+                                    chunk_tokens=8))
+        outs = eng.generate(prompts, max_new_tokens=10)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.pool.evictions > 0        # pressure was real
+        assert eng.metrics.preempted > 0
+        eng.pool.check_leaks()
+        assert eng.pool.num_free == eng.pool.capacity_blocks
+
+    def test_preempted_request_reuses_its_own_prefix(self, model):
+        """A preempted request's registered prompt blocks survive in
+        the LRU; its re-admission is a prefix-cache hit and the rerun
+        stays token-exact (recompute mode + cache reuse compose)."""
+        prompts = _prompts([8, 8], seed=25)
+        refs = [_reference(model, p, max_new_tokens=10) for p in prompts]
+        # capacity 6: both prefill (4 blocks), decode growth preempts
+        # the younger request, and the survivor finishes with 5 blocks —
+        # evicting the victim's parked TAIL but leaving its chain head
+        # for the re-admission to hit (leaf-first eviction order)
+        eng = Engine(model, _config(max_batch_size=2, num_blocks=7,
+                                    chunk_tokens=8))
+        outs = eng.generate(prompts, max_new_tokens=10)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.metrics.preempted > 0
+        assert eng.metrics.prefix_cache_hits > 0
+        eng.pool.check_leaks()
+
+    def test_long_prompt_interleaves_with_decode(self, model):
+        """Sarathi-style budget: while a long prompt prefills chunk by
+        chunk, an already-running request keeps producing tokens every
+        iteration (no prefill stall), and both finish token-exact."""
+        short, long_ = _prompts([4, 40], seed=26)
+        refs = [_reference(model, p, max_new_tokens=8)
+                for p in (short, long_)]
+        eng = Engine(model, _config(chunk_tokens=8))
+        r_short = eng.submit(short, max_new_tokens=8)
+        eng.step()                      # short is admitted + running
+        gen_before = r_short.num_generated
+        r_long = eng.submit(long_, max_new_tokens=8)
+        steps = 0
+        while r_long.state != FINISHED and r_short.state != FINISHED:
+            eng.step()
+            steps += 1
+        # the short request advanced during the long prompt's prefill
+        assert r_short.num_generated > gen_before
+        eng.run_until_complete()
+        np.testing.assert_array_equal(r_short.output_ids(), refs[0])
+        np.testing.assert_array_equal(r_long.output_ids(), refs[1])
+        assert r_long.prefill_chunks == 5    # ceil(40 / 8)
+        eng.pool.check_leaks()

@@ -32,6 +32,7 @@ python examples/train_mnist.py --steps 3 --batch 8
 python examples/pretrain_llama.py --steps 2 --batch 2 --seq 32
 python examples/generate_text.py
 python examples/serve_llama.py
+python examples/serve_llama.py --prefix-cache
 python examples/export_and_serve.py
 python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py

@@ -124,6 +124,7 @@ run_queue() {
   run only_bert      BENCH_ONLY=bert || return 1
   run only_unet      BENCH_ONLY=unet || return 1
   run only_serve     BENCH_ONLY=serve_llama || return 1
+  run only_prefix    BENCH_ONLY=prefix_cache || return 1
   BENCH_TIMEOUT=2400 run baseline BENCH_EXTRAS_BUDGET=1500 || return 1
 }
 
@@ -131,7 +132,7 @@ all_done() {
   local n
   for n in batch16 autotune flash_q512k512 flash_q128k512 flash_q256k1024 \
            llama1b_s4096 only_resnet only_bert only_unet only_serve \
-           baseline; do
+           only_prefix baseline; do
     is_done "${n}" || return 1
   done
   return 0
