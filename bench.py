@@ -47,8 +47,17 @@ def _expects_accelerator() -> bool:
     return bool(plats) and "cpu" not in plats.split(",")
 
 
+# recorded by _init_backend; run_bench folds it into the emitted JSON so
+# every artifact says WHICH backend produced the number and, on CPU
+# fallback, why the accelerator was skipped (ROADMAP "bench backend
+# probe is broken": five rounds of artifacts died in probe timeouts and
+# carried no backend provenance at all)
+_PROBE_RESULT = {"probed_backend": None, "probe_error": None,
+                 "probe_attempts": 0}
+
+
 def _init_backend(total_budget: float | None = None):
-    """Return (devices, backend_name) via ONE adaptive subprocess probe.
+    """Return (devices, backend_name) via bounded subprocess probes.
 
     A TPU held by a stale process (or a racing tunnel) raises
     RuntimeError("... UNAVAILABLE ...") from the first devices() call.
@@ -59,20 +68,23 @@ def _init_backend(total_budget: float | None = None):
     Without the probe, a retry would "succeed" on CPU and the bench would
     report a smoke-path number as the real perf result.
 
-    VERDICT r4 weak #1: three fixed 90 s probes guaranteed failure
-    whenever legitimate init takes >90 s (slow-but-alive tunnel).  Now the
-    FIRST probe gets the whole remaining budget (timeout = remaining);
-    only a probe that fails FAST (clean UNAVAILABLE, not a hang) is
-    retried with backoff inside the same budget.  The probe child's
-    stderr tail is always carried into the raised error so it lands in
-    the error JSON — the judge can tell "tunnel down" (timeout, empty
-    stderr) from "init slow/racing" (UNAVAILABLE text).
+    Every probe — including the FIRST — runs under a hard per-probe
+    deadline (BENCH_PROBE_DEADLINE, default 60 s).  The previous
+    adaptive scheme granted the first probe the whole remaining budget,
+    so a hung 'axon' platform probe starved the entire 300 s budget and
+    the CPU metric suite never ran (BENCH_r01–r05 all died this way).
+    A probe that times out now costs one deadline, not the run: we fall
+    back to CPU, record the probed backend and failure reason in
+    ``_PROBE_RESULT`` (emitted in the JSON), and still produce the full
+    per-subsystem metric suite.  Fast failures (clean UNAVAILABLE) are
+    retried with backoff inside the total budget as before.
     """
     import os
     import subprocess
 
     if total_budget is None:
         total_budget = float(os.environ.get("BENCH_PROBE_BUDGET", 300.0))
+    probe_deadline = float(os.environ.get("BENCH_PROBE_DEADLINE", 60.0))
     deadline = time.monotonic() + total_budget
     last_err = None
     attempt = 0
@@ -83,24 +95,26 @@ def _init_backend(total_budget: float | None = None):
                    else "time budget exhausted")
             break
         attempt += 1
+        _PROBE_RESULT["probe_attempts"] = attempt
+        timeout = min(probe_deadline, remaining)
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; d = jax.devices(); "
                  "print(jax.default_backend())"],
                 capture_output=True, text=True,
-                timeout=remaining,  # adaptive: the full remaining budget
-                env=dict(os.environ))
+                timeout=timeout,  # hard per-probe deadline, never the
+                env=dict(os.environ))  # whole remaining budget
         except subprocess.TimeoutExpired as e:
             tail = ((e.stderr if isinstance(e.stderr, str) else
                      (e.stderr or b"").decode("utf-8", "replace"))
                     or "").strip()[-500:]
-            last_err = (f"probe timed out after {remaining:.0f}s "
-                        f"(whole remaining budget); probe stderr tail: "
+            last_err = (f"probe timed out after {timeout:.0f}s "
+                        f"(per-probe deadline); probe stderr tail: "
                         f"{tail!r}")
-            why = "probe hung until the budget expired"
+            why = f"probe hung past its {probe_deadline:.0f}s deadline"
             print(f"# backend probe {attempt}: {last_err}", file=sys.stderr)
-            break
+            break  # a hang is not transient: don't burn more deadlines
         probed = probe.stdout.strip().splitlines()[-1] if \
             probe.stdout.strip() else ""
         if probe.returncode == 0 and (
@@ -114,6 +128,8 @@ def _init_backend(total_budget: float | None = None):
                 raise RuntimeError(
                     "accelerator probe succeeded but in-process init fell "
                     "back to cpu — TPU likely grabbed by another process")
+            _PROBE_RESULT["probed_backend"] = backend
+            _PROBE_RESULT["probe_error"] = None
             return devices, backend
         last_err = (f"probe exited rc={probe.returncode} backend="
                     f"{probed or 'none'}; probe stderr tail: "
@@ -122,12 +138,38 @@ def _init_backend(total_budget: float | None = None):
         print(f"# backend probe {attempt} failed fast: {last_err}; "
               f"retrying in {wait:.0f}s", file=sys.stderr)
         time.sleep(wait)
+    if _expects_accelerator():
+        # the accelerator never answered inside its deadline: fall back
+        # to CPU so the metric suite still runs, and stamp the artifact
+        # with the probed backend + failure reason (the fallback is
+        # explicit provenance, never silent)
+        _PROBE_RESULT["probed_backend"] = "cpu"
+        _PROBE_RESULT["probe_error"] = (
+            f"accelerator probe failed ({why}, budget "
+            f"{total_budget:.0f}s, per-probe deadline "
+            f"{probe_deadline:.0f}s): {last_err}")
+        print(f"# falling back to cpu: {_PROBE_RESULT['probe_error']}",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # a dead tunnel's PJRT plugin registration hangs at import when
+        # this is set (same guard as tools/ci.sh)
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        return jax.devices("cpu"), "cpu"
     raise RuntimeError(
         f"backend init failed ({why}, budget {total_budget:.0f}s): "
         f"{last_err}")
 
 
 def _emit(result: dict):
+    # every artifact line carries backend provenance: which backend the
+    # probe settled on and (on CPU fallback / init failure) why — the
+    # gate must never read a fallback number as accelerator evidence
+    if _PROBE_RESULT["probed_backend"] is not None:
+        result.setdefault("probed_backend", _PROBE_RESULT["probed_backend"])
+    if _PROBE_RESULT["probe_error"] is not None:
+        result.setdefault("probe_error", _PROBE_RESULT["probe_error"])
     print(json.dumps(result))
     sys.stdout.flush()
 

@@ -25,8 +25,11 @@ from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
 from .hazards import (scan, scan_checkpoint_writes, scan_decode_step,
                       scan_decode_steps, scan_function, scan_program,
-                      scan_static_function)
+                      scan_static_function, sort_diagnostics)
 from . import astlint
+from . import xray
+from .xray import (ProgramReport, analyze, analyze_train_step,
+                   audit_default_steps, check_sharding_readiness)
 
 __all__ = [
     "Diagnostic",
@@ -39,10 +42,17 @@ __all__ = [
     "scan_decode_step",
     "scan_decode_steps",
     "scan_checkpoint_writes",
+    "sort_diagnostics",
     "set_pass_verification",
     "pass_verification",
     "verify_after_pass",
     "astlint",
+    "xray",
+    "ProgramReport",
+    "analyze",
+    "analyze_train_step",
+    "audit_default_steps",
+    "check_sharding_readiness",
     "ERROR",
     "WARNING",
     "INFO",

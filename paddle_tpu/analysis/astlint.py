@@ -407,6 +407,10 @@ def lint_paths(paths: Sequence[str]) -> List[Finding]:
                             lint_file(os.path.join(dirpath, fn)))
         else:
             findings.extend(lint_file(path))
+    # deterministic output: (path, line, code) regardless of os.walk's
+    # directory order, so CI diffs and test assertions never flake (the
+    # sort is stable — same-line findings keep rule-visit order)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings
 
 

@@ -55,11 +55,37 @@ __all__ = [
     "scan_decode_steps",
     "scan_checkpoint_writes",
     "scan",
+    "sort_diagnostics",
 ]
 
 _HOST_SYNC_ATTRS = ("numpy", "item", "tolist", "cpu")
 _HOST_SYNC_CALLS = ("to_np",)
 _F64_NAMES = ("float64", "double")
+
+_WHERE_RE = None  # compiled lazily (re import below is cheap but explicit)
+
+
+def _where_key(where: str):
+    """(file, line) sort key from a ``file:line`` location string;
+    non-positional locations ('cache of f', 'block 0 op 3') sort by the
+    raw string with line 0."""
+    global _WHERE_RE
+    if _WHERE_RE is None:
+        import re
+
+        _WHERE_RE = re.compile(r"^(?P<file>.*):(?P<line>\d+)$")
+    m = _WHERE_RE.match(where or "")
+    if m:
+        return (m.group("file"), int(m.group("line")))
+    return (where or "", 0)
+
+
+def sort_diagnostics(diags: List[Diagnostic]) -> List[Diagnostic]:
+    """Deterministic order: (file, line, code).  Every multi-source scan
+    entry point returns through here so CI diffs and test assertions
+    never flake on dict/registry ordering (sort is stable, so
+    same-location diagnostics keep their discovery order)."""
+    return sorted(diags, key=lambda d: _where_key(d.where) + (d.code,))
 
 
 # ---------------------------------------------------------------------------
@@ -330,7 +356,7 @@ def scan_decode_steps() -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     for fn in registered_decode_steps():
         diags.extend(scan_decode_step(fn))
-    return diags
+    return sort_diagnostics(diags)
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +484,7 @@ def scan_checkpoint_writes(paths, exclude=_CKPT_SANCTIONED
         scanner = _CheckpointWriteScanner(f)
         scanner.visit(tree)
         diags.extend(scanner.diags)
-    return diags
+    return sort_diagnostics(diags)
 
 
 # ---------------------------------------------------------------------------
@@ -508,12 +534,12 @@ def scan(obj: Any, fetch_list: Optional[list] = None) -> List[Diagnostic]:
     if hasattr(obj, "blocks") and hasattr(obj, "global_block"):
         return scan_program(obj)
     if hasattr(obj, "_cache") and hasattr(obj, "_fn"):
-        return scan_static_function(obj)
+        return sort_diagnostics(scan_static_function(obj))
     fwd = getattr(obj, "forward", None)
     if fwd is not None and hasattr(fwd, "_cache"):
-        return scan_static_function(fwd)
+        return sort_diagnostics(scan_static_function(fwd))
     if callable(obj):
-        return scan_function(obj)
+        return sort_diagnostics(scan_function(obj))
     raise TypeError(
         f"cannot hazard-scan {type(obj).__name__}: expected a Program, "
         "StaticFunction, Layer, or function")

@@ -96,6 +96,36 @@ class Model:
             m.update(m.compute(outputs, *labels).numpy())
         return metrics if len(metrics) > 1 else metrics[0]
 
+    def xray(self, inputs, labels=None, *, chip="v5e",
+             hbm_budget_bytes=None):
+        """Statically X-ray the compiled train step on a sample batch
+        (analysis.xray): per-op FLOP/byte roofline, peak-live-HBM from a
+        liveness walk, donation/host-callback/f64 hazards.  The report
+        lands in ``self.xray_report`` and its FLOPs/bytes/peak-HBM
+        mirror into the observability gauges; nothing is executed (one
+        abstract trace).  Requires :meth:`prepare` with an optimizer and
+        loss."""
+        from ..analysis import xray as _xray
+
+        if getattr(self, "_train_step_fn", None) is None:
+            raise RuntimeError(
+                "Model.xray needs the compiled train step — call "
+                "prepare(optimizer, loss) first")
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        inputs = [to_tensor(x) if not isinstance(x, Tensor) else x
+                  for x in inputs]
+        labels = [to_tensor(y) if not isinstance(y, Tensor) else y
+                  for y in labels]
+        self.network.train()
+        report = _xray.analyze_train_step(
+            self._train_step_fn, inputs, labels, chip=chip,
+            hbm_budget_bytes=hbm_budget_bytes)
+        _xray.export_report_gauges(report)
+        self.xray_report = report
+        return report
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -121,7 +151,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            xray_on_start=False, hbm_budget_bytes=None):
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
@@ -177,6 +208,20 @@ class Model:
                     self._skip_batch = False
                     continue
                 inputs, labels = self._split_batch(batch)
+                if xray_on_start:
+                    # one abstract trace on the FIRST real batch's
+                    # shapes: static FLOPs/bytes/peak-HBM land in
+                    # self.xray_report + the observability gauges, and
+                    # ERROR hazards (f64, host callbacks, H110 budget)
+                    # abort before any step executes
+                    xray_on_start = False
+                    report = self.xray(inputs, labels,
+                                       hbm_budget_bytes=hbm_budget_bytes)
+                    errs = report.errors()
+                    if errs:
+                        raise RuntimeError(
+                            "train-step X-ray found ERROR hazards:\n  "
+                            + "\n  ".join(str(d) for d in errs))
                 loss = self.train_batch(inputs, labels)
                 if timer is not None:
                     timer.step(loss=loss, inputs=inputs)

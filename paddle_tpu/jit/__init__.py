@@ -375,6 +375,65 @@ class StaticFunction:
         finally:
             _LOOP_MAX_TRIPS.pop()
 
+    def trace_jaxpr(self, *args, **kwargs):
+        """Abstractly trace ONE call and return ``(closed_jaxpr,
+        donated_mask)`` for static analysis (paddle_tpu.analysis.xray).
+
+        Mirrors ``__call__``'s plumbing — state discovery, Tensor
+        flattening, dy2static, loop bounds — but hands the entry's
+        ``jax_fn`` to ``jax.make_jaxpr`` instead of executing it.  The
+        flattened invars are ``state_vals ++ dyn_vals ++ lrs ++ rng_key``
+        and the real call path jits with ``donate_argnums=(0,)``, so the
+        mask marks exactly the state leaves as donated.  Cleanup follows
+        ``probe_trace``: optimizer slots materialized under the abstract
+        trace hold tracers and are deleted; live params/buffers are
+        restored by ``jax_fn``'s own finally.  The python body runs once
+        under tracing, so user python side effects (step counters) fire —
+        same caveat as any extra trace.
+        """
+        if self._layers is None:
+            self._discover(args, kwargs)
+        if self._state is None or \
+                self._state_version != Layer._structure_version:
+            self._state = _State(self._layers, self._optimizers)
+            self._state_version = Layer._structure_version
+            self._mode_layers = None
+        state = self._state
+
+        raw_tree = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x,
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        flat, treedef = jax.tree_util.tree_flatten(raw_tree)
+        dyn_idx = [i for i, v in enumerate(flat) if _is_arrayish(v)]
+        dyn_vals = [flat[i] for i in dyn_idx]
+        static_flat = [None if i in dyn_idx else v
+                       for i, v in enumerate(flat)]
+        # a fresh entry, NOT cached: this trace never lowers/compiles,
+        # and a real call must still get its own trace-exactly-once entry
+        entry = _CompiledEntry(self._trace_target(), state, treedef,
+                               static_flat, tuple(dyn_idx))
+        entry._live_state = state
+        state_vals = state.read()
+        lrs = np.asarray([opt.get_lr() for opt in state.optimizers],
+                         np.float32)
+        rng_key = rnd.default_generator().next_key_data()
+        from .dy2static import _LOOP_MAX_TRIPS
+
+        _LOOP_MAX_TRIPS.append(self._loop_max_trips)
+        pre = set(entry._pre_slot_ids)
+        try:
+            closed = jax.make_jaxpr(entry._jax_fn)(
+                state_vals, list(dyn_vals), lrs, rng_key)
+        finally:
+            _LOOP_MAX_TRIPS.pop()
+            for s, k in list(state.opt_slots()):
+                if (id(s), k) not in pre:
+                    del s[k]
+        n_state = len(state_vals)
+        n_in = len(closed.jaxpr.invars)
+        donated = tuple(i < min(n_state, n_in) for i in range(n_in))
+        return closed, donated
+
     # ----- parity helpers
     @property
     def code(self):

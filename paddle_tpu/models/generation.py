@@ -12,6 +12,7 @@ serving); each step's math is jit-compiled by XLA.
 """
 from __future__ import annotations
 
+import functools
 import weakref
 
 import jax
@@ -83,26 +84,33 @@ def _gather_caches(caches, idx):
 # loop (H106: host syncs / python branching inside a decode step force a
 # device→host round trip per token).  Weak refs: a registered step must
 # not keep its model alive after the caller drops it.
-_decode_step_registry: "list[weakref.ref]" = []
+_decode_step_registry: "list[tuple[weakref.ref, str]]" = []
 
 
-def register_decode_step(fn):
+def register_decode_step(fn, kind: str = "decode"):
     """Register ``fn`` (the raw Python function behind a compiled decode/
-    prefill step) for hazard auditing.  Returns ``fn`` so it can be used
-    as a decorator."""
-    _decode_step_registry.append(weakref.ref(fn))
+    prefill step) for hazard auditing and jaxpr X-ray (analysis.xray
+    resolves abstract arg shapes per ``kind``).  Returns ``fn`` so it
+    can be used as a decorator."""
+    _decode_step_registry.append((weakref.ref(fn), kind))
     return fn
 
 
 def registered_decode_steps():
     """Live registered decode-step functions (dead models pruned)."""
+    return [fn for fn, _kind in registered_decode_step_entries()]
+
+
+def registered_decode_step_entries():
+    """Live ``(fn, kind)`` registry entries — the X-ray audit uses the
+    kind to build each step's abstract argument shapes."""
     alive = []
     remaining = []
-    for r in _decode_step_registry:
+    for r, kind in _decode_step_registry:
         fn = r()
         if fn is not None:
-            alive.append(fn)
-            remaining.append(r)
+            alive.append((fn, kind))
+            remaining.append((r, kind))
     _decode_step_registry[:] = remaining
     return alive
 
@@ -207,7 +215,7 @@ def make_decode_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
-    @register_decode_step
+    @functools.partial(register_decode_step, kind="decode")
     def step(tok, caches, offset):
         with no_grad_ctx():
             wrapped = [StaticKVCache(k, v) for k, v in caches]
@@ -239,7 +247,7 @@ def make_beam_decode_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
-    @register_decode_step
+    @functools.partial(register_decode_step, kind="beam_decode")
     def step(tok, caches, offset, parents):
         with no_grad_ctx():
             wrapped = [StaticKVCache(k[parents], v[parents])
@@ -273,7 +281,7 @@ def make_prefill_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
-    @register_decode_step
+    @functools.partial(register_decode_step, kind="prefill")
     def step(ids, caches, last_index):
         with no_grad_ctx():
             wrapped = [StaticKVCache(k, v) for k, v in caches]
@@ -308,7 +316,7 @@ def make_paged_decode_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
-    @register_decode_step
+    @functools.partial(register_decode_step, kind="paged_decode")
     def step(tok, pools, block_tables, lengths):
         with no_grad_ctx():
             wrapped = [PagedKVCache(k, v, block_tables) for k, v in pools]
@@ -353,7 +361,7 @@ def make_chunked_prefill_step(model):
     from ..core.dispatch import no_grad_ctx
 
     @jax.jit
-    @register_decode_step
+    @functools.partial(register_decode_step, kind="chunked_prefill")
     def step(ids, pools, block_table, start, last_index):
         with no_grad_ctx():
             wrapped = [PagedKVCache(k, v, block_table) for k, v in pools]

@@ -81,6 +81,14 @@ class ServingConfig:
     # cache-key check via observability.warn_on_retrace; cheap, keep on.
     # When False, retraces are still counted (engine._decode_step.retraces)
     strict_no_retrace: bool = True
+    # X-ray both compiled steps at startup (analysis.xray): static
+    # FLOPs/bytes/peak-HBM land in engine.xray_reports and (when
+    # telemetry is on) the observability gauges; ERROR-severity hazards
+    # — f64 eqns, host callbacks, or peak HBM over hbm_budget_bytes —
+    # raise before the engine serves a single token
+    xray_on_start: bool = False
+    hbm_budget_bytes: Optional[int] = None   # None: no H110 gate
+    xray_chip: str = "v5e"                   # roofline ridge profile
 
 
 class Engine:
@@ -130,6 +138,38 @@ class Engine:
         self._finished: Dict[str, Request] = {}
         self._ids = itertools.count()
         self._evictions_seen = 0    # pool counter already mirrored
+        self.xray_reports = self._xray_startup() if cfg.xray_on_start \
+            else None
+
+    def _xray_startup(self):
+        """X-ray the decode and prefill steps on this engine's exact
+        shapes (analysis.xray) before serving: static FLOPs/bytes/peak-
+        HBM mirror into the observability gauges, and ERROR hazards —
+        f64, host callbacks, HBM budget (H110) — abort construction."""
+        from ..analysis import xray
+
+        cfg = self.config
+        decode_args, prefill_args = xray._serving_abstract_args(
+            self.model, batch=cfg.max_batch_size,
+            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            chunk_tokens=self.chunk_tokens)
+        reports = [
+            xray.analyze(self._decode_step, decode_args,
+                         name="serving::decode_step", chip=cfg.xray_chip,
+                         hbm_budget_bytes=cfg.hbm_budget_bytes),
+            xray.analyze(self._prefill_step, prefill_args,
+                         name="serving::prefill_step", chip=cfg.xray_chip,
+                         hbm_budget_bytes=cfg.hbm_budget_bytes),
+        ]
+        errors = [d for r in reports for d in r.errors()]
+        for r in reports:
+            xray.export_report_gauges(r)
+        if errors:
+            raise ValueError(
+                "serving step X-ray found ERROR hazards:\n  " +
+                "\n  ".join(str(d) for d in errors))
+        return reports
 
     # ----------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens: int = 32,

@@ -24,6 +24,12 @@ echo "== lint =="
 # intentional exceptions with `# lint-tpu: disable[-file]=CODE` (README).
 python tools/lint_tpu.py paddle_tpu/
 
+echo "== program x-ray (jaxpr hazards + HBM budget) =="
+# traces the registered train/paged-decode/chunked-prefill steps on the
+# CPU (1,1) config: ERROR hazards (f64 eqns, host callbacks H109) or a
+# peak-live-HBM over the chip budget (H110) fail CI (README: Program X-ray)
+python tools/lint_tpu.py --xray
+
 echo "== unit + integration tests =="
 python -m pytest tests/ -q
 

@@ -1,19 +1,25 @@
 #!/usr/bin/env python
 """lint_tpu — repo AST lint CLI (op-schema parity, inplace-alias
-pairing, jax-import boundaries, mutable defaults).
+pairing, jax-import boundaries, mutable defaults) plus the jaxpr-level
+program X-ray gate.
 
 Usage:
     python tools/lint_tpu.py paddle_tpu/
     python tools/lint_tpu.py --list-rules
+    python tools/lint_tpu.py --xray [--hbm-budget-gib N] [--chip v5e]
 
 Exit status 1 when any unsuppressed ERROR-severity finding exists (the
 ``lint`` stage of tools/ci.sh gates on this).  Suppress with
 ``# lint-tpu: disable=L004`` on the flagged line or
 ``# lint-tpu: disable-file=L004`` anywhere in the file (see README).
 
-Loads the rule engine (paddle_tpu/analysis/astlint.py) by file path so
-linting never imports paddle_tpu or jax — it stays fast and usable even
-when the package itself is broken.
+Default (AST) mode loads the rule engine
+(paddle_tpu/analysis/astlint.py) by file path so linting never imports
+paddle_tpu or jax — it stays fast and usable even when the package
+itself is broken.  ``--xray`` is the opposite trade on purpose: it
+imports the package, traces the registered train/decode/prefill steps
+to jaxprs on the CPU (1,1) config, and fails on ERROR hazards (f64
+eqns, host callbacks H109) or a peak-live-HBM over the budget (H110).
 """
 import importlib.util
 import os
@@ -31,5 +37,41 @@ def _load_astlint():
     return mod
 
 
+def _xray_main(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="jaxpr X-ray over the registered steps")
+    parser.add_argument("--chip", default="cpu",
+                        help="roofline profile (cpu/v4/v5e/v5p/v6e)")
+    parser.add_argument("--hbm-budget-gib", type=float, default=None,
+                        help="peak-live-HBM budget; default: the chip "
+                        "profile's HBM capacity")
+    args = parser.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    from paddle_tpu.analysis import xray
+
+    budget = (int(args.hbm_budget_gib * 2**30)
+              if args.hbm_budget_gib is not None
+              else xray.CHIPS[args.chip].hbm_bytes)
+    reports = xray.audit_default_steps(chip=args.chip,
+                                       hbm_budget_bytes=budget)
+    n_err = 0
+    for r in reports:
+        print(r.summary())
+        for d in r.hazards:
+            print(f"  {d}")
+        n_err += len(r.errors())
+    print(f"lint-tpu --xray: {len(reports)} step(s), "
+          f"{sum(len(r.hazards) for r in reports)} hazard(s), "
+          f"{n_err} error(s)")
+    return 1 if n_err else 0
+
+
 if __name__ == "__main__":
-    sys.exit(_load_astlint().main(sys.argv[1:]))
+    args = sys.argv[1:]
+    if args and args[0] == "--xray":
+        sys.exit(_xray_main(args[1:]))
+    sys.exit(_load_astlint().main(args))
