@@ -30,6 +30,10 @@ from . import astlint
 from . import xray
 from .xray import (ProgramReport, analyze, analyze_train_step,
                    audit_default_steps, check_sharding_readiness)
+from . import shardplan
+from .shardplan import (Collective, PlanReport, PlanRequest,
+                        audit_shardplan, plan_jaxpr, plan_step,
+                        plan_train_step)
 
 __all__ = [
     "Diagnostic",
@@ -53,6 +57,14 @@ __all__ = [
     "analyze_train_step",
     "audit_default_steps",
     "check_sharding_readiness",
+    "shardplan",
+    "Collective",
+    "PlanReport",
+    "PlanRequest",
+    "audit_shardplan",
+    "plan_jaxpr",
+    "plan_step",
+    "plan_train_step",
     "ERROR",
     "WARNING",
     "INFO",

@@ -1,0 +1,1150 @@
+"""Static SPMD plan analyzer: sharding propagation, per-chip memory,
+and communication cost from the jaxpr.
+
+PR 6's X-ray answers "what does this program cost on ONE chip"; this
+module answers "what does it cost on a MESH" — before any mesh exists.
+Given a traced step (``jit.StaticFunction.trace_jaxpr`` or
+``jax.make_jaxpr``), an **abstract mesh** (named axis sizes, no real
+devices — the whole analysis runs on CPU tier-1), and a
+:class:`~paddle_tpu.distributed.sharding.SpecLayout`, it propagates
+shardings through the jaxpr the way GSPMD's partitioner would
+(dot_general/conv from dimension numbers, elementwise union rules,
+reshape split/merge, transpose permutation, recursion through
+pjit/scan/while/cond like the cost model) and emits a
+:class:`PlanReport`:
+
+- **per-chip sharded peak HBM** — the xray liveness pass re-run with a
+  shard-aware ``var_bytes`` callback that divides each buffer by its
+  shard count, gated by ``hbm_budget_bytes`` *per chip* (H110 ERROR).
+- **collective inventory** — every implied all-reduce / all-gather /
+  reduce-scatter / all-to-all with ring-formula bytes on the wire
+  (all-reduce moves ``2·S·(n-1)/n`` per chip, the others ``S·(n-1)/n``)
+  and estimated time against the chip's ICI profile
+  (:data:`~paddle_tpu.analysis.xray.CHIPS`).
+- **diagnostics** — S205 resharding hotspot (a producer/consumer spec
+  conflict forcing an *unplanned* gather above a byte threshold, ERROR),
+  S206 fully-replicated large parameter (WARNING — HBM burned on every
+  chip), S207 collective-bound step (estimated comm time exceeds the
+  roofline compute time, ERROR), S208 batch dim not sharded on the
+  ``data`` axis (WARNING — chunked prefill legitimately runs batch=1).
+
+**Planned vs unplanned.**  A collective the layout *implies* is
+planned: a sharded contraction ends in an all-reduce (row-parallel
+matmul, data-parallel grad sync), a one-sided sharded contraction
+all-gathers the sharded operand (the ZeRO-3/FSDP resolution), a lookup
+into a vocab-sharded embedding lowers to masked-gather + all-reduce.
+Unplanned collectives come from spec *conflicts* — the same mesh axis
+claimed by two output dims, or an elementwise op whose operands
+disagree — and are what S205 reports: they mean the layout fights
+itself on that edge.
+
+The propagation is a single forward pass (no GSPMD fix-point): loop
+carries keep their entry spec, and unknown primitives inherit from a
+same-shaped operand or fall back to replicated without inventing
+collectives.  That makes the analysis conservative in the safe
+direction — it can miss a resharding XLA would insert, but a *clean*
+report means the layout is self-consistent on every edge this pass
+understands.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .verifier import ERROR, WARNING, Diagnostic
+from .xray import (CHIPS, ChipProfile, _aval_bytes, _collect_costs,
+                   _peak_live_bytes, _var_bytes, estimate_collective_time,
+                   estimate_compute_time)
+
+__all__ = [
+    "Collective",
+    "PlanReport",
+    "PlanRequest",
+    "audit_shardplan",
+    "export_plan_gauges",
+    "plan_jaxpr",
+    "plan_step",
+    "plan_train_step",
+]
+
+
+# ---------------------------------------------------------------------------
+# spec algebra: a ShardSpec is a per-dimension tuple of mesh-axis names
+# ---------------------------------------------------------------------------
+
+ShardSpec = Tuple[Tuple[str, ...], ...]
+
+
+def _rep(rank: int) -> ShardSpec:
+    return ((),) * rank
+
+
+def _rank(v) -> int:
+    return len(getattr(v.aval, "shape", ()) or ())
+
+
+def _normalize_spec(spec, rank: int) -> ShardSpec:
+    """PartitionSpec / tuple / None → canonical per-dim axis tuples,
+    padded with replicated entries to ``rank``."""
+    if spec is None:
+        return _rep(rank)
+    entries: List[Tuple[str, ...]] = []
+    for e in tuple(spec)[:rank]:
+        if e is None:
+            entries.append(())
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(str(a) for a in e))
+        else:
+            entries.append((str(e),))
+    while len(entries) < rank:
+        entries.append(())
+    return tuple(entries)
+
+
+def _axes_product(axes: Sequence[str], mesh: Dict[str, int]) -> int:
+    n = 1
+    for a in axes:
+        n *= int(mesh.get(a, 1))
+    return n
+
+
+def _shard_count(spec: ShardSpec, mesh: Dict[str, int]) -> int:
+    n = 1
+    for entry in spec:
+        n *= _axes_product(entry, mesh)
+    return max(1, n)
+
+
+def _spec_str(spec: ShardSpec) -> str:
+    def one(entry):
+        if not entry:
+            return "·"
+        return "+".join(entry)
+    return "(" + ", ".join(one(e) for e in spec) + ")"
+
+
+# ---------------------------------------------------------------------------
+# report dataclasses
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Collective:
+    """One implied collective.  ``payload_bytes`` is the logical tensor
+    size being communicated (already divided by its shard count over
+    the *other* axes); ``bytes_moved`` is per-chip wire traffic from the
+    ring formula; ``count`` is the static trip multiplier (scan)."""
+
+    kind: str                 # all_reduce | all_gather | reduce_scatter | all_to_all
+    axes: Tuple[str, ...]
+    payload_bytes: int
+    bytes_moved: int
+    time_s: float
+    planned: bool
+    primitive: str
+    count: float = 1.0
+
+    @property
+    def total_bytes(self) -> float:
+        return self.bytes_moved * self.count
+
+    @property
+    def total_time_s(self) -> float:
+        return self.time_s * self.count
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Static mesh-execution plan for one traced step."""
+
+    name: str
+    chip: ChipProfile
+    mesh: Dict[str, int]
+    n_chips: int
+    per_chip_peak_hbm_bytes: int
+    collectives: List[Collective]
+    flops: float               # whole-program, all chips
+    bytes: float               # whole-program HBM bytes, all chips
+    diagnostics: List[Diagnostic]
+    param_specs: Dict[str, str]
+    hbm_budget_bytes: Optional[int] = None
+
+    @property
+    def comm_bytes(self) -> float:
+        return sum(c.total_bytes for c in self.collectives)
+
+    @property
+    def comm_time_s(self) -> float:
+        return sum(c.total_time_s for c in self.collectives)
+
+    @property
+    def compute_time_s(self) -> float:
+        """Per-chip roofline time: the program's cost divided over the
+        mesh, against the same formula xray's summary uses."""
+        n = max(1, self.n_chips)
+        return estimate_compute_time(self.flops / n, self.bytes / n,
+                                     self.chip)
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    def table(self, top: int = 12) -> str:
+        """Collective inventory: kind, mesh axes, wire KiB/chip, µs,
+        planned-or-conflict, producing primitive."""
+        rows = [f"{'collective':<16}{'axes':<14}{'KiB/chip':>10}"
+                f"{'µs':>8}  plan  primitive"]
+        ordered = sorted(self.collectives,
+                         key=lambda c: (-c.total_bytes, c.kind, c.primitive))
+        for c in ordered[:top]:
+            rows.append(
+                f"{c.kind:<16}{'×'.join(c.axes):<14}"
+                f"{c.total_bytes / 1024:>10.2f}{c.total_time_s * 1e6:>8.2f}"
+                f"  {'yes' if c.planned else 'NO':<4}  {c.primitive}")
+        return "\n".join(rows)
+
+    def summary(self) -> str:
+        budget = (f" / budget {self.hbm_budget_bytes / 2**30:.2f} GiB"
+                  if self.hbm_budget_bytes else "")
+        mesh = ",".join(f"{k}={v}" for k, v in self.mesh.items())
+        unplanned = sum(1 for c in self.collectives if not c.planned)
+        return (f"[shardplan] {self.name} on ({mesh}) @ {self.chip.name}: "
+                f"per-chip peak HBM "
+                f"{self.per_chip_peak_hbm_bytes / 2**20:.2f} MiB{budget}, "
+                f"{len(self.collectives)} collective(s) "
+                f"({unplanned} unplanned, "
+                f"{self.comm_bytes / 2**20:.3f} MiB on wire), "
+                f"comm {self.comm_time_s * 1e6:.1f} µs vs compute "
+                f"{self.compute_time_s * 1e6:.1f} µs, "
+                f"{len(self.diagnostics)} diagnostic(s)")
+
+
+@dataclasses.dataclass
+class PlanRequest:
+    """Opt-in config for ``Model.fit(shardplan=...)`` /
+    ``ServingConfig.shardplan`` and the CLI — everything
+    :func:`plan_train_step` / :func:`plan_step` need beyond the trace."""
+
+    mesh: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {"data": 2, "fsdp": 2, "tp": 2})
+    layout: Any = None            # SpecLayout; None → default
+    chip: str = "cpu"
+    hbm_budget_bytes: Optional[int] = None
+    s205_bytes: int = 1 << 20     # unplanned-gather ERROR threshold
+    s206_bytes: int = 8 << 20     # replicated-param WARNING threshold
+    raise_on_error: bool = True
+
+    def resolved_layout(self):
+        if self.layout is not None:
+            return self.layout
+        from ..distributed.sharding import SpecLayout
+
+        return SpecLayout()
+
+
+# ---------------------------------------------------------------------------
+# the propagator
+# ---------------------------------------------------------------------------
+
+class _Planner:
+    """Single forward sharding-propagation pass over a (nested) jaxpr.
+
+    ``env`` maps every visited jaxpr Var to its ShardSpec — including
+    vars of inner jaxprs, so the shard-aware liveness callback can
+    resolve any var the peak-HBM walk touches."""
+
+    def __init__(self, mesh: Dict[str, int], chip: ChipProfile):
+        self.mesh = dict(mesh)
+        self.chip = chip
+        self.env: Dict[Any, ShardSpec] = {}
+        self.collectives: List[Collective] = []
+
+    # -- env ---------------------------------------------------------------
+
+    def spec_of(self, v) -> ShardSpec:
+        if isinstance(v, jax.core.Literal):
+            return _rep(_rank(v))
+        return self.env.get(v, _rep(_rank(v)))
+
+    def set_spec(self, v, spec: ShardSpec):
+        if isinstance(v, jax.core.Literal):
+            return
+        self.env[v] = self._drop_indivisible(v, spec)
+
+    def _drop_indivisible(self, v, spec: ShardSpec) -> ShardSpec:
+        """A dim not divisible by its axis product cannot actually be
+        sharded — treat it as replicated here (S204 complains at the
+        layout level)."""
+        shape = getattr(v.aval, "shape", ()) or ()
+        out = []
+        for dim, entry in enumerate(spec):
+            n = _axes_product(entry, self.mesh)
+            if n > 1 and dim < len(shape) and int(shape[dim]) % n != 0:
+                out.append(())
+            else:
+                out.append(entry)
+        return tuple(out)
+
+    # -- collective emission -----------------------------------------------
+
+    def emit(self, kind: str, axes: Sequence[str], payload: float,
+             planned: bool, primitive: str, mul: float):
+        axes = tuple(a for a in axes if self.mesh.get(a, 1) > 1)
+        n = _axes_product(axes, self.mesh)
+        if n <= 1 or payload <= 0:
+            return
+        factor = 2.0 * (n - 1) / n if kind == "all_reduce" else (n - 1) / n
+        moved = int(payload * factor)
+        self.collectives.append(Collective(
+            kind=kind, axes=axes, payload_bytes=int(payload),
+            bytes_moved=moved,
+            time_s=estimate_collective_time(moved, self.chip),
+            planned=planned, primitive=primitive, count=mul))
+
+    def _dedupe(self, spec: ShardSpec, used: set, out_bytes: float,
+                primitive: str, mul: float, planned: bool = False
+                ) -> ShardSpec:
+        """Drop axes already claimed elsewhere in the output; every drop
+        of a real (>1) axis means the value must be gathered along it."""
+        result: List[Tuple[str, ...]] = []
+        for entry in spec:
+            kept = []
+            for a in entry:
+                if a in used:
+                    if self.mesh.get(a, 1) > 1:
+                        self.emit("all_gather", (a,),
+                                  out_bytes / _axes_product([a], self.mesh),
+                                  planned, primitive, mul)
+                else:
+                    used.add(a)
+                    kept.append(a)
+            result.append(tuple(kept))
+        return tuple(result)
+
+    # -- walk --------------------------------------------------------------
+
+    def run(self, jaxpr, mul: float = 1.0):
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, mul)
+
+    def _eqn(self, eqn, mul: float):
+        name = eqn.primitive.name
+        handler = _RULES.get(name)
+        if handler is not None:
+            handler(self, eqn, mul)
+        elif name in ("cond", "while", "scan", "pjit") or \
+                "jaxpr" in eqn.params or "call_jaxpr" in eqn.params:
+            self._call_like(eqn, mul)
+        else:
+            self._default(eqn, mul)
+
+    # -- generic rules -----------------------------------------------------
+
+    def _default(self, eqn, mul: float):
+        """Elementwise/unknown: per-dim union across same-shaped
+        operands; disagreeing operands lose their axes (unplanned
+        gather); unknown shapes replicate without inventing traffic."""
+        for out in eqn.outvars:
+            out_shape = getattr(out.aval, "shape", ()) or ()
+            rank = len(out_shape)
+            merged: List[Tuple[str, ...]] = [()] * rank
+            conflict_axes: set = set()
+            for v in eqn.invars:
+                if isinstance(v, jax.core.Literal):
+                    continue
+                if (getattr(v.aval, "shape", None) or ()) != tuple(out_shape):
+                    continue
+                spec = self.spec_of(v)
+                for d in range(rank):
+                    if not spec[d]:
+                        continue
+                    if not merged[d]:
+                        merged[d] = spec[d]
+                    elif merged[d] != spec[d]:
+                        conflict_axes.update(set(spec[d]) - set(merged[d]))
+            for a in sorted(conflict_axes):
+                self.emit("all_gather", (a,),
+                          _aval_bytes(out.aval)
+                          / _axes_product([a], self.mesh),
+                          False, eqn.primitive.name, mul)
+            used: set = set()
+            final = self._dedupe(tuple(merged), used,
+                                 _aval_bytes(out.aval),
+                                 eqn.primitive.name, mul)
+            self.set_spec(out, final)
+
+    def _match_specs(self, outer_vars, inner_vars, outer_to_inner: bool):
+        """Shape-aware pairing for call-like eqns: equal shapes copy the
+        spec; a rank-1 difference with a matching tail is scan's
+        stacked/per-iteration relationship (strip or prepend the leading
+        dim); anything else replicates."""
+        for ov, iv in zip(outer_vars, inner_vars):
+            src, dst = (ov, iv) if outer_to_inner else (iv, ov)
+            if isinstance(dst, jax.core.Literal):
+                continue
+            s_shape = tuple(getattr(src.aval, "shape", ()) or ())
+            d_shape = tuple(getattr(dst.aval, "shape", ()) or ())
+            spec = self.spec_of(src)
+            if s_shape == d_shape:
+                self.set_spec(dst, spec)
+            elif len(s_shape) == len(d_shape) + 1 and s_shape[1:] == d_shape:
+                self.set_spec(dst, spec[1:])
+            elif len(d_shape) == len(s_shape) + 1 and d_shape[1:] == s_shape:
+                self.set_spec(dst, ((),) + spec)
+            else:
+                self.set_spec(dst, _rep(len(d_shape)))
+
+    def _call_like(self, eqn, mul: float):
+        name = eqn.primitive.name
+        params = eqn.params
+        if name == "cond":
+            branches = params["branches"]
+            ops = eqn.invars[1:]
+            # propagate every branch (liveness needs the env), but only
+            # keep the most expensive branch's collectives — branches
+            # are exclusive, same policy as the cost walk
+            base = len(self.collectives)
+            best: List[Collective] = []
+            best_cost = -1.0
+            for b in branches:
+                inner = b.jaxpr
+                self._match_specs(ops, inner.invars, True)
+                self.run(inner, mul)
+                mine = self.collectives[base:]
+                del self.collectives[base:]
+                cost = sum(c.total_bytes for c in mine)
+                if cost > best_cost:
+                    best, best_cost = mine, cost
+                    self._match_specs(eqn.outvars, inner.outvars, False)
+            self.collectives.extend(best)
+            return
+        if name == "while":
+            cn = int(params.get("cond_nconsts", 0))
+            bn = int(params.get("body_nconsts", 0))
+            cond_j = params["cond_jaxpr"].jaxpr
+            body_j = params["body_jaxpr"].jaxpr
+            carry = eqn.invars[cn + bn:]
+            self._match_specs(eqn.invars[:cn] + carry, cond_j.invars, True)
+            self._match_specs(eqn.invars[cn:cn + bn] + carry,
+                              body_j.invars, True)
+            self.run(cond_j, mul)
+            self.run(body_j, mul)
+            self._match_specs(eqn.outvars, body_j.outvars, False)
+            return
+        if name == "scan":
+            inner = params["jaxpr"].jaxpr
+            trips = float(params.get("length", 1))
+            self._match_specs(eqn.invars, inner.invars, True)
+            self.run(inner, mul * trips)
+            self._match_specs(eqn.outvars, inner.outvars, False)
+            return
+        inner = params.get("jaxpr", params.get("call_jaxpr"))
+        inner = getattr(inner, "jaxpr", inner)
+        self._match_specs(eqn.invars, inner.invars, True)
+        self.run(inner, mul)
+        self._match_specs(eqn.outvars, inner.outvars, False)
+
+
+# ---------------------------------------------------------------------------
+# primitive-specific propagation rules
+# ---------------------------------------------------------------------------
+
+def _rule_dot_general(pl: _Planner, eqn, mul: float):
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0], eqn.invars[1]
+    ls, rs = pl.spec_of(lhs), pl.spec_of(rhs)
+    out = eqn.outvars[0]
+    out_bytes = _aval_bytes(out.aval)
+
+    # contraction: axes sharded on BOTH sides → partial sums, one
+    # planned all-reduce of the (already-assembled) output; axes on one
+    # side only → planned all-gather of that operand (FSDP resolution)
+    reduce_axes: List[str] = []
+    for li, ri in zip(lc, rc):
+        both = set(ls[li]) & set(rs[ri])
+        reduce_axes.extend(sorted(both))
+        for side_spec, side_var, dim in ((ls, lhs, li), (rs, rhs, ri)):
+            only = set(side_spec[dim]) - both
+            for a in sorted(only):
+                payload = (_aval_bytes(side_var.aval)
+                           / _shard_count(pl.spec_of(side_var), pl.mesh)
+                           * _axes_product([a], pl.mesh))
+                pl.emit("all_gather", (a,), payload, True,
+                        "dot_general", mul)
+
+    # output dims: batch, then lhs free, then rhs free
+    used: set = set(reduce_axes)
+    out_spec: List[Tuple[str, ...]] = []
+    for li, ri in zip(lb, rb):
+        axes = tuple(ls[li]) if ls[li] else tuple(rs[ri])
+        if ls[li] and rs[ri] and set(ls[li]) != set(rs[ri]):
+            for a in sorted(set(rs[ri]) - set(ls[li])):
+                pl.emit("all_gather", (a,),
+                        out_bytes / _axes_product([a], pl.mesh),
+                        False, "dot_general", mul)
+        out_spec.append(axes)
+    for i in range(len(ls)):
+        if i not in tuple(lc) + tuple(lb):
+            out_spec.append(tuple(ls[i]))
+    for i in range(len(rs)):
+        if i not in tuple(rc) + tuple(rb):
+            out_spec.append(tuple(rs[i]))
+    final = pl._dedupe(tuple(out_spec), used, out_bytes, "dot_general", mul)
+    pl.set_spec(out, final)
+    if reduce_axes:
+        payload = out_bytes / _shard_count(final, pl.mesh)
+        pl.emit("all_reduce", tuple(sorted(set(reduce_axes))), payload,
+                True, "dot_general", mul)
+
+
+def _rule_transpose(pl: _Planner, eqn, mul: float):
+    perm = eqn.params["permutation"]
+    spec = pl.spec_of(eqn.invars[0])
+    pl.set_spec(eqn.outvars[0], tuple(spec[p] for p in perm))
+
+
+def _rule_broadcast_in_dim(pl: _Planner, eqn, mul: float):
+    bdims = eqn.params["broadcast_dimensions"]
+    in_v, out = eqn.invars[0], eqn.outvars[0]
+    spec = pl.spec_of(in_v)
+    in_shape = tuple(getattr(in_v.aval, "shape", ()) or ())
+    out_shape = tuple(out.aval.shape)
+    out_spec = [()] * len(out_shape)
+    for i, j in enumerate(bdims):
+        if i < len(in_shape) and in_shape[i] == out_shape[j]:
+            out_spec[j] = spec[i]
+    pl.set_spec(out, tuple(out_spec))
+
+
+def _reshape_groups(src: Sequence[int], dst: Sequence[int]):
+    """Pair contiguous runs of src/dst dims with equal element products.
+    Yields (src_dims, dst_dims) groups, or None if the factorization
+    doesn't line up (fallback: drop all sharding)."""
+    groups = []
+    i = j = 0
+    while i < len(src) or j < len(dst):
+        si, sj = i, j
+        pi = pj = 1
+        if i < len(src):
+            pi = src[i]
+            i += 1
+        if j < len(dst):
+            pj = dst[j]
+            j += 1
+        while pi != pj:
+            if pi < pj:
+                if i >= len(src):
+                    return None
+                pi *= src[i]
+                i += 1
+            else:
+                if j >= len(dst):
+                    return None
+                pj *= dst[j]
+                j += 1
+        groups.append((list(range(si, i)), list(range(sj, j))))
+    return groups
+
+
+def _rule_reshape(pl: _Planner, eqn, mul: float):
+    in_v, out = eqn.invars[0], eqn.outvars[0]
+    spec = pl.spec_of(in_v)
+    src = [int(s) for s in (getattr(in_v.aval, "shape", ()) or ())]
+    dst = [int(s) for s in out.aval.shape]
+    groups = _reshape_groups(src, dst)
+    out_spec: List[Tuple[str, ...]] = [()] * len(dst)
+    gathered: List[str] = []
+    if groups is None:
+        gathered = [a for e in spec for a in e]
+    else:
+        for sdims, ddims in groups:
+            sharded = [(d, spec[d]) for d in sdims if spec[d]]
+            if not sharded:
+                continue
+            # sharding survives a split/merge only when it lives on the
+            # MAJOR (outermost non-size-1) dim of the group and the
+            # receiving major dim divides by the axis product
+            major_s = [d for d in sdims if src[d] > 1]
+            major_d = [d for d in ddims if dst[d] > 1]
+            if len(sharded) == 1 and major_s and major_d and \
+                    sharded[0][0] == major_s[0]:
+                axes = sharded[0][1]
+                n = _axes_product(axes, pl.mesh)
+                if dst[major_d[0]] % max(1, n) == 0:
+                    out_spec[major_d[0]] = axes
+                    continue
+            gathered.extend(a for _, e in sharded for a in e)
+    for a in sorted(set(gathered)):
+        if pl.mesh.get(a, 1) > 1:
+            pl.emit("all_gather", (a,),
+                    _aval_bytes(out.aval) / _axes_product([a], pl.mesh),
+                    False, "reshape", mul)
+    pl.set_spec(out, tuple(out_spec))
+
+
+def _rule_reduce(pl: _Planner, eqn, mul: float):
+    axes = tuple(eqn.params.get("axes", ()))
+    in_v, out = eqn.invars[0], eqn.outvars[0]
+    spec = pl.spec_of(in_v)
+    out_spec = tuple(e for d, e in enumerate(spec) if d not in axes)
+    reduce_axes = sorted({a for d in axes if d < len(spec)
+                          for a in spec[d]})
+    pl.set_spec(out, out_spec)
+    if reduce_axes:
+        payload = (_aval_bytes(out.aval)
+                   / _shard_count(pl.spec_of(out), pl.mesh))
+        pl.emit("all_reduce", tuple(reduce_axes), payload, True,
+                eqn.primitive.name, mul)
+
+
+def _rule_gather(pl: _Planner, eqn, mul: float):
+    dn = eqn.params["dimension_numbers"]
+    operand, indices = eqn.invars[0], eqn.invars[1]
+    out = eqn.outvars[0]
+    ospec = pl.spec_of(operand)
+    ispec = pl.spec_of(indices)
+    slice_sizes = tuple(eqn.params.get("slice_sizes", ()))
+    op_shape = tuple(getattr(operand.aval, "shape", ()) or ())
+    out_rank = len(out.aval.shape)
+    offset = tuple(dn.offset_dims)
+    collapsed = set(dn.collapsed_slice_dims)
+    out_spec: List[Tuple[str, ...]] = [()] * out_rank
+    # offset output dims ← non-collapsed operand dims, in order; the
+    # spec survives only full (unsliced) dims
+    slice_dims = [d for d in range(len(op_shape)) if d not in collapsed]
+    for pos, d in zip(sorted(offset), slice_dims):
+        full = (d < len(slice_sizes)
+                and int(slice_sizes[d]) == int(op_shape[d]))
+        if full:
+            out_spec[pos] = ospec[d]
+    # batch output dims ← indices dims (minus the index vector dim)
+    batch_pos = [p for p in range(out_rank) if p not in offset]
+    for p, d in zip(batch_pos, range(len(ispec))):
+        out_spec[p] = ispec[d]
+    used: set = set()
+    final = pl._dedupe(tuple(out_spec), used, _aval_bytes(out.aval),
+                       "gather", mul)
+    pl.set_spec(out, final)
+    # the vocab-parallel pattern: looking up along a SHARDED operand dim
+    # lowers to a masked local lookup + one planned all-reduce
+    lookup_axes = sorted({a for d in range(len(op_shape))
+                          if d in collapsed or (
+                              d < len(slice_sizes)
+                              and int(slice_sizes[d]) < int(op_shape[d]))
+                          for a in ospec[d]})
+    if lookup_axes:
+        payload = (_aval_bytes(out.aval)
+                   / _shard_count(pl.spec_of(out), pl.mesh))
+        pl.emit("all_reduce", tuple(lookup_axes), payload, True,
+                "gather", mul)
+
+
+def _rule_scatter(pl: _Planner, eqn, mul: float):
+    operand, updates = eqn.invars[0], eqn.invars[-1]
+    out = eqn.outvars[0]
+    ospec = pl.spec_of(operand)
+    pl.set_spec(out, ospec)
+    # scatter-add into a differently-sharded target (embedding grad):
+    # each chip owns partial updates — a planned grad-sync all-reduce
+    if eqn.primitive.name in ("scatter-add", "scatter_add"):
+        op_axes = {a for e in ospec for a in e}
+        upd_axes = {a for e in pl.spec_of(updates) for a in e}
+        sync = sorted(upd_axes - op_axes)
+        if sync:
+            payload = (_aval_bytes(out.aval)
+                       / _shard_count(ospec, pl.mesh))
+            pl.emit("all_reduce", tuple(sync), payload, True,
+                    eqn.primitive.name, mul)
+
+
+def _rule_concatenate(pl: _Planner, eqn, mul: float):
+    dim = int(eqn.params["dimension"])
+    out = eqn.outvars[0]
+    rank = len(out.aval.shape)
+    merged: List[Tuple[str, ...]] = [()] * rank
+    for v in eqn.invars:
+        if isinstance(v, jax.core.Literal):
+            continue
+        spec = pl.spec_of(v)
+        for d in range(min(rank, len(spec))):
+            if d != dim and spec[d] and not merged[d]:
+                merged[d] = spec[d]
+    used: set = set()
+    pl.set_spec(out, pl._dedupe(tuple(merged), used,
+                                _aval_bytes(out.aval), "concatenate", mul))
+
+
+def _rule_squeeze(pl: _Planner, eqn, mul: float):
+    dims = set(eqn.params.get("dimensions", ()))
+    spec = pl.spec_of(eqn.invars[0])
+    pl.set_spec(eqn.outvars[0],
+                tuple(e for d, e in enumerate(spec) if d not in dims))
+
+
+def _rule_expand_dims(pl: _Planner, eqn, mul: float):
+    dims = set(eqn.params.get("dimensions", ()))
+    spec = list(pl.spec_of(eqn.invars[0]))
+    out_rank = len(eqn.outvars[0].aval.shape)
+    out_spec: List[Tuple[str, ...]] = []
+    it = iter(spec)
+    for d in range(out_rank):
+        out_spec.append(() if d in dims else next(it, ()))
+    pl.set_spec(eqn.outvars[0], tuple(out_spec))
+
+
+def _rule_shape_preserving(pl: _Planner, eqn, mul: float):
+    """Ops where output dims correspond 1:1 to input dims but a dim's
+    EXTENT may shrink (slice, pad, dynamic_slice...): keep the spec on
+    untouched dims, drop it where the extent changed."""
+    in_v, out = eqn.invars[0], eqn.outvars[0]
+    spec = pl.spec_of(in_v)
+    in_shape = tuple(getattr(in_v.aval, "shape", ()) or ())
+    out_shape = tuple(out.aval.shape)
+    if len(in_shape) != len(out_shape):
+        pl.set_spec(out, _rep(len(out_shape)))
+        return
+    pl.set_spec(out, tuple(
+        spec[d] if in_shape[d] == out_shape[d] else ()
+        for d in range(len(out_shape))))
+
+
+def _rule_dynamic_update_slice(pl: _Planner, eqn, mul: float):
+    pl.set_spec(eqn.outvars[0], pl.spec_of(eqn.invars[0]))
+
+
+def _rule_replicated(pl: _Planner, eqn, mul: float):
+    for out in eqn.outvars:
+        pl.set_spec(out, _rep(_rank(out)))
+
+
+def _make_collective_rule(kind: str):
+    def rule(pl: _Planner, eqn, mul: float):
+        axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if isinstance(axes, str):
+            axes = (axes,)
+        axes = tuple(str(a) for a in (axes or ()))
+        for v, out in zip(eqn.invars, eqn.outvars):
+            payload = (_aval_bytes(getattr(v, "aval", None) or out.aval)
+                       / _shard_count(pl.spec_of(v), pl.mesh))
+            pl.emit(kind, axes, payload, True, eqn.primitive.name, mul)
+            pl.set_spec(out, _rep(_rank(out)))
+    return rule
+
+
+_RULES = {
+    "dot_general": _rule_dot_general,
+    "transpose": _rule_transpose,
+    "broadcast_in_dim": _rule_broadcast_in_dim,
+    "reshape": _rule_reshape,
+    "reduce_sum": _rule_reduce,
+    "reduce_max": _rule_reduce,
+    "reduce_min": _rule_reduce,
+    "reduce_prod": _rule_reduce,
+    "reduce_and": _rule_reduce,
+    "reduce_or": _rule_reduce,
+    "argmax": _rule_reduce,
+    "argmin": _rule_reduce,
+    "gather": _rule_gather,
+    "scatter": _rule_scatter,
+    "scatter-add": _rule_scatter,
+    "scatter_add": _rule_scatter,
+    "concatenate": _rule_concatenate,
+    "squeeze": _rule_squeeze,
+    "expand_dims": _rule_expand_dims,
+    "slice": _rule_shape_preserving,
+    "dynamic_slice": _rule_shape_preserving,
+    "pad": _rule_shape_preserving,
+    "rev": _rule_shape_preserving,
+    "dynamic_update_slice": _rule_dynamic_update_slice,
+    "iota": _rule_replicated,
+    "psum": _make_collective_rule("all_reduce"),
+    "all_gather": _make_collective_rule("all_gather"),
+    "psum_scatter": _make_collective_rule("reduce_scatter"),
+    "all_to_all": _make_collective_rule("all_to_all"),
+}
+
+
+# ---------------------------------------------------------------------------
+# plan_jaxpr — the core entry every wrapper funnels into
+# ---------------------------------------------------------------------------
+
+def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
+               mesh: Dict[str, int],
+               name: str = "<jaxpr>",
+               chip: str = "cpu",
+               hbm_budget_bytes: Optional[int] = None,
+               constvar_specs: Optional[Sequence[Any]] = None,
+               extra_var_specs: Sequence[Tuple[Any, Any]] = (),
+               param_info: Sequence[Tuple[str, int, Any]] = (),
+               data_inputs: Sequence[Tuple[str, int]] = (),
+               data_axis: str = "data",
+               s205_bytes: int = 1 << 20,
+               s206_bytes: int = 8 << 20) -> PlanReport:
+    """Propagate ``invar_specs`` (one PartitionSpec-like or None per
+    jaxpr invar; ``constvar_specs`` likewise for constvars) through
+    ``closed`` on the abstract ``mesh`` and build the
+    :class:`PlanReport`.
+
+    ``param_info`` is ``[(name, nbytes, spec)]`` for S206;
+    ``data_inputs`` is ``[(label, invar_index)]`` naming which invars
+    carry a batch dimension S208 should check.
+    """
+    profile = CHIPS[chip] if isinstance(chip, str) else chip
+    mesh = {str(k): int(v) for k, v in dict(mesh).items()}
+    n_chips = 1
+    for v in mesh.values():
+        n_chips *= v
+    jaxpr = closed.jaxpr
+    pl = _Planner(mesh, profile)
+    for v, spec in zip(jaxpr.invars, list(invar_specs) or []):
+        pl.set_spec(v, _normalize_spec(spec, _rank(v)))
+    for v, spec in zip(jaxpr.constvars, list(constvar_specs or [])):
+        pl.set_spec(v, _normalize_spec(spec, _rank(v)))
+    for v, spec in extra_var_specs:
+        pl.set_spec(v, _normalize_spec(spec, _rank(v)))
+    pl.run(jaxpr)
+
+    # whole-program cost (all chips) for the S207 comparison
+    acc: Dict[str, List[float]] = {}
+    _collect_costs(jaxpr, 1.0, acc)
+    flops = sum(v[0] for v in acc.values())
+    byts = sum(v[1] for v in acc.values())
+
+    def sharded_bytes(v) -> int:
+        b = _var_bytes(v)
+        if isinstance(v, jax.core.Literal) or b == 0:
+            return b
+        n = _shard_count(pl.spec_of(v), pl.mesh)
+        return -(-b // n)  # ceil: padding never under-counts
+
+    peak = _peak_live_bytes(jaxpr, sharded_bytes)
+
+    where = f"shardplan:{name}"
+    diags: List[Diagnostic] = []
+
+    # S205 — resharding hotspots: unplanned gathers grouped per
+    # (primitive, axes) edge so one conflicted layer reads as one finding
+    grouped: Dict[Tuple[str, Tuple[str, ...], str], float] = {}
+    for c in pl.collectives:
+        if not c.planned:
+            key = (c.primitive, c.axes, c.kind)
+            grouped[key] = grouped.get(key, 0.0) + c.total_bytes
+    for (prim, axes, kind), total in sorted(grouped.items()):
+        if total >= s205_bytes:
+            diags.append(Diagnostic(
+                "S205", ERROR,
+                f"resharding hotspot: spec conflict at '{prim}' forces an "
+                f"unplanned {kind} over mesh axes {list(axes)} moving "
+                f"{total / 1024:.1f} KiB/chip — the layout fights itself "
+                "on this edge; re-shard the producer or consumer so both "
+                "agree", where))
+
+    # S206 — fully-replicated large parameter: every chip burns its
+    # full size (undonated-style HBM waste times the whole mesh)
+    for pname, nbytes, spec in param_info:
+        nspec = _normalize_spec(spec, len(spec or ()))
+        if any(e for e in nspec) or nbytes < s206_bytes:
+            continue
+        diags.append(Diagnostic(
+            "S206", WARNING,
+            f"param {pname!r} ({nbytes / 2**20:.1f} MiB) is fully "
+            f"replicated across all {n_chips} chips — "
+            f"{nbytes * n_chips / 2**20:.1f} MiB of mesh HBM for one "
+            "tensor; shard it on 'fsdp' unless it is genuinely tiny",
+            where))
+
+    # S207 — collective-bound step
+    comm_t = sum(c.total_time_s for c in pl.collectives)
+    compute_t = estimate_compute_time(flops / max(1, n_chips),
+                                      byts / max(1, n_chips), profile)
+    if comm_t > compute_t:
+        diags.append(Diagnostic(
+            "S207", ERROR,
+            f"collective-bound: estimated comm {comm_t * 1e6:.1f} µs "
+            f"exceeds per-chip compute {compute_t * 1e6:.1f} µs on "
+            f"{profile.name} — the mesh spends the step waiting on ICI; "
+            "shard less aggressively or grow the per-chip work", where))
+
+    # S208 — batch dim not on the data axis
+    d_size = mesh.get(data_axis, 1)
+    if d_size > 1:
+        for label, idx in data_inputs:
+            if idx >= len(jaxpr.invars):
+                continue
+            v = jaxpr.invars[idx]
+            shape = tuple(getattr(v.aval, "shape", ()) or ())
+            if not shape or shape[0] <= 1 or shape[0] % d_size != 0:
+                continue  # batch=1 (chunked prefill) legitimately can't
+            spec = pl.spec_of(v)
+            if data_axis not in (spec[0] if spec else ()):
+                diags.append(Diagnostic(
+                    "S208", WARNING,
+                    f"batch dim of input {label!r} {shape} is not sharded "
+                    f"on the {data_axis!r} axis (size {d_size}) — the "
+                    "whole batch is replicated; data parallelism buys "
+                    "nothing for this input", where))
+
+    if hbm_budget_bytes is not None and peak > hbm_budget_bytes:
+        diags.append(Diagnostic(
+            "H110", ERROR,
+            f"per-chip peak live HBM {peak / 2**30:.3f} GiB exceeds the "
+            f"{hbm_budget_bytes / 2**30:.3f} GiB per-chip budget on this "
+            f"{_mesh_str(mesh)} mesh — shard further, shrink the batch, "
+            "or pick a bigger chip", where))
+
+    from .hazards import sort_diagnostics
+
+    param_specs = {pname: _spec_str(_normalize_spec(spec, len(spec or ())))
+                   for pname, _, spec in param_info}
+    return PlanReport(
+        name=name, chip=profile, mesh=mesh, n_chips=n_chips,
+        per_chip_peak_hbm_bytes=peak, collectives=pl.collectives,
+        flops=flops, bytes=byts, diagnostics=sort_diagnostics(diags),
+        param_specs=param_specs, hbm_budget_bytes=hbm_budget_bytes)
+
+
+def _mesh_str(mesh: Dict[str, int]) -> str:
+    return "(" + ",".join(f"{k}={v}" for k, v in mesh.items()) + ")"
+
+
+# ---------------------------------------------------------------------------
+# wrappers: train step, serving step, the default audit
+# ---------------------------------------------------------------------------
+
+def _param_names(sfn) -> Dict[int, str]:
+    """id(param) → qualified name, walked over the layers the static
+    function discovered (the model is always among them)."""
+    names: Dict[int, str] = {}
+    for layer in (sfn._layers or ()):
+        for n, p in layer.named_parameters():
+            names.setdefault(id(p), n)
+    return names
+
+
+def plan_train_step(step_fn, inputs, labels, *,
+                    request: Optional[PlanRequest] = None,
+                    name: str = "hapi::train_step") -> PlanReport:
+    """Plan a ``jit.to_static`` train step (or its observability
+    wrapper) on sample ``inputs``/``labels``.  The trace's invar layout
+    is ``state ++ dyn ++ lrs ++ rng``; params take the layout's role
+    spec, optimizer slots inherit their param's spec, inputs take the
+    batch spec, everything else replicates."""
+    req = request or PlanRequest()
+    layout = req.resolved_layout()
+    sfn = getattr(step_fn, "_fn", step_fn)
+    closed, _donated = sfn.trace_jaxpr(inputs, labels)
+    state = sfn._state
+    names = _param_names(sfn)
+    by_id: Dict[int, Any] = {}
+    param_info: List[Tuple[str, int, Any]] = []
+    for i, p in enumerate(state.params):
+        pname = names.get(id(p), f"param{i}")
+        spec = layout.param_spec(pname)
+        by_id[id(p)] = spec
+        param_info.append((pname, _aval_bytes(p._value), spec))
+
+    n_in = len(closed.jaxpr.invars)
+    n_p, n_b = len(state.params), len(state.buffers)
+    slots = state.opt_slots()
+    specs: List[Any] = [None] * n_in
+    for i, p in enumerate(state.params):
+        if i < n_in:
+            specs[i] = by_id[id(p)]
+    for j, (_store, key) in enumerate(slots):
+        idx = n_p + n_b + j
+        if idx < n_in and key in by_id:
+            specs[idx] = by_id[key]      # slot keyed by id(param)
+    dyn_lo, dyn_hi = n_p + n_b + len(slots), n_in - 2
+    data_inputs: List[Tuple[str, int]] = []
+    batch = layout.batch_spec()
+    for idx in range(dyn_lo, dyn_hi):
+        specs[idx] = batch
+        data_inputs.append((f"dyn{idx - dyn_lo}", idx))
+    return plan_jaxpr(
+        closed, specs, mesh=req.mesh, name=name, chip=req.chip,
+        hbm_budget_bytes=req.hbm_budget_bytes, param_info=param_info,
+        data_inputs=data_inputs, data_axis=layout.data_axis,
+        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes)
+
+
+def plan_step(step, abstract_args: Sequence[Any], *, model,
+              arg_specs: Sequence[Any],
+              request: Optional[PlanRequest] = None,
+              name: str = "<step>",
+              data_input_leaves: Sequence[Tuple[str, int]] = ()
+              ) -> PlanReport:
+    """Plan a serving-style step traced with ``jax.make_jaxpr``.  The
+    model weights are captured as jit CONSTANTS, so they surface as
+    jaxpr constvars — matched back to named parameters by identity.
+    ``arg_specs`` mirrors ``abstract_args``' pytree structure;
+    ``data_input_leaves`` names flat leaf indices S208 should check."""
+    from .xray import _as_abstract
+
+    req = request or PlanRequest()
+    layout = req.resolved_layout()
+    fn = step
+    if hasattr(fn, "_fn") and hasattr(fn, "compiles"):
+        fn = fn._fn
+    args = [jax.tree_util.tree_map(_as_abstract, a,
+                                   is_leaf=lambda x: hasattr(x, "_value"))
+            for a in abstract_args]
+    closed = jax.make_jaxpr(fn)(*args)
+    flat_specs: List[Any] = []
+    for spec, arg in zip(arg_specs, args):
+        _flatten_specs_like(spec, arg, flat_specs)
+    # jitted steps trace to one pjit eqn: the captured weights are
+    # consts of NESTED closed jaxprs, not the top level — walk them all
+    by_value: Dict[int, str] = {id(p._value): n
+                                for n, p in model.named_parameters()}
+    extra: List[Tuple[Any, Any]] = []
+    param_info: List[Tuple[str, int, Any]] = []
+    seen: set = set()
+    for var, val in _iter_const_bindings(closed):
+        pname = by_value.get(id(val))
+        if pname is None:
+            continue
+        spec = layout.param_spec(pname)
+        extra.append((var, spec))
+        if pname not in seen:
+            seen.add(pname)
+            param_info.append((pname, _var_bytes(var), spec))
+    return plan_jaxpr(
+        closed, flat_specs, mesh=req.mesh, name=name, chip=req.chip,
+        hbm_budget_bytes=req.hbm_budget_bytes,
+        extra_var_specs=extra, param_info=param_info,
+        data_inputs=data_input_leaves, data_axis=layout.data_axis,
+        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes)
+
+
+def _iter_const_bindings(closed):
+    """Yield ``(constvar, const_value)`` pairs for a ClosedJaxpr and
+    every ClosedJaxpr nested in its equations (pjit / scan / while /
+    cond / custom_* all carry their own consts)."""
+    yield from zip(closed.jaxpr.constvars, closed.consts)
+    for eqn in closed.jaxpr.eqns:
+        for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+            inner = eqn.params.get(key)
+            if inner is not None and hasattr(inner, "consts"):
+                yield from _iter_const_bindings(inner)
+        for b in eqn.params.get("branches", ()):
+            if hasattr(b, "consts"):
+                yield from _iter_const_bindings(b)
+
+
+def _flatten_specs_like(spec, arg, out: List[Any]):
+    """Walk ``spec`` alongside ``arg``'s container structure, emitting
+    one spec per array leaf in jax flattening order.  A PartitionSpec
+    (or None) against a container broadcasts over every leaf under it."""
+    from jax.sharding import PartitionSpec
+
+    if isinstance(arg, dict):
+        for k in sorted(arg):
+            sub = spec.get(k) if isinstance(spec, dict) else spec
+            _flatten_specs_like(sub, arg[k], out)
+        return
+    if isinstance(arg, (list, tuple)):
+        broadcast = (spec is None or isinstance(spec, PartitionSpec)
+                     or not isinstance(spec, (list, tuple)))
+        for i, a in enumerate(arg):
+            _flatten_specs_like(spec if broadcast else spec[i], a, out)
+        return
+    out.append(spec)
+
+
+def _serving_arg_specs(model, layout, decode_args, prefill_args):
+    """Specs mirroring ``xray._serving_abstract_args``' structure: KV
+    pools shard kv-heads on ``tp`` (SNIPPETS [3] style), per-sequence
+    buffers shard batch on ``data``; prefill runs batch=1, replicated."""
+    from jax.sharding import PartitionSpec
+
+    tp = layout.tp_axis
+    pool_spec = [(PartitionSpec(None, None, tp, None),
+                  PartitionSpec(None, None, tp, None))
+                 for _ in decode_args[1]]
+    batch = layout.batch_spec()
+    decode = (batch, pool_spec, batch, batch)
+    prefill = (PartitionSpec(), pool_spec, PartitionSpec(),
+               PartitionSpec(), PartitionSpec())
+    return decode, prefill
+
+
+def audit_shardplan(*, chip: str = "cpu",
+                    hbm_budget_bytes: Optional[int] = None,
+                    mesh: Optional[Dict[str, int]] = None,
+                    layout: Any = None,
+                    s205_bytes: int = 1 << 10,
+                    s206_bytes: int = 8 << 20) -> List[PlanReport]:
+    """Plan all three default step kinds (train, paged decode, chunked
+    prefill) for a tiny Llama against the canonical llama SpecLayout on
+    a simulated ``(data=2, fsdp=2, tp=2)`` mesh — entirely on CPU, no
+    devices.  The ``lint_tpu.py --shardplan`` / CI entry point; callers
+    gate on ``report.errors()``.
+
+    The S205 threshold defaults to 1 KiB here (not the production
+    1 MiB): the CI model is tiny, and a CLEAN layout emits zero
+    unplanned collectives regardless of scale — any unplanned byte on
+    this model means real conflict at any size."""
+    import paddle_tpu as paddle
+    from .. import nn
+    from ..models import LlamaConfig, LlamaForCausalLM
+    from ..optimizer import AdamW
+
+    req = PlanRequest(mesh=mesh or {"data": 2, "fsdp": 2, "tp": 2},
+                      layout=layout, chip=chip,
+                      hbm_budget_bytes=hbm_budget_bytes,
+                      s205_bytes=s205_bytes, s206_bytes=s206_bytes)
+    lay = req.resolved_layout()
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    net = LlamaForCausalLM(cfg)
+    reports: List[PlanReport] = []
+
+    model = paddle.Model(net)
+    model.prepare(AdamW(1e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    ids = np.zeros((2, 16), np.int64)
+    reports.append(plan_train_step(
+        model._train_step_fn, [paddle.to_tensor(ids[:, :-1])],
+        [paddle.to_tensor(ids[:, 1:])], request=req))
+
+    from ..models.generation import (make_chunked_prefill_step,
+                                     make_paged_decode_step)
+    from .xray import _serving_abstract_args
+
+    net.eval()
+    decode_args, prefill_args = _serving_abstract_args(
+        net, batch=4, num_blocks=32, block_size=8,
+        max_blocks_per_seq=8, chunk_tokens=32)
+    decode_specs, prefill_specs = _serving_arg_specs(
+        net, lay, decode_args, prefill_args)
+    reports.append(plan_step(
+        make_paged_decode_step(net), decode_args, model=net,
+        arg_specs=decode_specs, request=req,
+        name="serving::decode_step",
+        data_input_leaves=(("tokens", 0),)))
+    reports.append(plan_step(
+        make_chunked_prefill_step(net), prefill_args, model=net,
+        arg_specs=prefill_specs, request=req,
+        name="serving::prefill_step",
+        data_input_leaves=(("chunk_ids", 0),)))
+    for r in reports:
+        export_plan_gauges(r)
+    return reports
+
+
+def export_plan_gauges(report: PlanReport):
+    """Mirror a plan's headline numbers into the observability registry
+    (no-op when telemetry is disabled)."""
+    from .. import observability
+
+    if not observability.enabled():
+        return
+    reg = observability.get_registry()
+    reg.gauge("shardplan_comm_bytes",
+              "total per-chip collective wire bytes of a planned step"
+              ).set(report.comm_bytes, step=report.name)
+    reg.gauge("shardplan_per_chip_peak_hbm_bytes",
+              "shard-aware liveness peak HBM per chip of a planned step"
+              ).set(report.per_chip_peak_hbm_bytes, step=report.name)

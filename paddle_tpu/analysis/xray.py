@@ -68,6 +68,8 @@ __all__ = [
     "analyze_train_step",
     "audit_default_steps",
     "check_sharding_readiness",
+    "estimate_collective_time",
+    "estimate_compute_time",
     "export_report_gauges",
 ]
 
@@ -89,6 +91,8 @@ class ChipProfile:
     peak_flops: float        # FLOP/s (bf16)
     hbm_bandwidth: float     # bytes/s
     hbm_bytes: int           # capacity per chip
+    ici_bandwidth: float = 1e11   # bytes/s per chip over the interconnect
+    ici_latency: float = 1e-6     # per-collective launch latency, seconds
 
     @property
     def ridge(self) -> float:
@@ -96,13 +100,37 @@ class ChipProfile:
         return self.peak_flops / self.hbm_bandwidth
 
 
+# ICI figures are aggregate per-chip interconnect bandwidth from the
+# public Cloud TPU system-architecture pages: v4 has 6 links x 50 GB/s
+# (3D torus, 2400 Gbps aggregate); v5e 4 links x 400 Gbps (1600 Gbps,
+# 2D torus); v5p 4800 Gbps over 6 links (3D torus); v6e (Trillium)
+# 3584 Gbps over 4 links.  Latency is the one-hop launch overhead, order
+# 1 us on real ICI.  "cpu" is loopback shared memory on the dev box —
+# fast and near-zero-latency so CPU CI classifies the tiny model as
+# compute-heavy the way a real topology-free single host would.
 CHIPS: Dict[str, ChipProfile] = {
-    "v4": ChipProfile("v4", 275e12, 1228e9, 32 << 30),
-    "v5e": ChipProfile("v5e", 197e12, 819e9, 16 << 30),
-    "v5p": ChipProfile("v5p", 459e12, 2765e9, 95 << 30),
-    "v6e": ChipProfile("v6e", 918e12, 1640e9, 32 << 30),
-    "cpu": ChipProfile("cpu", 5e11, 50e9, 8 << 30),
+    "v4": ChipProfile("v4", 275e12, 1228e9, 32 << 30, 300e9, 1e-6),
+    "v5e": ChipProfile("v5e", 197e12, 819e9, 16 << 30, 200e9, 1e-6),
+    "v5p": ChipProfile("v5p", 459e12, 2765e9, 95 << 30, 600e9, 1e-6),
+    "v6e": ChipProfile("v6e", 918e12, 1640e9, 32 << 30, 448e9, 1e-6),
+    "cpu": ChipProfile("cpu", 5e11, 50e9, 8 << 30, 200e9, 0.0),
 }
+
+
+def estimate_compute_time(flops: float, bytes_moved: float,
+                          chip: ChipProfile) -> float:
+    """Roofline step-time estimate: the max of the compute-bound and
+    memory-bound times.  Shared by the xray summary and shardplan's S207
+    so compute-vs-comm classification is consistent between the two."""
+    return max(flops / chip.peak_flops,
+               bytes_moved / chip.hbm_bandwidth)
+
+
+def estimate_collective_time(bytes_on_wire: float,
+                             chip: ChipProfile) -> float:
+    """Time for one collective that puts ``bytes_on_wire`` on each
+    chip's ICI links (ring-formula bytes, computed by the caller)."""
+    return bytes_on_wire / chip.ici_bandwidth + chip.ici_latency
 
 
 # ---------------------------------------------------------------------------
@@ -284,12 +312,16 @@ def _count_eqns(jaxpr) -> int:
 # liveness walk (peak HBM)
 # ---------------------------------------------------------------------------
 
-def _peak_live_bytes(jaxpr) -> int:
+def _peak_live_bytes(jaxpr, var_bytes=_var_bytes) -> int:
     """Linear-scan liveness over one open jaxpr: a var is live from its
     definition (entry for invars/constvars) to its last use (program end
     for outputs).  Call-like eqns add ``inner_peak - boundary`` as a
     transient — the inner program's scratch beyond what the caller
-    already accounts for at the call boundary."""
+    already accounts for at the call boundary.
+
+    ``var_bytes`` maps a jaxpr var (or Literal) to its byte size;
+    shardplan passes a shard-aware callback that divides each buffer by
+    its shard count, turning this same walk into *per-chip* peak HBM."""
     n = len(jaxpr.eqns)
     last_use: Dict[Any, int] = {}
     for i, eqn in enumerate(jaxpr.eqns):
@@ -301,20 +333,21 @@ def _peak_live_bytes(jaxpr) -> int:
             last_use[v] = n  # live through the end
     live: Dict[Any, int] = {}
     for v in tuple(jaxpr.invars) + tuple(jaxpr.constvars):
-        live[v] = _var_bytes(v)
+        live[v] = var_bytes(v)
     current = sum(live.values())
     peak = current
     for i, eqn in enumerate(jaxpr.eqns):
         for v in eqn.outvars:
             if v not in live:
-                live[v] = _var_bytes(v)
+                live[v] = var_bytes(v)
                 current += live[v]
         transient = 0
         subs = _sub_jaxprs(eqn)
         if subs:
-            boundary = (sum(_var_bytes(v) for v in eqn.invars)
-                        + sum(_var_bytes(v) for v in eqn.outvars))
-            inner_peak = max(_peak_live_bytes(inner) for inner, _ in subs)
+            boundary = (sum(var_bytes(v) for v in eqn.invars)
+                        + sum(var_bytes(v) for v in eqn.outvars))
+            inner_peak = max(_peak_live_bytes(inner, var_bytes)
+                             for inner, _ in subs)
             transient = max(0, inner_peak - boundary)
         peak = max(peak, current + transient)
         for v in tuple(eqn.invars) + tuple(eqn.outvars):
@@ -436,6 +469,12 @@ class ProgramReport:
     def arithmetic_intensity(self) -> float:
         return self.flops / self.bytes if self.bytes else 0.0
 
+    @property
+    def compute_time_s(self) -> float:
+        """Roofline single-chip step-time estimate (shared formula with
+        shardplan's comm-vs-compute classification)."""
+        return estimate_compute_time(self.flops, self.bytes, self.chip)
+
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.hazards if d.severity == ERROR]
 
@@ -457,7 +496,9 @@ class ProgramReport:
         return (f"[xray] {self.name}: {self.flops / 1e9:.3f} GFLOP, "
                 f"{self.bytes / 2**30:.3f} GiB moved, intensity "
                 f"{self.arithmetic_intensity:.2f} FLOP/B "
-                f"(ridge {self.chip.ridge:.1f} @ {self.chip.name}), "
+                f"(ridge {self.chip.ridge:.1f} @ {self.chip.name}, "
+                f"ici {self.chip.ici_bandwidth / 1e9:.0f} GB/s), "
+                f"est step {self.compute_time_s * 1e3:.3f} ms, "
                 f"peak HBM {self.peak_hbm_bytes / 2**20:.2f} MiB{budget}, "
                 f"{self.n_eqns} eqns, {len(self.hazards)} hazard(s)")
 
@@ -657,12 +698,13 @@ def check_sharding_readiness(layout: Dict[str, Any],
             factor = int(np.prod([mesh_sizes[a] for a in axes],
                                  dtype=np.int64))
             if factor and shape[dim] % factor != 0:
+                product = " × ".join(f"{a}={mesh_sizes[a]}" for a in axes)
                 diags.append(Diagnostic(
                     "S204", ERROR,
                     f"dim {dim} of {role!r} has size {shape[dim]}, not "
-                    f"divisible by {factor} (mesh axes {axes}) — GSPMD "
-                    "would pad every shard; pick a divisible dim or "
-                    "resize the mesh", where))
+                    f"divisible by the mesh-axis product {product} = "
+                    f"{factor} — GSPMD would pad every shard; pick a "
+                    "divisible dim or resize the mesh", where))
     from .hazards import sort_diagnostics
 
     return sort_diagnostics(diags)

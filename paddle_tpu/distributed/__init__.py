@@ -25,6 +25,8 @@ from .parallel_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .sharding import shard_tensor, shard_op, reshard  # noqa: F401
+from .sharding import (SpecLayout, llama_param_role,  # noqa: F401
+                       llama_param_specs)
 from .moe import ExpertMLP, MoELayer  # noqa: F401
 from .pipeline import (LayerDesc, PipelineLayer, PipelineParallel,  # noqa: F401
                        SharedLayerDesc, gpipe_spmd, pipeline_1f1b,

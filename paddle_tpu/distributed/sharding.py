@@ -7,7 +7,8 @@ device_put (eager) or with_sharding_constraint (inside a trace).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Dict, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -15,6 +16,105 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..core.dispatch import apply, in_static_trace
 from ..core.tensor import Tensor
 from .mesh import get_mesh
+
+
+# ---------------------------------------------------------------------------
+# canonical SpecLayout — PartitionSpecs per parameter role over the
+# data/fsdp/tp axes (SNIPPETS.md [3] idiom).  Authored now, validated
+# statically by analysis.shardplan / check_sharding_readiness, and the
+# layout the mesh-execution PR will hand to jit in_shardings.
+# ---------------------------------------------------------------------------
+
+#: param-role → substrings of the qualified parameter name that select it
+_LLAMA_ROLE_PATTERNS = (
+    ("embed", ("embed_tokens.weight",)),
+    ("lm_head", ("lm_head.weight",)),
+    ("attn_qkv", ("q_proj.weight", "k_proj.weight", "v_proj.weight")),
+    ("attn_out", ("o_proj.weight",)),
+    ("mlp_in", ("gate_proj.weight", "up_proj.weight")),
+    ("mlp_out", ("down_proj.weight",)),
+    ("norm", ("layernorm.weight", "norm.weight")),
+)
+
+
+def llama_param_role(name: str) -> Optional[str]:
+    """Map a qualified llama parameter name (``named_parameters`` key) to
+    its layout role, or None for a name no pattern covers."""
+    for role, pats in _LLAMA_ROLE_PATTERNS:
+        if any(name.endswith(p) for p in pats):
+            return role
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs per parameter role over named mesh axes.
+
+    Megatron-style tensor parallelism with FSDP weight sharding on the
+    orthogonal axis, batch on ``data``:
+
+    - ``attn_qkv`` / ``mlp_in``  ([in, out]): column-parallel — the
+      output-feature dim on ``tp``, the input dim sharded by ``fsdp``.
+    - ``attn_out`` / ``mlp_out`` ([in, out]): row-parallel — the
+      input-feature dim on ``tp`` (the contraction is sharded, so the
+      matmul ends in ONE planned all-reduce per block), output on
+      ``fsdp``.
+    - ``embed`` ([vocab, hidden]): vocab-parallel on ``tp``.
+    - ``lm_head`` ([hidden, vocab]): column-parallel (vocab on ``tp``).
+    - ``norm``: replicated — RMSNorm weights are a few KiB.
+
+    ``batch_axis`` is where activation batch dims live; the default
+    ``data`` is what S208 checks for.  Set it to None (or another axis)
+    to express deliberately degenerate layouts — the shardplan CLI's
+    injection knob does exactly that.
+    """
+
+    data_axis: str = "data"
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+    batch_axis: Optional[str] = "data"
+
+    def batch_spec(self) -> PartitionSpec:
+        """Spec for activation batch dims (inputs, labels, KV pools)."""
+        if self.batch_axis is None:
+            return PartitionSpec()
+        return PartitionSpec(self.batch_axis)
+
+    def spec_for_role(self, role: str) -> PartitionSpec:
+        table = {
+            "embed": PartitionSpec(self.tp_axis, self.fsdp_axis),
+            "lm_head": PartitionSpec(self.fsdp_axis, self.tp_axis),
+            "attn_qkv": PartitionSpec(self.fsdp_axis, self.tp_axis),
+            "attn_out": PartitionSpec(self.tp_axis, self.fsdp_axis),
+            "mlp_in": PartitionSpec(self.fsdp_axis, self.tp_axis),
+            "mlp_out": PartitionSpec(self.tp_axis, self.fsdp_axis),
+            "norm": PartitionSpec(),
+        }
+        if role not in table:
+            raise KeyError(f"unknown param role {role!r}; known roles: "
+                           f"{sorted(table)}")
+        return table[role]
+
+    def param_spec(self, name: str) -> PartitionSpec:
+        """Spec for one qualified parameter name; unmatched names (and
+        biases/buffers) replicate — correct, never wrong, just unscaled."""
+        role = llama_param_role(name)
+        if role is None:
+            return PartitionSpec()
+        return self.spec_for_role(role)
+
+    def role_layout(self) -> Dict[str, PartitionSpec]:
+        """``{role: spec}`` — the shape check_sharding_readiness wants."""
+        return {role: self.spec_for_role(role)
+                for role, _ in _LLAMA_ROLE_PATTERNS}
+
+
+def llama_param_specs(model) -> Dict[str, PartitionSpec]:
+    """``{param_name: PartitionSpec}`` for every named parameter of a
+    llama-family module under the default :class:`SpecLayout`."""
+    layout = SpecLayout()
+    return {name: layout.param_spec(name)
+            for name, _ in model.named_parameters()}
 
 
 def _pspec(placements) -> PartitionSpec:

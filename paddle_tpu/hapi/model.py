@@ -126,6 +126,36 @@ class Model:
         self.xray_report = report
         return report
 
+    def shardplan(self, inputs, labels=None, *, request=None):
+        """Statically plan the compiled train step on an abstract mesh
+        (analysis.shardplan): sharding propagation under a SpecLayout,
+        per-chip peak HBM, the implied collective inventory, and
+        S205–S208 diagnostics.  ``request`` is an
+        ``analysis.PlanRequest`` (None → llama layout on a simulated
+        ``(data=2, fsdp=2, tp=2)`` mesh).  The report lands in
+        ``self.shardplan_report`` and mirrors into the
+        ``shardplan_comm_bytes`` / ``shardplan_per_chip_peak_hbm_bytes``
+        gauges; nothing executes and no devices are needed."""
+        from ..analysis import shardplan as _shardplan
+
+        if getattr(self, "_train_step_fn", None) is None:
+            raise RuntimeError(
+                "Model.shardplan needs the compiled train step — call "
+                "prepare(optimizer, loss) first")
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        inputs = [to_tensor(x) if not isinstance(x, Tensor) else x
+                  for x in inputs]
+        labels = [to_tensor(y) if not isinstance(y, Tensor) else y
+                  for y in labels]
+        self.network.train()
+        report = _shardplan.plan_train_step(
+            self._train_step_fn, inputs, labels, request=request)
+        _shardplan.export_plan_gauges(report)
+        self.shardplan_report = report
+        return report
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -152,7 +182,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            xray_on_start=False, hbm_budget_bytes=None):
+            xray_on_start=False, hbm_budget_bytes=None, shardplan=None):
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
@@ -221,6 +251,21 @@ class Model:
                     if errs:
                         raise RuntimeError(
                             "train-step X-ray found ERROR hazards:\n  "
+                            + "\n  ".join(str(d) for d in errs))
+                if shardplan is not None:
+                    # same first-batch contract as xray_on_start: one
+                    # abstract trace, report + gauges, abort on ERROR
+                    # (S205 resharding, S207 collective-bound, H110
+                    # per-chip budget) before a single step runs
+                    req, shardplan = shardplan, None
+                    from ..analysis import PlanRequest
+                    if req is True:
+                        req = PlanRequest()
+                    plan = self.shardplan(inputs, labels, request=req)
+                    errs = plan.errors()
+                    if errs and getattr(req, "raise_on_error", True):
+                        raise RuntimeError(
+                            "train-step shard plan found ERRORs:\n  "
                             + "\n  ".join(str(d) for d in errs))
                 loss = self.train_batch(inputs, labels)
                 if timer is not None:

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import contextlib
 
@@ -89,6 +89,14 @@ class ServingConfig:
     xray_on_start: bool = False
     hbm_budget_bytes: Optional[int] = None   # None: no H110 gate
     xray_chip: str = "v5e"                   # roofline ridge profile
+    # static shard-plan audit at construction (analysis.shardplan):
+    # an analysis.PlanRequest (or True for the default llama layout on
+    # a simulated (data=2, fsdp=2, tp=2) mesh).  Propagates shardings
+    # through the decode + chunked-prefill programs, mirrors per-chip
+    # peak HBM and collective bytes into the observability gauges, and
+    # aborts construction on S205/S207/H110-per-chip ERRORs — all on
+    # CPU, no devices needed.
+    shardplan: Any = None
 
 
 class Engine:
@@ -140,6 +148,50 @@ class Engine:
         self._evictions_seen = 0    # pool counter already mirrored
         self.xray_reports = self._xray_startup() if cfg.xray_on_start \
             else None
+        self.shardplan_reports = self._shardplan_startup() \
+            if cfg.shardplan is not None else None
+
+    def _shardplan_startup(self):
+        """Statically plan the decode and chunked-prefill programs on
+        this engine's exact shapes against an abstract mesh
+        (analysis.shardplan) before serving: per-chip peak HBM and the
+        collective inventory mirror into the observability gauges, and
+        ERRORs — S205 resharding, S207 collective-bound, H110 per-chip
+        budget — abort construction."""
+        from ..analysis import PlanRequest, shardplan, xray
+
+        cfg = self.config
+        req = cfg.shardplan
+        if req is True:
+            req = PlanRequest(hbm_budget_bytes=cfg.hbm_budget_bytes)
+        layout = req.resolved_layout()
+        decode_args, prefill_args = xray._serving_abstract_args(
+            self.model, batch=cfg.max_batch_size,
+            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            max_blocks_per_seq=self.max_blocks_per_seq,
+            chunk_tokens=self.chunk_tokens)
+        decode_specs, prefill_specs = shardplan._serving_arg_specs(
+            self.model, layout, decode_args, prefill_args)
+        reports = [
+            shardplan.plan_step(
+                self._decode_step, decode_args, model=self.model,
+                arg_specs=decode_specs, request=req,
+                name="serving::decode_step",
+                data_input_leaves=(("tokens", 0),)),
+            shardplan.plan_step(
+                self._prefill_step, prefill_args, model=self.model,
+                arg_specs=prefill_specs, request=req,
+                name="serving::prefill_step",
+                data_input_leaves=(("chunk_ids", 0),)),
+        ]
+        errors = [d for r in reports for d in r.errors()]
+        for r in reports:
+            shardplan.export_plan_gauges(r)
+        if errors and getattr(req, "raise_on_error", True):
+            raise ValueError(
+                "serving step shard plan found ERRORs:\n  " +
+                "\n  ".join(str(d) for d in errors))
+        return reports
 
     def _xray_startup(self):
         """X-ray the decode and prefill steps on this engine's exact

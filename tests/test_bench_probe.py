@@ -1,0 +1,78 @@
+"""bench.py backend-probe fallback contract.
+
+A hung accelerator probe must cost one BENCH_PROBE_DEADLINE, not the
+whole run: bench falls back to CPU, stamps the probed backend and the
+failure reason into ``_PROBE_RESULT``, and ``_emit`` folds both into
+every JSON artifact line so the perf gate can never mistake a CPU
+fallback number for accelerator evidence.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop("bench_under_test", None)
+
+
+@pytest.fixture
+def hanging_probe(tmp_path, monkeypatch):
+    """A fake ``jax`` module that outlives any probe deadline, first on
+    the subprocess's import path.  The in-process fallback still gets
+    the REAL jax: it is already in this process's sys.modules."""
+    (tmp_path / "jax.py").write_text("import time\ntime.sleep(30)\n")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        str(tmp_path) + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # expects an accelerator
+    monkeypatch.setenv("BENCH_PROBE_DEADLINE", "1")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    return tmp_path
+
+
+class TestProbeDeadlineFallback:
+    def test_hung_probe_falls_back_and_stamps_provenance(
+            self, bench, hanging_probe, capsys):
+        devices, backend = bench._init_backend(total_budget=20.0)
+        assert backend == "cpu"
+        assert devices  # real CPU devices, not the fake module's
+        assert bench._PROBE_RESULT["probed_backend"] == "cpu"
+        assert "deadline" in bench._PROBE_RESULT["probe_error"]
+        assert bench._PROBE_RESULT["probe_attempts"] == 1  # hang ≠ retry
+        # the fallback forces later in-process jax inits onto CPU
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+        # _emit folds the provenance into the artifact JSON line
+        capsys.readouterr()
+        bench._emit({"metric": "m", "value": 1.0})
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["probed_backend"] == "cpu"
+        assert "deadline" in line["probe_error"]
+
+    def test_emit_without_probe_is_unstamped(self, bench, capsys):
+        assert bench._PROBE_RESULT["probed_backend"] is None
+        bench._emit({"metric": "m", "value": 1.0})
+        line = json.loads(capsys.readouterr().out.strip())
+        assert "probed_backend" not in line
+        assert "probe_error" not in line
+
+    def test_expects_accelerator_env_contract(self, bench, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        assert bench._expects_accelerator()
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert not bench._expects_accelerator()
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+        assert not bench._expects_accelerator()  # cpu listed = allowed
+        monkeypatch.delenv("JAX_PLATFORMS")
+        assert not bench._expects_accelerator()

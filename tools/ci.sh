@@ -30,6 +30,13 @@ echo "== program x-ray (jaxpr hazards + HBM budget) =="
 # peak-live-HBM over the chip budget (H110) fail CI (README: Program X-ray)
 python tools/lint_tpu.py --xray
 
+echo "== shard plan (SPMD layout + per-chip HBM + collectives) =="
+# propagates the canonical llama SpecLayout through the registered
+# train/decode/chunked-prefill jaxprs on a simulated (data=2,fsdp=2,tp=2)
+# mesh: resharding conflicts (S205), comm-bound steps (S207), or a
+# per-chip HBM budget breach fail CI (README: Sharding plan analyzer)
+python tools/lint_tpu.py --shardplan
+
 echo "== unit + integration tests =="
 python -m pytest tests/ -q
 

@@ -7,6 +7,7 @@ Usage:
     python tools/lint_tpu.py paddle_tpu/
     python tools/lint_tpu.py --list-rules
     python tools/lint_tpu.py --xray [--hbm-budget-gib N] [--chip v5e]
+    python tools/lint_tpu.py --shardplan [--mesh data=2,fsdp=2,tp=2]
 
 Exit status 1 when any unsuppressed ERROR-severity finding exists (the
 ``lint`` stage of tools/ci.sh gates on this).  Suppress with
@@ -20,6 +21,12 @@ itself is broken.  ``--xray`` is the opposite trade on purpose: it
 imports the package, traces the registered train/decode/prefill steps
 to jaxprs on the CPU (1,1) config, and fails on ERROR hazards (f64
 eqns, host callbacks H109) or a peak-live-HBM over the budget (H110).
+
+``--shardplan`` goes one layer further: it propagates the canonical
+llama SpecLayout through the same jaxprs on a simulated mesh (default
+data=2,fsdp=2,tp=2 — no devices required), prints the per-chip peak
+HBM and collective inventory, and fails on resharding conflicts
+(S205), comm-bound plans (S207), or a per-chip HBM budget breach.
 """
 import importlib.util
 import os
@@ -35,6 +42,58 @@ def _load_astlint():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _shardplan_main(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="static SPMD shard-plan audit over the registered "
+        "steps on a simulated mesh (no devices needed)")
+    parser.add_argument("--mesh", default="data=2,fsdp=2,tp=2",
+                        help="abstract mesh axes, e.g. data=2,fsdp=2,tp=2")
+    parser.add_argument("--chip", default="cpu",
+                        help="ICI/roofline profile (cpu/v4/v5e/v5p/v6e)")
+    parser.add_argument("--hbm-budget-gib", type=float, default=None,
+                        help="per-chip peak-HBM budget; default: the "
+                        "chip profile's HBM capacity")
+    parser.add_argument("--batch-axis", default="data",
+                        choices=["data", "tp", "fsdp", "none"],
+                        help="mesh axis the batch dim is sharded on "
+                        "(injection knob: 'tp' deliberately misplaces "
+                        "the batch to exercise the S205/S208 gate)")
+    args = parser.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    from paddle_tpu.analysis import shardplan, xray
+    from paddle_tpu.distributed.sharding import SpecLayout
+
+    mesh = {}
+    for part in args.mesh.split(","):
+        axis, _, size = part.partition("=")
+        mesh[axis.strip()] = int(size)
+    batch = None if args.batch_axis == "none" else args.batch_axis
+    layout = SpecLayout(batch_axis=batch)
+    budget = (int(args.hbm_budget_gib * 2**30)
+              if args.hbm_budget_gib is not None
+              else xray.CHIPS[args.chip].hbm_bytes)
+    reports = shardplan.audit_shardplan(
+        chip=args.chip, hbm_budget_bytes=budget, mesh=mesh, layout=layout)
+    n_err = 0
+    for r in reports:
+        print(r.summary())
+        print(r.table())
+        for d in r.diagnostics:
+            print(f"  {d}")
+        n_err += len(r.errors())
+    total_bytes = sum(c.total_bytes for r in reports
+                      for c in r.collectives)
+    print(f"lint-tpu --shardplan: {len(reports)} step(s), "
+          f"{int(total_bytes)} collective byte(s) on the wire, "
+          f"{sum(len(r.diagnostics) for r in reports)} diagnostic(s), "
+          f"{n_err} error(s)")
+    return 1 if n_err else 0
 
 
 def _xray_main(argv):
@@ -74,4 +133,6 @@ if __name__ == "__main__":
     args = sys.argv[1:]
     if args and args[0] == "--xray":
         sys.exit(_xray_main(args[1:]))
+    if args and args[0] == "--shardplan":
+        sys.exit(_shardplan_main(args[1:]))
     sys.exit(_load_astlint().main(args))
