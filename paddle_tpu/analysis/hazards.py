@@ -497,8 +497,9 @@ def scan_static_function(sfn, retrace_threshold: int = 2
     plus the compile-cache retrace analysis (H101).
 
     The cache key is ``((dyn_specs, static_values, treedef), state_sig,
-    mode_key)``; entries sharing everything but ``static_values`` mean
-    the function recompiled once per captured Python scalar value.
+    mode_key, mesh_token)``; entries sharing everything but
+    ``static_values`` mean the function recompiled once per captured
+    Python scalar value.
     """
     diags = scan_function(sfn)
     cache = getattr(sfn, "_cache", None)
@@ -507,13 +508,16 @@ def scan_static_function(sfn, retrace_threshold: int = 2
     groups = {}
     for key in cache:
         try:
-            (dyn, stat, treedef), state_sig, mode_key = key
+            # mesh_token (the bound MeshExecutor's identity) joined the
+            # key when runtime mesh execution landed; recompiling for a
+            # DIFFERENT mesh is a new program by design, not a retrace
+            (dyn, stat, treedef), state_sig, mode_key, mesh_token = key
         except (TypeError, ValueError):
             continue
-        groups.setdefault((dyn, treedef, state_sig, mode_key),
+        groups.setdefault((dyn, treedef, state_sig, mode_key, mesh_token),
                           []).append(stat)
     name = getattr(sfn, "__name__", repr(sfn))
-    for (dyn, _td, _sig, _mode), stats in groups.items():
+    for (dyn, _td, _sig, _mode, _mesh), stats in groups.items():
         if len(stats) >= retrace_threshold:
             seen_vals = sorted({repr(s) for s in stats})
             diags.append(Diagnostic(

@@ -50,6 +50,10 @@ from . import planner  # noqa: F401
 from .planner import CostModel, Planner  # noqa: F401
 from . import launch  # noqa: F401
 from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
+from . import executor  # noqa: F401
+from .executor import (MeshExecutor, active_mesh,  # noqa: F401
+                       active_mesh_axes, as_executor, current_executor,
+                       default_shardplan_mesh)
 
 
 def is_initialized():

@@ -125,6 +125,39 @@ def _zero_spec(p, mesh):
     return PartitionSpec()
 
 
+def _canonical_zero_spec(name, p, mesh):
+    """The canonical SpecLayout role spec mapped onto this mesh's axes
+    (fsdp→'sharding', tp→'mp'), restricted to axes the mesh has and
+    dims they divide.  None when the name has no role or nothing of the
+    role spec survives restriction — callers fall back to _zero_spec."""
+    from jax.sharding import PartitionSpec
+
+    from ..sharding import SpecLayout, llama_param_role
+
+    role = llama_param_role(name)
+    if role is None:
+        return None
+    layout = SpecLayout(data_axis="dp", fsdp_axis="sharding",
+                        tp_axis="mp", batch_axis="dp")
+    spec = layout.spec_for_role(role)
+    if not tuple(spec):
+        return PartitionSpec()  # deliberately replicated role (norm)
+    entries = []
+    for dim, entry in enumerate(tuple(spec)):
+        axes = (entry,) if isinstance(entry, str) else tuple(entry or ())
+        deg = 1
+        for a in axes:
+            deg *= int(mesh.shape.get(a, 0) or 0)
+        if (not axes or any(a not in mesh.shape for a in axes)
+                or dim >= len(p.shape) or int(p.shape[dim]) % deg != 0):
+            entries.append(None)
+        else:
+            entries.append(entry)
+    if all(e is None for e in entries):
+        return None
+    return PartitionSpec(*entries)
+
+
 def apply_group_sharding(model, mesh, stage=3):
     """ZeRO stages over the 'sharding' mesh axis (reference:
     sharding_optimizer.py stage 1, group_sharded_stage2.py,
@@ -145,10 +178,18 @@ def apply_group_sharding(model, mesh, stage=3):
 
     from ..sharding import get_sharding_spec, mark_sharding
 
-    for _, p in model.named_parameters():
+    for name, p in model.named_parameters():
         if get_sharding_spec(p) is not None:
             continue  # e.g. mp-annotated parallel layers keep their spec
-        spec = _zero_spec(p, mesh)
+        spec = None
+        if stage >= 2:
+            # 'os_g'/'p_g_os' route through the canonical SpecLayout
+            # (fsdp→'sharding', tp→'mp') so grads and stage-3 params land
+            # on the SAME layout the mesh executor / shardplan validate;
+            # non-llama names keep the largest-divisible-dim heuristic
+            spec = _canonical_zero_spec(name, p, mesh)
+        if spec is None:
+            spec = _zero_spec(p, mesh)
         p._zero_opt_spec = spec  # stage >= 1: shard the slots
         if stage >= 2:
             p._zero_grad_spec = spec

@@ -125,6 +125,33 @@ def _pspec(placements) -> PartitionSpec:
     return PartitionSpec(*placements)
 
 
+def _context_mesh(mesh, spec: Optional[PartitionSpec] = None
+                  ) -> Optional[Mesh]:
+    """Resolve the mesh an annotation applies to: the caller's, else the
+    global one (distributed.mesh), else the registered MeshExecutor's —
+    so annotations inside executor-driven programs are not no-ops.
+    When ``spec`` is given and the preferred candidate does not know its
+    axes, fall through to one that does (a lingering fleet mesh over
+    ``dp/mp`` must not eat an executor-targeted ``fsdp/tp`` spec)."""
+    if hasattr(mesh, "to_jax_mesh"):
+        return mesh.to_jax_mesh()
+    if mesh is not None:
+        return mesh
+    from .executor import active_mesh
+
+    candidates = [m for m in (get_mesh(), active_mesh()) if m is not None]
+    if spec is not None:
+        for m in candidates:
+            if _spec_axes_known(spec, m):
+                return m
+    return candidates[0] if candidates else None
+
+
+def _spec_axes_known(spec: PartitionSpec, mesh: Mesh) -> bool:
+    needed = [a for a in jax.tree_util.tree_leaves(tuple(spec)) if a]
+    return all(a in mesh.shape for a in needed)
+
+
 def shard_tensor(x: Tensor, mesh: Optional[Mesh] = None, placements=None,
                  dist_attr=None) -> Tensor:
     """Annotate a tensor with a mesh sharding.
@@ -132,11 +159,15 @@ def shard_tensor(x: Tensor, mesh: Optional[Mesh] = None, placements=None,
     Eager: device_put onto the NamedSharding (actually lays the tensor out
     across chips).  Traced: with_sharding_constraint (GSPMD propagates).
     """
-    mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else \
-        (mesh or get_mesh())
+    spec = _pspec(placements)
+    mesh = _context_mesh(mesh, spec)
     if mesh is None:
         return x
-    spec = _pspec(placements)
+    if not _spec_axes_known(spec, mesh):
+        # a fallback mesh (executor/global) may lack this annotation's
+        # axes (e.g. 'sp' on a (data, fsdp, tp) mesh) — keep the old
+        # no-op contract rather than erroring mid-model
+        return x
     sharding = NamedSharding(mesh, spec)
     if in_static_trace() or _is_tracer(x._value):
         out = apply("sharding_constraint",
@@ -155,17 +186,25 @@ def _is_tracer(v):
     return hasattr(v, "aval") and not hasattr(v, "addressable_shards")
 
 
-def mark_sharding(param: Tensor, placements) -> Tensor:
+def mark_sharding(param: Tensor, placements, mesh=None) -> Tensor:
     """Attach a sharding spec to a Parameter; jit.to_static uses it to build
-    in_shardings for the compiled step (and eagerly lays out the weight)."""
+    in_shardings for the compiled step (and eagerly lays out the weight).
+
+    The mesh context resolves caller-arg → global mesh → registered
+    MeshExecutor.  Under tracing the annotation still takes effect as a
+    sharding constraint (same contract as fleet's slot pinning) instead
+    of silently no-opping."""
     spec = _pspec(placements)
     param._sharding_spec = spec
-    mesh = get_mesh()
-    if mesh is not None and not _is_tracer(param._value):
-        needed = [a for a in jax.tree_util.tree_leaves(tuple(spec)) if a]
-        if all(a in mesh.shape for a in needed):
-            param._value = jax.device_put(param._value,
-                                          NamedSharding(mesh, spec))
+    mesh = _context_mesh(mesh, spec)
+    if mesh is None or not _spec_axes_known(spec, mesh):
+        return param
+    sharding = NamedSharding(mesh, spec)
+    if _is_tracer(param._value):
+        param._value = jax.lax.with_sharding_constraint(
+            param._value, sharding)
+    else:
+        param._value = jax.device_put(param._value, sharding)
     return param
 
 

@@ -28,9 +28,11 @@ class Model:
         self.stop_training = False
         self._skip_batch = False
         self._train_step_fn = None
+        self._mesh_executor = None
 
     # ------------------------------------------------------------- prepare
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, mesh=None):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -40,6 +42,22 @@ class Model:
         else:
             self._metrics = list(metrics)
         self._build_train_step()
+        if mesh is not None:
+            self.use_mesh(mesh)
+
+    def use_mesh(self, mesh):
+        """Install a ``distributed.MeshExecutor`` (or build one from an
+        ``{axis: size}`` dict): params/optimizer slots are laid out per
+        the canonical ``SpecLayout`` and the compiled train/eval steps
+        run as one GSPMD program per step with explicit shardings +
+        donation.  Returns the executor (``reconcile_train`` on it
+        audits the compiled program against the static shard plan,
+        diagnostic S209)."""
+        from ..distributed.executor import as_executor
+
+        ex = as_executor(mesh)
+        ex.install(self)
+        return ex
 
     def _build_train_step(self):
         from .. import jit
@@ -90,6 +108,11 @@ class Model:
                   for x in inputs]
         labels = [to_tensor(y) if not isinstance(y, Tensor) else y
                   for y in labels]
+        if self._mesh_executor is not None:
+            # commit batch leaves onto the batch spec so they match the
+            # step's in_shardings (to_tensor lands on one device)
+            inputs = self._mesh_executor.shard_batch(inputs)
+            labels = self._mesh_executor.shard_batch(labels)
         loss, outputs = self._train_step_fn(inputs, labels)
         metrics = [float(np.asarray(loss.numpy()).mean())]
         for m in self._metrics:
@@ -182,7 +205,12 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            xray_on_start=False, hbm_budget_bytes=None, shardplan=None):
+            xray_on_start=False, hbm_budget_bytes=None, shardplan=None,
+            mesh=None):
+        if mesh is not None and self._mesh_executor is None:
+            # late mesh install (prepare(mesh=...) is equivalent): lay
+            # state out on the device mesh before the first step compiles
+            self.use_mesh(mesh)
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,

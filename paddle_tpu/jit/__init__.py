@@ -332,12 +332,22 @@ class StaticFunction:
                                  for sl in layer.sublayers(
                                      include_self=True)]
         mode_key = tuple(sl.training for sl in self._mode_layers)
+        # the mesh is part of the program: a distributed.MeshExecutor
+        # bound here (executor.install) means the entry jits with
+        # explicit per-invar shardings, and a mesh change must
+        # select/build a different executable
+        mesh_exec = getattr(self, "_mesh_executor", None)
         key = (_spec_key(static_flat, treedef, dyn_vals), state.signature(),
-               mode_key)
+               mode_key,
+               None if mesh_exec is None else mesh_exec.cache_token())
         entry = self._cache.get(key)
         if entry is None:
+            in_sh = (None if mesh_exec is None
+                     else mesh_exec.train_in_shardings(state, dyn_vals))
             entry = _CompiledEntry(self._trace_target(), state, treedef,
-                                   static_flat, tuple(dyn_idx))
+                                   static_flat, tuple(dyn_idx),
+                                   in_shardings=in_sh,
+                                   mesh_exec=mesh_exec)
             self._cache[key] = entry
 
         # host numpy (not device jnp): in a multi-controller runtime
@@ -446,7 +456,8 @@ class StaticFunction:
 
 
 class _CompiledEntry:
-    def __init__(self, fn, state_example, treedef, static_flat, dyn_idx):
+    def __init__(self, fn, state_example, treedef, static_flat, dyn_idx,
+                 in_shardings=None, mesh_exec=None):
         self._fn = fn
         self._treedef = treedef
         self._static_flat = static_flat
@@ -499,6 +510,16 @@ class _CompiledEntry:
                 n_pb = len(state.params) + len(state.buffers)
                 cur = state.read()
                 new_state = cur[:n_pb] + known_vals + new_vals
+                if mesh_exec is not None:
+                    # pin the state OUTPUTS to the planned layout: XLA's
+                    # sharding propagation-to-output is otherwise free to
+                    # reshard them (observed: replicated norm weights
+                    # coming back fsdp-sharded), and the next call's
+                    # committed args would then mismatch in_shardings
+                    known_handles = [(s, k) for s, k in post_slots
+                                     if (id(s), k) in pre_ids]
+                    new_state = mesh_exec.constrain_state_outputs(
+                        state, new_state, known_handles + new_handles)
                 # identity check on tracers: a param the program never
                 # touched passes through as the SAME tracer object —
                 # learned here so __call__ can route forward-only wraps
@@ -524,7 +545,14 @@ class _CompiledEntry:
             return out_raw, new_state
 
         self._jax_fn = jax_fn
-        self._jitted = jax.jit(jax_fn, donate_argnums=(0,))
+        if in_shardings is None:
+            self._jitted = jax.jit(jax_fn, donate_argnums=(0,))
+        else:
+            # GSPMD execution (distributed.MeshExecutor): committed
+            # per-invar layouts make this one multi-device program, and
+            # donation pins the state outputs to the same layouts
+            self._jitted = jax.jit(jax_fn, donate_argnums=(0,),
+                                   in_shardings=in_shardings)
 
     def run(self, state, dyn_vals, lrs, rng_key):
         self._live_state = state

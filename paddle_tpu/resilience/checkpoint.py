@@ -84,6 +84,15 @@ def host_snapshot(tree: Any) -> Any:
     if isinstance(tree, np.ndarray):
         return tree.copy()
     if hasattr(tree, "shape") and hasattr(tree, "dtype"):    # jax array
+        if getattr(tree, "sharding", None) is not None and \
+                not getattr(tree, "is_fully_replicated", True):
+            # mesh-sharded (distributed.MeshExecutor): gather the device
+            # shards into one host array so the checkpoint is
+            # layout-independent — restore re-shards onto whatever mesh
+            # is active then
+            import jax
+
+            return np.asarray(jax.device_get(tree)).copy()
         return np.asarray(tree).copy()
     return tree
 
@@ -103,11 +112,18 @@ def collect_state(network=None, optimizer=None,
 
 
 def apply_state(state: Dict[str, Any], network=None, optimizer=None):
-    """Restore a :func:`collect_state` tree into live objects."""
+    """Restore a :func:`collect_state` tree into live objects.  When a
+    ``distributed.MeshExecutor`` is installed on the network, the host
+    arrays are re-sharded back onto the mesh — the gathered save plus
+    this re-shard is what keeps kill/resume bit-identical under SPMD."""
     if network is not None and "model" in state:
         network.set_state_dict(state["model"])
     if optimizer is not None and "optimizer" in state:
         optimizer.set_state_dict(state["optimizer"])
+    executor = getattr(network, "_mesh_executor", None) \
+        if network is not None else None
+    if executor is not None:
+        executor.reshard(network, optimizer)
 
 
 # ---------------------------------------------------------------------------

@@ -97,6 +97,13 @@ class ServingConfig:
     # aborts construction on S205/S207/H110-per-chip ERRORs — all on
     # CPU, no devices needed.
     shardplan: Any = None
+    # RUNTIME mesh execution (distributed.MeshExecutor, or an
+    # {axis: size} dict): weights are sharded per the canonical
+    # SpecLayout and the paged KV pool PS(None, None, "tp", None), so
+    # decode/prefill each run as ONE GSPMD program over the mesh.
+    # Engine.reconcile_mesh() audits the compiled programs against the
+    # static shard plan (diagnostic S209).
+    mesh: Any = None
 
 
 class Engine:
@@ -127,6 +134,15 @@ class Engine:
                                       np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._pending = np.zeros((S,), np.int32)  # next token to decode
+        # runtime SPMD: shard weights + KV pool BEFORE the step makers
+        # below — the steps capture the weights as jit constants, so the
+        # rebind here is what makes the compiled programs multi-device
+        self.mesh_executor = None
+        if cfg.mesh is not None:
+            from ..distributed.executor import as_executor
+
+            self.mesh_executor = as_executor(cfg.mesh)
+            self.mesh_executor.install_serving(model, self.pool)
         # compile accounting wraps both compiled entry points, and BOTH
         # carry the no-retrace contract now: each one's single allowed
         # compile is this engine's warmup; any cache growth past it seen
@@ -192,6 +208,17 @@ class Engine:
                 "serving step shard plan found ERRORs:\n  " +
                 "\n  ".join(str(d) for d in errors))
         return reports
+
+    def reconcile_mesh(self):
+        """Cross-check the COMPILED decode/prefill programs against the
+        static shard plan (diagnostic S209: collective footprint,
+        per-device memory, realized KV-pool output shards).  Returns
+        ``{step_name: (PlanReport, [S209 diagnostics])}`` — empty
+        diagnostic lists mean runtime and plan agree."""
+        if self.mesh_executor is None:
+            raise RuntimeError(
+                "reconcile_mesh needs ServingConfig(mesh=...)")
+        return self.mesh_executor.reconcile_serving(self)
 
     def _xray_startup(self):
         """X-ray the decode and prefill steps on this engine's exact

@@ -37,6 +37,15 @@ echo "== shard plan (SPMD layout + per-chip HBM + collectives) =="
 # per-chip HBM budget breach fail CI (README: Sharding plan analyzer)
 python tools/lint_tpu.py --shardplan
 
+echo "== mesh execution (2x2x2 SPMD on forced host devices) =="
+# runtime MeshExecutor over an emulated 8-device host: train-loss parity
+# (2,2,2) vs (1,1,1), serving token parity vs generate() with tp=2, zero
+# retraces, and S209 plan-vs-runtime reconciliation (README: Mesh
+# execution).  Env already forces JAX_PLATFORMS=cpu + 8 host devices
+# above; run the module on its own so the mesh path gates every PR even
+# when the main suite is filtered.
+python -m pytest tests/test_mesh_executor.py -q
+
 echo "== unit + integration tests =="
 python -m pytest tests/ -q
 

@@ -50,8 +50,10 @@ def _shardplan_main(argv):
     parser = argparse.ArgumentParser(
         description="static SPMD shard-plan audit over the registered "
         "steps on a simulated mesh (no devices needed)")
-    parser.add_argument("--mesh", default="data=2,fsdp=2,tp=2",
-                        help="abstract mesh axes, e.g. data=2,fsdp=2,tp=2")
+    parser.add_argument("--mesh", default=None,
+                        help="abstract mesh axes, e.g. data=2,fsdp=2,tp=2 "
+                        "(default: the registered MeshExecutor's axes "
+                        "when one is active, else data=2,fsdp=2,tp=2)")
     parser.add_argument("--chip", default="cpu",
                         help="ICI/roofline profile (cpu/v4/v5e/v5p/v6e)")
     parser.add_argument("--hbm-budget-gib", type=float, default=None,
@@ -67,12 +69,26 @@ def _shardplan_main(argv):
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
     from paddle_tpu.analysis import shardplan, xray
+    from paddle_tpu.distributed.executor import default_shardplan_mesh
     from paddle_tpu.distributed.sharding import SpecLayout
 
-    mesh = {}
-    for part in args.mesh.split(","):
-        axis, _, size = part.partition("=")
-        mesh[axis.strip()] = int(size)
+    mesh_arg = args.mesh
+    mesh = None
+    if mesh_arg is None:
+        # audit the mesh actually in use when a runtime executor is
+        # registered (distributed.MeshExecutor); else the simulated
+        # default
+        mesh = default_shardplan_mesh()
+        if mesh is not None:
+            print(f"--mesh defaulting to the registered executor's "
+                  f"axes: {mesh}")
+        else:
+            mesh_arg = "data=2,fsdp=2,tp=2"
+    if mesh is None:
+        mesh = {}
+        for part in mesh_arg.split(","):
+            axis, _, size = part.partition("=")
+            mesh[axis.strip()] = int(size)
     batch = None if args.batch_axis == "none" else args.batch_axis
     layout = SpecLayout(batch_axis=batch)
     budget = (int(args.hbm_budget_gib * 2**30)
