@@ -13,7 +13,14 @@ admission seeds the pool's content-addressed block index, and every
 later admission reuses those blocks — prefilling only its unique tail
 in fixed-shape chunks (ONE compiled prefill program for all lengths).
 
-Run:  python examples/serve_llama.py [--prefix-cache]
+With ``--overload-chaos`` (the CI overload stage) the demo replays a
+seeded traffic burst with per-request deadlines under an injected
+sustained slowdown — hopeless requests are SHED at admission instead
+of timing out after burning prefill — then injects a hung decode step
+the watchdog detects and retries, and asserts the engine recovers to
+``SERVING`` with zero retraces.
+
+Run:  python examples/serve_llama.py [--prefix-cache | --overload-chaos]
 """
 import argparse
 
@@ -93,11 +100,68 @@ def prefix_cache_demo(model):
     assert eng._prefill_step.retraces == 0
 
 
+def overload_chaos_demo(model):
+    from paddle_tpu.resilience.chaos import FaultPlan, burst_prompts
+    from paddle_tpu.serving import SERVING
+
+    eng = Engine(model, ServingConfig(max_batch_size=4, block_size=4,
+                                      num_blocks=64, chunk_tokens=4,
+                                      max_queue_len=32))
+
+    # --- phase 1: seeded burst + sustained slowdown -> load shedding
+    with FaultPlan(seed=11, step_delay_s=0.03):
+        warm = eng.submit(burst_prompts(seed=1, n=1, min_len=8,
+                                        max_len=8)[0], max_new_tokens=4)
+        eng.run_until_complete()          # warms the latency EWMAs
+        assert warm.finish_reason == "length"
+        burst = burst_prompts(seed=11, n=4, min_len=96, max_len=96)
+        feasible = eng.submit(
+            burst_prompts(seed=2, n=1, min_len=8, max_len=8)[0],
+            max_new_tokens=4, deadline_s=0.7)
+        doomed = [eng.submit(p, max_new_tokens=4, deadline_s=0.7)
+                  for p in burst]
+        eng.run_until_complete()
+
+    c = eng.stats()["counters"]
+    print(f"burst: {c['requests_shed']} shed at admission, "
+          f"{c['requests_timed_out']} timed out, "
+          f"goodput {c['goodput_tokens']} tokens")
+    assert feasible.finish_reason == "length"
+    assert all(r.finish_reason == "shed" for r in doomed)
+    assert c["requests_timed_out"] == 0   # shed beats a timeout
+
+    # --- phase 2: injected hung step -> watchdog detects, retries,
+    # engine returns to SERVING
+    eng2 = Engine(model, ServingConfig(
+        max_batch_size=4, block_size=4, num_blocks=64, chunk_tokens=4,
+        watchdog_floor_s=0.25, watchdog_budget_mult=50.0,
+        step_max_retries=1, health_recovery_steps=2))
+    req = eng2.submit(burst_prompts(seed=3, n=1, min_len=4,
+                                    max_len=4)[0], max_new_tokens=6)
+    with FaultPlan(step_delay_s={3: 0.6}):   # hang one decode attempt
+        eng2.run_until_complete()
+    h = eng2.health()
+    print(f"watchdog: {h['watchdog_stalls']} stall detected, "
+          f"{h['step_retries']} retry, health={h['state']}")
+    assert req.finish_reason == "length"
+    assert h["watchdog_stalls"] == 1 and h["step_retries"] >= 1
+    assert h["state"] == SERVING          # recovered after clean steps
+
+    for e in (eng, eng2):
+        assert e._decode_step.retraces == 0
+        assert e._prefill_step.retraces == 0
+        e.pool.check_leaks()
+    print("overload chaos: shed + stall recovery OK, zero retraces")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prefix-cache", action="store_true",
                     help="shared-system-prompt workload exercising the "
                          "content-addressed prefix cache")
+    ap.add_argument("--overload-chaos", action="store_true",
+                    help="seeded burst + injected stall: load shedding, "
+                         "watchdog retry, recovery to SERVING")
     args = ap.parse_args()
 
     paddle.seed(0)
@@ -105,6 +169,8 @@ def main():
     model.eval()
     if args.prefix_cache:
         prefix_cache_demo(model)
+    elif args.overload_chaos:
+        overload_chaos_demo(model)
     else:
         staggered_demo(model)
 

@@ -25,7 +25,8 @@ from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
 from .hazards import (scan, scan_checkpoint_writes, scan_decode_step,
                       scan_decode_steps, scan_function, scan_program,
-                      scan_static_function, sort_diagnostics)
+                      scan_static_function, scan_wall_clock_deadlines,
+                      sort_diagnostics)
 from . import astlint
 from . import xray
 from .xray import (ProgramReport, analyze, analyze_train_step,
@@ -46,6 +47,7 @@ __all__ = [
     "scan_decode_step",
     "scan_decode_steps",
     "scan_checkpoint_writes",
+    "scan_wall_clock_deadlines",
     "sort_diagnostics",
     "set_pass_verification",
     "pass_verification",

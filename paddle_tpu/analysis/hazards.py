@@ -34,6 +34,16 @@ exactly that class of defect:
   (ERROR), and Python ``if``/``while`` branching on traced values bakes
   one executable per branch outcome — a retrace per token at worst
   (WARNING).  ``scan_decode_steps()`` audits every live registered step.
+- **H111 wall-clock deadline**: ``time.time()`` used where a DURATION
+  matters — deadlines, timeouts, watchdog budgets — in serving or
+  resilience code.  The wall clock steps under NTP slews and leap
+  smears, so a deadline armed from it can fire early, late, or never;
+  ``time.monotonic()`` is the contract
+  (``scheduler.Request.deadline_t``, the serving step watchdog).
+  ``scan_wall_clock_deadlines()`` audits source trees: ``time.time()``
+  near deadline/timeout vocabulary is an ERROR, elsewhere a WARNING
+  (timestamps for logs/filenames are legitimate wall-clock uses, but
+  deserve a look when they sit in serving/resilience paths).
 
 Program-level scans are pure metadata walks (no execution); source-level
 scans are AST walks with real file/line locations.
@@ -54,6 +64,7 @@ __all__ = [
     "scan_decode_step",
     "scan_decode_steps",
     "scan_checkpoint_writes",
+    "scan_wall_clock_deadlines",
     "scan",
     "sort_diagnostics",
 ]
@@ -482,6 +493,119 @@ def scan_checkpoint_writes(paths, exclude=_CKPT_SANCTIONED
         except (OSError, SyntaxError):
             continue
         scanner = _CheckpointWriteScanner(f)
+        scanner.visit(tree)
+        diags.extend(scanner.diags)
+    return sort_diagnostics(diags)
+
+
+# ---------------------------------------------------------------------------
+# wall-clock deadline scan (serving / resilience)
+# ---------------------------------------------------------------------------
+
+# vocabulary that marks a time value as a DURATION/DEADLINE use, where
+# only the monotonic clock is correct (NTP steps move the wall clock)
+_H111_HINTS = ("deadline", "timeout", "watchdog", "expir", "budget",
+               "slo", "stall", "elapsed", "retry")
+
+
+def _h111_texts(node) -> List[str]:
+    """Identifier-ish strings inside ``node`` (names, attributes,
+    argument names) to match the deadline vocabulary against."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+        elif isinstance(n, ast.arg):
+            out.append(n.arg)
+    return out
+
+
+class _WallClockScanner(ast.NodeVisitor):
+    """H111: ``time.time()`` in deadline/timeout/watchdog logic.  The
+    wall clock is for TIMESTAMPS (log lines, filenames); arming a
+    deadline or measuring a budget from it breaks under NTP slews and
+    clock steps — ``time.monotonic()`` is the serving/resilience
+    contract (``Request.deadline_t``, the step watchdog)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.diags: List[Diagnostic] = []
+        self._fn_stack: List[str] = []
+        self._stmt_stack: List[ast.stmt] = []
+
+    def visit(self, node):
+        is_stmt = isinstance(node, ast.stmt)
+        if is_stmt:
+            self._stmt_stack.append(node)
+        super().visit(node)
+        if is_stmt:
+            self._stmt_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "time" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            texts = list(self._fn_stack)
+            if self._stmt_stack:
+                texts += _h111_texts(self._stmt_stack[-1])
+            hinted = any(h in t.lower() for t in texts
+                         for h in _H111_HINTS)
+            where = f"{self.filename}:{node.lineno}"
+            if hinted:
+                self.diags.append(Diagnostic(
+                    "H111", ERROR,
+                    "time.time() arms a deadline/timeout/watchdog — the "
+                    "wall clock steps under NTP slews, so the deadline "
+                    "can fire early, late, or never; use "
+                    "time.monotonic() (the Request.deadline_t contract)",
+                    where))
+            else:
+                self.diags.append(Diagnostic(
+                    "H111", WARNING,
+                    "time.time() in serving/resilience code: fine for a "
+                    "timestamp, wrong for any duration or deadline — "
+                    "confirm, or switch to time.monotonic()", where))
+        self.generic_visit(node)
+
+
+def scan_wall_clock_deadlines(paths) -> List[Diagnostic]:
+    """H111-audit python sources for ``time.time()`` used where only
+    the monotonic clock is correct.  ``paths`` is a file, a directory
+    (walked for ``.py``), or a list of either — typically
+    ``paddle_tpu/serving`` and ``paddle_tpu/resilience``, whose
+    deadline and watchdog semantics REQUIRE ``time.monotonic()``.
+    Calls near deadline/timeout vocabulary are ERRORs, the rest
+    WARNINGs."""
+    import os
+
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in sorted(files):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            continue
+        scanner = _WallClockScanner(f)
         scanner.visit(tree)
         diags.extend(scanner.diags)
     return sort_diagnostics(diags)

@@ -414,8 +414,10 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     _GLOO_STORE.add("gloo/init", 1)
     import time
 
-    deadline = time.time() + 30
-    while time.time() < deadline:
+    # monotonic, not wall clock (hazard H111): an NTP step mid-
+    # rendezvous would fire this timeout early or stretch it
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
         if _GLOO_STORE.add("gloo/init", 0) >= rank_num:
             return
         time.sleep(0.01)
@@ -434,8 +436,8 @@ def gloo_barrier():
     _GLOO_STORE.add(key, 1)
     import time
 
-    deadline = time.time() + 30
-    while time.time() < deadline:
+    deadline = time.monotonic() + 30      # H111: never the wall clock
+    while time.monotonic() < deadline:
         if _GLOO_STORE.add(key, 0) >= world:
             return
         time.sleep(0.01)

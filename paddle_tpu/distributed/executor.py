@@ -384,6 +384,13 @@ class MeshExecutor:
         # [num_blocks, block_size, kv_heads, head_dim] — heads on tp
         return PartitionSpec(None, None, self.layout.tp_axis, None)
 
+    def static_kv_spec(self) -> PartitionSpec:
+        """Sequential ``generate()`` StaticKVCache layout,
+        [batch, max_len, kv_heads, head_dim] — kv heads on tp, matching
+        the paged pool (``kv_pool_spec``) so the one-shot path stops
+        replicating a full max_len cache per chip."""
+        return PartitionSpec(None, None, self.layout.tp_axis, None)
+
     def shard_kv_layers(self, layers):
         spec = self.kv_pool_spec()
         return [(self.put(k, spec), self.put(v, spec))

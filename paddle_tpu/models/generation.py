@@ -42,12 +42,29 @@ def _empty_caches(model, batch):
 def _static_caches(model, batch, max_len):
     """Fixed-size caches: every decode step reuses ONE set of op shapes
     (the concat-growing cache changes shapes per token, recompiling each
-    step on TPU — see models/llama.py StaticKVCache)."""
+    step on TPU — see models/llama.py StaticKVCache).
+
+    Under an ACTIVE mesh executor the [batch, max_len, kv_heads,
+    head_dim] buffers are committed sharded on the tp axis over
+    kv_heads — the same layout the serving path gives the paged pool
+    (``MeshExecutor.kv_pool_spec``) — instead of replicating an entire
+    max_len cache onto every chip.  ``clean_spec`` inside ``put`` falls
+    back to replication when kv_heads does not divide tp."""
     from .llama import StaticKVCache
 
     kv_heads, head_dim, dtype = _cache_dims(model)
-    return [StaticKVCache.empty(batch, max_len, kv_heads, head_dim, dtype)
-            for _ in range(model.config.num_hidden_layers)]
+    caches = [StaticKVCache.empty(batch, max_len, kv_heads, head_dim,
+                                  dtype)
+              for _ in range(model.config.num_hidden_layers)]
+    from ..distributed.executor import current_executor
+
+    ex = current_executor()
+    if ex is not None:
+        spec = ex.static_kv_spec()
+        for c in caches:
+            c.k = ex.put(c.k, spec)
+            c.v = ex.put(c.v, spec)
+    return caches
 
 
 def _select_token(logits, *, do_sample, temperature, top_k, top_p, key):

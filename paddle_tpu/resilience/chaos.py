@@ -21,12 +21,19 @@ Instrumented sites:
 - ``on_save(site)``        — checkpoint writers, mid-commit (crash)
 - ``after_save(path)``     — checkpoint writers, post-commit (disk rot)
 - ``maybe_fail_request(request_id)`` — serving prefill (poison request)
+- ``maybe_fail_serving_step(label)`` — serving step watchdog (hung or
+  failing compiled-step ATTEMPTS: delays register as watchdog stalls,
+  exceptions exercise the bounded-retry path)
 - ``poison_batch(step, arrays)``     — data path (NaN/Inf gradients)
+
+``burst_prompts`` is the matching ARRIVAL generator: a seeded batch of
+random prompts for overload tests, so a shedding/degradation scenario
+replays identically every run.
 """
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
@@ -39,7 +46,9 @@ __all__ = [
     "on_save",
     "after_save",
     "maybe_fail_request",
+    "maybe_fail_serving_step",
     "poison_batch",
+    "burst_prompts",
     "truncate_file",
     "bitflip_file",
 ]
@@ -86,6 +95,17 @@ class FaultPlan:
         bit-rot / torn-write case integrity checking must catch.
     fail_request_ids: serving request ids whose prefill raises
         :class:`ChaosError` (the poison-request case).
+    step_delay_s: injected latency into serving compiled-step ATTEMPTS
+        (``maybe_fail_serving_step``, 1-based attempt ordinal counted
+        across prefill+decode, retries included).  Either a plain float
+        — every attempt sleeps that long, the sustained-slowdown case —
+        or ``{ordinal: seconds}`` for targeted hangs.  The sleep lands
+        inside the engine watchdog's timed window, so a big enough
+        delay IS a detected stall.
+    fail_step_at: 1-based serving-step attempt ordinals that raise
+        :class:`ChaosError` instead of running — the transient device
+        failure the watchdog's bounded retry must absorb (consecutive
+        ordinals exhaust the retries and quarantine the engine).
     """
 
     def __init__(self, seed: int = 0,
@@ -96,7 +116,10 @@ class FaultPlan:
                  delay_steps: Optional[Dict[int, float]] = None,
                  crash_on_save: Optional[int] = None,
                  corrupt_after_save: Optional[Dict[int, str]] = None,
-                 fail_request_ids: Iterable[str] = ()):
+                 fail_request_ids: Iterable[str] = (),
+                 step_delay_s: Union[None, float,
+                                     Dict[int, float]] = None,
+                 fail_step_at: Iterable[int] = ()):
         self.seed = seed
         self.nan_batch_steps = frozenset(nan_batch_steps)
         self.inf_batch_steps = frozenset(inf_batch_steps)
@@ -109,9 +132,12 @@ class FaultPlan:
             if kind not in ("truncate", "bitflip"):
                 raise ValueError(f"unknown corruption kind {kind!r}")
         self.fail_request_ids = frozenset(fail_request_ids)
+        self.step_delay_s = step_delay_s
+        self.fail_step_at = frozenset(fail_step_at)
         # observability: what actually fired (tests assert on these)
         self.injected: list = []
         self._save_calls = 0
+        self._serving_step_calls = 0
 
     # ------------------------------------------------------------ scope
     def __enter__(self) -> "FaultPlan":
@@ -170,6 +196,26 @@ class FaultPlan:
             self.injected.append(("fail_request", request_id))
             raise ChaosError(f"injected prefill failure for {request_id}")
 
+    def maybe_fail_serving_step(self, label: str):
+        """One serving compiled-step ATTEMPT (prefill chunk or decode
+        iteration, retries counted separately) — sleep and/or raise per
+        the schedule.  Called inside the engine watchdog's monotonic
+        window, so injected delays are observed as stalls."""
+        self._serving_step_calls += 1
+        n = self._serving_step_calls
+        delay = (self.step_delay_s if isinstance(
+            self.step_delay_s, (int, float))
+            else (self.step_delay_s or {}).get(n))
+        if delay:
+            import time
+
+            self.injected.append(("serving_delay", n, label))
+            time.sleep(delay)
+        if n in self.fail_step_at:
+            self.injected.append(("serving_fail", n, label))
+            raise ChaosError(
+                f"injected serving step failure at attempt {n} ({label})")
+
     def poison_batch(self, step: int, arrays):
         """Return ``arrays`` (a list/tuple of numpy arrays) with NaN/Inf
         written into the float entries when ``step`` is scheduled;
@@ -217,6 +263,25 @@ def after_save(path: str):
 def maybe_fail_request(request_id: str):
     if _ACTIVE is not None:
         _ACTIVE.maybe_fail_request(request_id)
+
+
+def maybe_fail_serving_step(label: str):
+    if _ACTIVE is not None:
+        _ACTIVE.maybe_fail_serving_step(label)
+
+
+def burst_prompts(seed: int, n: int, min_len: int = 4,
+                  max_len: int = 32, vocab: int = 256
+                  ) -> List[np.ndarray]:
+    """Seeded burst-arrival generator: ``n`` random int32 prompts with
+    lengths uniform in ``[min_len, max_len]`` — the deterministic
+    traffic spike overload tests and the overload bench replay so
+    shedding-on and shedding-off see the IDENTICAL workload."""
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab,
+                        size=(int(rng.randint(min_len, max_len + 1)),)
+                        ).astype(np.int32)
+            for _ in range(n)]
 
 
 def poison_batch(step: int, arrays):

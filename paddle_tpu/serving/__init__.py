@@ -17,6 +17,7 @@ Layout:
 - :mod:`scheduler` — FCFS+fairness policy, admission control, preemption
 - :mod:`metrics`   — TTFT/TPOT/queue-time counters + engine gauges
 - :mod:`endpoint`  — Predictor-shaped :class:`Endpoint` front door
+- :mod:`overload`  — load shedding, degradation ladder, step watchdog
 
 Quick start::
 
@@ -34,8 +35,10 @@ from .cache import BlockKVPool, PoolExhausted
 from .endpoint import Endpoint
 from .engine import Engine, ServingConfig
 from .metrics import RequestTimeline, ServingMetrics
+from .overload import (DEGRADED, FAILED, LADDER_LEVELS, SERVING,
+                       EngineQuarantined, OverloadController)
 from .scheduler import (FINISHED, PREEMPTED, PREFILLING, QUEUED, RUNNING,
-                        AdmissionError, Request, Scheduler)
+                        AdmissionError, QueueFull, Request, Scheduler)
 
 __all__ = [
     "Engine",
@@ -46,8 +49,15 @@ __all__ = [
     "Scheduler",
     "Request",
     "AdmissionError",
+    "QueueFull",
     "ServingMetrics",
     "RequestTimeline",
+    "OverloadController",
+    "EngineQuarantined",
+    "LADDER_LEVELS",
+    "SERVING",
+    "DEGRADED",
+    "FAILED",
     "QUEUED",
     "PREFILLING",
     "RUNNING",

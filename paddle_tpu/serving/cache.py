@@ -162,6 +162,21 @@ class BlockKVPool:
         self.evictions += 1
         return b
 
+    def evict_parked(self, n: Optional[int] = None) -> int:
+        """Eagerly evict up to ``n`` (default: all) PARKED prefix-cache
+        blocks, LRU-first, returning them to the free list.  The
+        degradation ladder's first rung (serving/overload.py): parked
+        blocks already count as allocatable headroom (``num_free``), but
+        reclaiming them up front makes the headroom real before a burst
+        of allocations has to evict one block at a time — and drops the
+        stale prefix index entries with them.  Returns the number
+        evicted."""
+        count = 0
+        while self._cached_free and (n is None or count < n):
+            self._free.append(self._evict_lru())
+            count += 1
+        return count
+
     def _release_block(self, b: int):
         """Last owner gone: park indexed content in the LRU, recycle the
         rest."""

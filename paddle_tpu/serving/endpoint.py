@@ -81,6 +81,12 @@ class Endpoint:
     def metrics(self) -> dict:
         return self.engine.stats()
 
+    def health(self) -> dict:
+        """Engine health snapshot (``Engine.health()``): the
+        serving/degraded/failed state, degradation-ladder level and
+        watchdog totals a load balancer needs for readiness checks."""
+        return self.engine.health()
+
 
 class _Handle:
     """ZeroCopyTensor-shaped view over an Endpoint io dict."""

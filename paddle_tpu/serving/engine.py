@@ -44,8 +44,9 @@ from ..observability import warn_on_retrace
 from .. import profiler
 from .cache import BlockKVPool, PoolExhausted
 from .metrics import ServingMetrics
+from .overload import EngineQuarantined, OverloadController
 from .scheduler import (FINISHED, PREFILLING, RUNNING, AdmissionError,
-                        Request, Scheduler)
+                        QueueFull, Request, Scheduler)
 
 
 def _trace(name: str):
@@ -104,6 +105,35 @@ class ServingConfig:
     # Engine.reconcile_mesh() audits the compiled programs against the
     # static shard plan (diagnostic S209).
     mesh: Any = None
+    # ---- overload control (serving/overload.py; README "Overload
+    # control & graceful degradation") ----
+    # deadline-aware load shedding at submit(): reject with
+    # finish_reason="shed" when the estimated TTFT (queue depth +
+    # pending prefill tokens over the chunk/decode latency EWMAs)
+    # already busts deadline_s.  Never fires while the EWMAs are cold.
+    enable_load_shedding: bool = True
+    shed_safety_factor: float = 1.0   # shed when est > deadline * factor
+    # KV memory-pressure watermarks (fraction of pool blocks referenced
+    # by live requests) driving the degradation ladder, with hysteresis:
+    # escalate one level per iteration STRICTLY above high, unwind one
+    # below low.  The default high of 1.0 cannot be exceeded, so the
+    # ladder is opt-in: set e.g. 0.9/0.7 to start degrading before the
+    # pool is fully referenced (preemption still guards the full-pool
+    # case either way)
+    kv_high_watermark: float = 1.0
+    kv_low_watermark: float = 0.75
+    # hung-step watchdog: per-attempt budget = watchdog_budget_mult x
+    # the step's EWMA latency, floored by watchdog_floor_s (generous:
+    # the first call pays XLA compilation); a stall or transient step
+    # exception gets step_max_retries retries with exponential backoff
+    # from step_retry_backoff_s, then the engine quarantines DEGRADED
+    # (stalls) or FAILED (exceptions, raising EngineQuarantined)
+    watchdog_budget_mult: float = 20.0
+    watchdog_floor_s: float = 30.0
+    step_max_retries: int = 2
+    step_retry_backoff_s: float = 0.05
+    # consecutive in-budget steps before DEGRADED self-heals to SERVING
+    health_recovery_steps: int = 3
 
 
 class Engine:
@@ -128,6 +158,7 @@ class Engine:
         self.scheduler = Scheduler(self.pool,
                                    max_queue_len=cfg.max_queue_len)
         self.metrics = ServingMetrics()
+        self.overload = OverloadController(cfg, self.metrics)
         S = cfg.max_batch_size
         self._slots: List[Optional[Request]] = [None] * S
         self._block_tables = np.zeros((S, self.max_blocks_per_seq),
@@ -255,24 +286,41 @@ class Engine:
                eos_token_id: Optional[int] = None, stop_sequences=None,
                tokenizer=None, request_id: Optional[str] = None,
                temperature: float = 0.0, do_sample: bool = False,
-               deadline_s: Optional[float] = None
+               deadline_s: Optional[float] = None, priority: int = 0
                ) -> Request:
         """Queue one request; returns its :class:`Request` handle.
         Raises :class:`AdmissionError` when the wait queue is full or
         the sequence can never fit the pool (backpressure: callers
         retry or shed load).
 
-        ``deadline_s`` is a wall-clock SLO measured from submission:
-        once exceeded the request is retired with
-        ``finish_reason="timeout"`` (partial tokens kept) — whether it
-        is still queued, mid-prefill, or mid-decode — instead of
-        occupying a slot other requests could use.
+        ``deadline_s`` is a monotonic-clock SLO measured from
+        submission (``time.monotonic``, so wall-clock steps/NTP slews
+        never fire it — hazard H111): once exceeded the request is
+        retired with ``finish_reason="timeout"`` (partial tokens kept)
+        — whether it is still queued, mid-prefill, or mid-decode —
+        instead of occupying a slot other requests could use.  When
+        load shedding is enabled and the engine's latency EWMAs are
+        warm, a request whose ESTIMATED time-to-first-token already
+        busts the deadline is retired immediately with
+        ``finish_reason="shed"`` (returned, not raised — cheap
+        rejection beats a guaranteed timeout).
+
+        ``priority`` (higher wins) orders overload decisions: admission
+        prefers high, shedding and preemption take the lowest first.  A
+        higher-priority arrival hitting a FULL queue sheds the
+        lowest-priority waiting request instead of being rejected.
 
         ``temperature``/``do_sample`` exist for ``generate()`` call-site
         parity only: the engine decodes greedily (one shared compiled
         step for the whole bucket), so greedy settings are accepted and
         a sampling request is a loud :class:`ValueError` rather than a
         silently different decode."""
+        if self.overload.health.failed:
+            self.metrics.on_reject()
+            raise AdmissionError(
+                "engine quarantined FAILED "
+                f"({self.overload.health.last_error}); revive() after "
+                "operator intervention")
         if do_sample or (temperature is not None
                          and float(temperature) != 0.0):
             raise ValueError(
@@ -288,14 +336,31 @@ class Engine:
             stop_sequences=normalize_stop_sequences(stop_sequences,
                                                     tokenizer),
             request_id=request_id or f"req-{next(self._ids)}",
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, priority=priority)
         if req.prompt_len + req.max_new_tokens > self.max_model_len:
             self.metrics.on_reject()
             raise AdmissionError(
                 f"{req.request_id}: prompt ({req.prompt_len}) + "
                 f"max_new_tokens ({req.max_new_tokens}) exceeds "
                 f"max_model_len ({self.max_model_len})")
+        # deadline-aware load shedding (serving/overload.py): when even
+        # an optimistic TTFT estimate busts the SLO, retire now — the
+        # caller gets the handle back with finish_reason="shed"
+        if self.overload.should_shed(self, req.prompt, deadline_s):
+            self.metrics.on_submit(req.request_id)
+            self._retire(req, "shed")
+            return req
         try:
+            self.scheduler.enqueue(req)
+        except QueueFull:
+            victim = self.scheduler.shed_candidate(req.priority)
+            if victim is None:
+                self.metrics.on_reject()
+                raise
+            # full queue, higher-priority arrival: displace the lowest-
+            # priority waiting request (shed) and take its place
+            self.scheduler.waiting.remove(victim)
+            self._retire(victim, "shed")
             self.scheduler.enqueue(req)
         except AdmissionError:
             self.metrics.on_reject()
@@ -308,7 +373,18 @@ class Engine:
         """One engine iteration: retire/admit at token granularity,
         advance admitted prompts by prefill chunks under the token
         budget, then one compiled decode step over the bucket.  Returns
-        True while there is work left (running, prefilling or waiting)."""
+        True while there is work left (running, prefilling or waiting).
+
+        Raises :class:`EngineQuarantined` when the engine is FAILED
+        (step watchdog exhausted its retries on exceptions) — call
+        :meth:`revive` after operator intervention."""
+        if self.overload.health.failed:
+            raise EngineQuarantined(
+                f"engine quarantined FAILED "
+                f"({self.overload.health.last_error}); revive() first")
+        # one hysteresis step of the memory-pressure ladder BEFORE
+        # admission, so pause_admissions takes effect this iteration
+        self.overload.ladder.tick(self)
         self._admit()
         self._prefill_tick()
         if any(r is not None and r.state == RUNNING for r in self._slots):
@@ -342,6 +418,8 @@ class Engine:
         for req in [r for r in self.scheduler.waiting if r.expired()]:
             self.scheduler.waiting.remove(req)
             self._retire(req, "timeout")
+        if self.overload.ladder.admissions_paused:
+            return
         free_slots = [i for i, r in enumerate(self._slots) if r is None]
         while free_slots:
             req = self.scheduler.next_admittable()
@@ -400,7 +478,8 @@ class Engine:
         one chunk always runs so prefill can never stall).  A request
         whose final chunk completes gets its first token here and joins
         the decode bucket this same iteration."""
-        budget = self.config.prefill_token_budget or self.chunk_tokens
+        budget = self.overload.ladder.effective_prefill_budget(
+            self.config.prefill_token_budget or self.chunk_tokens)
         prefilling = sorted(
             (r for r in self.scheduler.running if r.state == PREFILLING),
             key=lambda r: r.ordinal)
@@ -417,6 +496,11 @@ class Engine:
                     chaos.maybe_fail_request(req.request_id)
                     with _trace(f"serving::prefill:{req.request_id}"):
                         self._prefill_chunk(req)
+                except EngineQuarantined:
+                    # an ENGINE-level failure (step watchdog out of
+                    # retries) is not the request's fault — propagate
+                    # instead of retiring it as poison
+                    raise
                 except Exception as e:  # noqa: BLE001 — poison isolation
                     # ONE malformed request must not kill the engine
                     # loop: fail and retire it, free its blocks, keep
@@ -447,8 +531,12 @@ class Engine:
         ids = np.zeros((1, C), np.int32)
         ids[0, :n_tok] = req.prompt[start:start + n_tok]
         bt = self._block_tables[req.slot:req.slot + 1]
-        last, new_pools = self._prefill_step(
-            ids, self.pool.layers, bt,
+        # watchdog-wrapped dispatch (serving/overload.py): monotonic
+        # budget + bounded retry; the compiled step is pure, so a retry
+        # recomputes the identical chunk from the unchanged pool.  The
+        # pool rebind below happens only after a successful attempt.
+        last, new_pools = self.overload.prefill_watchdog.call(
+            self._prefill_step, ids, self.pool.layers, bt,
             np.asarray([start], np.int32), np.int32(n_tok - 1))
         self.pool.layers = [(k, v) for k, v in new_pools]
         req.prefill_pos = start + n_tok
@@ -559,11 +647,19 @@ class Engine:
                 if r is not None and r.state == PREFILLING:
                     bt[i] = 0
         with _trace("serving::decode_step"):
-            logits, new_pools = self._decode_step(
-                self._pending[:, None], self.pool.layers,
+            # the np.asarray device→host sync happens INSIDE the timed
+            # closure so the watchdog budget covers device execution,
+            # not just dispatch; retries recompute the same pure step
+            # on the unchanged pool (the rebind below is post-success)
+            def _timed_decode(tokens, layers, tables, lengths):
+                out, pools = self._decode_step(tokens, layers, tables,
+                                               lengths)
+                return np.asarray(out), pools
+
+            logits, new_pools = self.overload.decode_watchdog.call(
+                _timed_decode, self._pending[:, None], self.pool.layers,
                 bt, self._lengths)
             self.pool.layers = [(k, v) for k, v in new_pools]
-            logits = np.asarray(logits)
         self.metrics.on_decode_iteration(
             len(active), self.config.max_batch_size,
             self.pool.utilization())
@@ -623,8 +719,23 @@ class Engine:
         fix)."""
         return self._prefill_step._cache_size()
 
+    def health(self) -> dict:
+        """Engine health snapshot (serving/overload.py): state
+        (``"serving"``/``"degraded"``/``"failed"``), degradation-ladder
+        level, watchdog stall/retry totals, latency EWMAs, queue depth
+        and KV pressure — host-side only, cheap to poll."""
+        return self.overload.snapshot(self)
+
+    def revive(self):
+        """Operator override after a FAILED quarantine (step watchdog
+        out of retries): clear health back to SERVING so ``submit`` and
+        ``step`` accept work again.  The caller owns deciding the
+        underlying fault is gone."""
+        self.overload.health.revive()
+
     def stats(self) -> dict:
         d = self.metrics.as_dict()
         d["pool"] = self.pool.stats()
         d["queue_depth"] = len(self.scheduler.waiting)
+        d["health"] = self.health()
         return d

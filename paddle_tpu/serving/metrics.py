@@ -87,6 +87,14 @@ class ServingMetrics:
         self.prefill_chunks = 0
         self._cached_tokens_sum = 0
         self._prompt_tokens_sum = 0
+        # overload control (serving/overload.py)
+        self.shed = 0               # retired with finish_reason "shed"
+        self.goodput_tokens = 0     # tokens from requests that BEAT
+        #                             their deadline (or had none)
+        self.watchdog_stalls = 0    # step attempts over the budget
+        self.step_retries = 0       # watchdog retry attempts
+        self.degradation_level = 0  # gauge: current ladder level
+        self.health_state = 0       # gauge: 0 serving / 1 degraded / 2 failed
         # gauge accumulators (sampled once per decode iteration)
         self._occupancy_sum = 0.0
         self._cache_util_sum = 0.0
@@ -204,7 +212,13 @@ class ServingMetrics:
             self.timed_out += 1
         elif reason == "error":
             self.failed += 1
+        elif reason == "shed":
+            self.shed += 1
         self.tokens_generated += tokens
+        # goodput: tokens that were WORTH producing — the request
+        # finished inside its SLO (timeouts/sheds/errors contribute 0)
+        if reason in ("eos", "stop", "length"):
+            self.goodput_tokens += tokens
         t = self.requests[request_id]
         t.finished_ns = _now_ns()
         t.tokens_generated = tokens
@@ -221,8 +235,16 @@ class ServingMetrics:
             elif reason == "error":
                 reg.counter("serving_requests_failed_total",
                             "requests retired with an error").inc()
+            elif reason == "shed":
+                reg.counter("serving_requests_shed_total",
+                            "requests shed at admission (estimated TTFT "
+                            "past the deadline)").inc()
             reg.counter("serving_tokens_generated_total",
                         "tokens produced by decode").inc(tokens)
+            if reason in ("eos", "stop", "length"):
+                reg.counter("serving_goodput_tokens_total",
+                            "tokens from requests finished within "
+                            "deadline").inc(tokens)
             d = t.to_dict()
             if d["tpot_s"] is not None:
                 reg.histogram("serving_tpot_seconds",
@@ -232,6 +254,43 @@ class ServingMetrics:
                 reg.histogram("serving_e2e_seconds",
                               "submit-to-finish request latency"
                               ).observe(d["e2e_s"])
+
+    # ------------------------------------------------ overload control
+    def on_watchdog_stall(self, label: str):
+        """One step attempt ran past its watchdog budget."""
+        self.watchdog_stalls += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_watchdog_stalls_total",
+                        "compiled-step attempts over the watchdog "
+                        "latency budget").inc(step=label)
+
+    def on_step_retry(self, label: str):
+        """One bounded-retry attempt after a stall or step exception."""
+        self.step_retries += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_step_retries_total",
+                        "compiled-step retries (stall or transient "
+                        "exception)").inc(step=label)
+
+    def on_degradation_level(self, level: int):
+        """Degradation ladder moved to ``level`` (0 = normal)."""
+        self.degradation_level = level
+        reg = self._obs()
+        if reg is not None:
+            reg.gauge("serving_degradation_level",
+                      "memory-pressure degradation ladder level "
+                      "(0 normal .. 4 preempt)").set(level)
+
+    def on_health(self, code: int):
+        """Engine health gauge (0 serving / 1 degraded / 2 failed)."""
+        self.health_state = code
+        reg = self._obs()
+        if reg is not None:
+            reg.gauge("serving_health_state",
+                      "engine health (0 serving / 1 degraded / "
+                      "2 failed)").set(code)
 
     def on_decode_iteration(self, active: int, batch_size: int,
                             cache_utilization: float):
@@ -284,8 +343,14 @@ class ServingMetrics:
                 "prefix_cache_misses": self.prefix_cache_misses,
                 "prefix_cache_evictions": self.prefix_cache_evictions,
                 "prefill_chunks": self.prefill_chunks,
+                "requests_shed": self.shed,
+                "goodput_tokens": self.goodput_tokens,
+                "watchdog_stalls": self.watchdog_stalls,
+                "step_retries": self.step_retries,
             },
             "gauges": {
+                "degradation_level": self.degradation_level,
+                "health_state": self.health_state,
                 "batch_occupancy": self.last_batch_occupancy,
                 "batch_occupancy_avg": round(self._occupancy_sum / n, 4),
                 "cache_utilization": self.last_cache_utilization,

@@ -34,6 +34,13 @@ class AdmissionError(Exception):
     """Request rejected at submit time (backpressure or impossible fit)."""
 
 
+class QueueFull(AdmissionError):
+    """The bounded wait queue is at capacity.  Distinguished from the
+    impossible-fit AdmissionError so the engine's overload layer can
+    respond differently: a higher-priority arrival may shed the
+    lowest-priority waiting request instead of being turned away."""
+
+
 # request states
 QUEUED = "queued"
 PREFILLING = "prefilling"   # admitted; prompt chunks still being computed
@@ -56,10 +63,18 @@ class Request:
     eos_token_id: Optional[int] = None
     stop_sequences: List[List[int]] = field(default_factory=list)
     request_id: str = ""
-    # wall-clock SLO: the request is retired with finish_reason
-    # "timeout" once deadline_s seconds have passed since submission,
-    # whether it is still queued or mid-decode (partial tokens kept)
+    # per-request SLO on the MONOTONIC clock (time.monotonic, immune to
+    # wall-clock steps — hazard H111): the request is retired with
+    # finish_reason "timeout" once deadline_s seconds have elapsed since
+    # submission, whether it is still queued or mid-decode (partial
+    # tokens kept)
     deadline_s: Optional[float] = None
+    # priority class for overload control (serving/overload.py): higher
+    # wins.  Admission prefers the highest-priority waiting request,
+    # preemption and queue-full shedding take the LOWEST priority first
+    # (youngest within a class).  All-default workloads reduce exactly
+    # to the FCFS + fairness policy above.
+    priority: int = 0
     # runtime (engine-owned)
     ordinal: int = field(default_factory=lambda: next(_ordinal))
     state: str = QUEUED
@@ -137,9 +152,20 @@ class Scheduler:
                 f"{req.request_id}: needs {total} blocks at full length, "
                 f"pool capacity is {self.pool.capacity_blocks}")
         if len(self.waiting) >= self.max_queue_len:
-            raise AdmissionError(
+            raise QueueFull(
                 f"wait queue full ({self.max_queue_len}); retry later")
         self.waiting.append(req)
+
+    def shed_candidate(self, priority: int) -> Optional[Request]:
+        """Waiting request a ``priority``-class arrival may displace
+        when the queue is full: the LOWEST-priority (youngest within
+        the class) waiting request, and only when its priority is
+        strictly below the arrival's.  None when nobody qualifies —
+        same-priority traffic keeps the plain bounded-queue rejection."""
+        if not self.waiting:
+            return None
+        victim = min(self.waiting, key=lambda r: (r.priority, -r.ordinal))
+        return victim if victim.priority < priority else None
 
     def requeue_preempted(self, req: Request):
         """Victim goes to the HEAD of the queue with its original
@@ -163,7 +189,11 @@ class Scheduler:
         accounts for matched blocks parked in the evictable LRU)."""
         if not self.waiting:
             return None
-        head = self.waiting[0]
+        # highest priority class first, FCFS ordinal within a class —
+        # for all-default priorities this is exactly the old head-of-
+        # deque pick (preempted requests re-queued at the head always
+        # carry the smallest ordinals among waiting)
+        head = min(self.waiting, key=lambda r: (-r.priority, r.ordinal))
         # uncached prompt blocks + room for the first generated token's
         # write position (a new block only when the prompt fills its
         # last one)
@@ -171,24 +201,27 @@ class Scheduler:
                                                   extra_tokens=1)
         if not feasible:
             return None
-        return self.waiting.popleft()
+        self.waiting.remove(head)
+        return head
 
     # ------------------------------------------------------- preemption
     def pick_victim(self) -> Optional[Request]:
-        """Youngest running request — the least completed work lost, and
-        the last in FCFS order anyway.  The requester itself may be the
-        victim (it self-preempts rather than evicting older work)."""
+        """Lowest-priority running request, youngest within the class —
+        the least completed work lost, and the last in FCFS order
+        anyway.  The requester itself may be the victim (it self-
+        preempts rather than evicting older work).  With all-default
+        priorities this is exactly the old youngest-first pick."""
         if not self.running:
             return None
-        return max(self.running, key=lambda r: r.ordinal)
+        return max(self.running, key=lambda r: (-r.priority, r.ordinal))
 
     # ------------------------------------------------------ termination
     @staticmethod
     def finish_reason(req: Request) -> Optional[str]:
         """Termination check over the request's generated tokens —
         shared semantics with ``generate()`` (same match_stop) — plus
-        the wall-clock deadline (a hard SLO: it wins over eos/stop and
-        fires even before the first token)."""
+        the monotonic-clock deadline (a hard SLO: it wins over eos/stop
+        and fires even before the first token)."""
         if req.expired():
             return "timeout"
         if not req.generated:
@@ -204,6 +237,6 @@ class Scheduler:
         return None
 
 
-__all__ = ["AdmissionError", "Request", "Scheduler", "QUEUED",
-           "PREFILLING", "RUNNING", "PREEMPTED", "FINISHED",
+__all__ = ["AdmissionError", "QueueFull", "Request", "Scheduler",
+           "QUEUED", "PREFILLING", "RUNNING", "PREEMPTED", "FINISHED",
            "normalize_stop_sequences"]

@@ -251,6 +251,38 @@ class TestMeshServing:
         with pytest.raises(RuntimeError, match="mesh"):
             eng.reconcile_mesh()
 
+    def test_sequential_generate_static_kv_sharded(self):
+        """Satellite: sequential ``generate()``'s static KV caches are
+        committed sharded on the tp axis under an active mesh — same
+        layout as the paged pool — with token-exact outputs."""
+        from paddle_tpu.models.generation import _static_caches
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        model.eval()
+        prompt = np.random.RandomState(3).randint(
+            1, 256, size=(7,)).astype(np.int32)
+        ref = model.generate(paddle.to_tensor(prompt[None, :]),
+                             temperature=0.0, use_static_cache=True,
+                             max_new_tokens=8)
+        ref = np.asarray(ref.numpy())
+
+        ex = MeshExecutor(AXES)
+        assert ex.static_kv_spec() == PartitionSpec(
+            None, None, ex.layout.tp_axis, None)
+        caches = _static_caches(model, batch=1, max_len=32)
+        kv_heads = caches[0].k.shape[2]
+        for c in caches:
+            for buf in (c.k, c.v):
+                assert len(buf.sharding.device_set) == 8
+                assert buf.sharding.shard_shape(buf.shape)[2] \
+                    == kv_heads // 2
+        out = model.generate(paddle.to_tensor(prompt[None, :]),
+                             temperature=0.0, use_static_cache=True,
+                             max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), ref)
+        ex.close()
+
 
 # ---------------------------------------------------------------------------
 # shard-aware checkpoint: host-gather save, re-shard restore

@@ -389,14 +389,17 @@ class TestStepTimer:
 class TestServingMirror:
     _CONTRACT_COUNTERS = {
         "requests_submitted", "requests_rejected", "requests_completed",
-        "requests_timed_out", "requests_failed", "preemptions",
-        "tokens_generated", "decode_iterations", "prefills",
+        "requests_timed_out", "requests_failed", "requests_shed",
+        "preemptions", "tokens_generated", "goodput_tokens",
+        "decode_iterations", "prefills",
         "prefix_cache_hits", "prefix_cache_misses",
-        "prefix_cache_evictions", "prefill_chunks"}
+        "prefix_cache_evictions", "prefill_chunks",
+        "watchdog_stalls", "step_retries"}
     _CONTRACT_GAUGES = {
         "batch_occupancy", "batch_occupancy_avg",
         "cache_utilization", "cache_utilization_avg",
-        "prefix_cached_token_ratio"}
+        "prefix_cached_token_ratio", "degradation_level",
+        "health_state"}
 
     def _run_workload(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

@@ -55,6 +55,13 @@ python examples/pretrain_llama.py --steps 2 --batch 2 --seq 32
 python examples/generate_text.py
 python examples/serve_llama.py
 python examples/serve_llama.py --prefix-cache
+
+echo "== overload chaos (shed + hung-step recovery) =="
+# seeded burst under an injected sustained slowdown: hopeless requests
+# are shed at admission (zero timeouts), then an injected hung decode
+# step is detected and retried by the watchdog and the engine recovers
+# to SERVING — all with zero retraces (README: Overload control)
+python examples/serve_llama.py --overload-chaos
 python examples/export_and_serve.py
 python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py
