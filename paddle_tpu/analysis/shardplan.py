@@ -57,11 +57,12 @@ import numpy as np
 
 from .verifier import ERROR, WARNING, Diagnostic
 from .xray import (CHIPS, ChipProfile, _aval_bytes, _collect_costs,
-                   _peak_live_bytes, _var_bytes, estimate_collective_time,
-                   estimate_compute_time)
+                   _peak_live_by_dtype, _peak_live_bytes, _var_bytes,
+                   estimate_collective_time, estimate_compute_time)
 
 __all__ = [
     "Collective",
+    "MoEStatics",
     "PlanReport",
     "PlanRequest",
     "audit_shardplan",
@@ -127,6 +128,11 @@ def _spec_str(spec: ShardSpec) -> str:
     return "(" + ", ".join(one(e) for e in spec) + ")"
 
 
+# primitives that carry an axis_name param but move no tensor bytes —
+# they must not trip the S210 unpriced-collective detector
+_AXIS_NAME_FREE = {"axis_index", "axis_size", "pvary"}
+
+
 # ---------------------------------------------------------------------------
 # report dataclasses
 # ---------------------------------------------------------------------------
@@ -156,6 +162,22 @@ class Collective:
         return self.time_s * self.count
 
 
+@dataclasses.dataclass(frozen=True)
+class MoEStatics:
+    """Static description of one capacity-padded MoE dispatch (GShard
+    style ``[E, C, M]`` buffers).  Lets the planner (a) price the expert
+    exchange as an all_to_all sized from the padded payload instead of a
+    worst-case all-reduce and (b) statically check capacity overflow
+    (S211: ``tokens·top_k > experts·capacity`` drops routed tokens)."""
+
+    experts: int               # E
+    capacity: int              # C slots per expert
+    top_k: int                 # routing choices per token
+    tokens: int                # tokens routed per step (batch · seq)
+    capacity_factor: float = 1.0
+    expert_axis: str = "expert"
+
+
 @dataclasses.dataclass
 class PlanReport:
     """Static mesh-execution plan for one traced step."""
@@ -171,6 +193,10 @@ class PlanReport:
     diagnostics: List[Diagnostic]
     param_specs: Dict[str, str]
     hbm_budget_bytes: Optional[int] = None
+    # dtype -> per-chip bytes held at the liveness peak (sums to
+    # per_chip_peak_hbm_bytes); the dtype-aware gauge for int8/fp8 KV
+    per_chip_peak_hbm_by_dtype: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def comm_bytes(self) -> float:
@@ -235,6 +261,7 @@ class PlanRequest:
     s205_bytes: int = 1 << 20     # unplanned-gather ERROR threshold
     s206_bytes: int = 8 << 20     # replicated-param WARNING threshold
     raise_on_error: bool = True
+    moe: Optional[MoEStatics] = None  # set for MoE steps (S211 + a2a pricing)
 
     def resolved_layout(self):
         if self.layout is not None:
@@ -255,11 +282,16 @@ class _Planner:
     vars of inner jaxprs, so the shard-aware liveness callback can
     resolve any var the peak-HBM walk touches."""
 
-    def __init__(self, mesh: Dict[str, int], chip: ChipProfile):
+    def __init__(self, mesh: Dict[str, int], chip: ChipProfile,
+                 moe: Optional[MoEStatics] = None):
         self.mesh = dict(mesh)
         self.chip = chip
+        self.moe = moe
         self.env: Dict[Any, ShardSpec] = {}
         self.collectives: List[Collective] = []
+        # (primitive, axes) pairs that carried an axis_name but have no
+        # pricing rule — the S210 silent-blind-spot inventory
+        self.unknown_collectives: List[Tuple[str, Tuple[str, ...]]] = []
 
     # -- env ---------------------------------------------------------------
 
@@ -290,12 +322,15 @@ class _Planner:
     # -- collective emission -----------------------------------------------
 
     def emit(self, kind: str, axes: Sequence[str], payload: float,
-             planned: bool, primitive: str, mul: float):
+             planned: bool, primitive: str, mul: float,
+             factor: Optional[float] = None):
         axes = tuple(a for a in axes if self.mesh.get(a, 1) > 1)
         n = _axes_product(axes, self.mesh)
         if n <= 1 or payload <= 0:
             return
-        factor = 2.0 * (n - 1) / n if kind == "all_reduce" else (n - 1) / n
+        if factor is None:
+            factor = (2.0 * (n - 1) / n if kind == "all_reduce"
+                      else (n - 1) / n)
         moved = int(payload * factor)
         self.collectives.append(Collective(
             kind=kind, axes=axes, payload_bytes=int(payload),
@@ -335,35 +370,53 @@ class _Planner:
         if handler is not None:
             handler(self, eqn, mul)
         elif name in ("cond", "while", "scan", "pjit") or \
-                "jaxpr" in eqn.params or "call_jaxpr" in eqn.params:
+                "jaxpr" in eqn.params or "call_jaxpr" in eqn.params \
+                or "fun_jaxpr" in eqn.params:
             self._call_like(eqn, mul)
         else:
+            if name not in _AXIS_NAME_FREE and (
+                    "axis_name" in eqn.params
+                    or "axis_index_groups" in eqn.params):
+                # a collective-looking primitive the planner cannot
+                # price — record it so S210 surfaces the blind spot
+                axes = eqn.params.get("axis_name", ())
+                if isinstance(axes, str):
+                    axes = (axes,)
+                axes = tuple(str(a) for a in (axes or ()))
+                if not axes or _axes_product(axes, self.mesh) > 1:
+                    self.unknown_collectives.append((name, axes))
             self._default(eqn, mul)
 
     # -- generic rules -----------------------------------------------------
 
     def _default(self, eqn, mul: float):
-        """Elementwise/unknown: per-dim union across same-shaped
-        operands; disagreeing operands lose their axes (unplanned
-        gather); unknown shapes replicate without inventing traffic."""
+        """Elementwise/unknown: per-dim union across broadcast-compatible
+        operands (right-aligned; size-1 dims contribute nothing);
+        disagreeing operands lose their axes (unplanned gather);
+        unknown shapes replicate without inventing traffic."""
         for out in eqn.outvars:
-            out_shape = getattr(out.aval, "shape", ()) or ()
+            out_shape = tuple(getattr(out.aval, "shape", ()) or ())
             rank = len(out_shape)
             merged: List[Tuple[str, ...]] = [()] * rank
             conflict_axes: set = set()
             for v in eqn.invars:
                 if isinstance(v, jax.core.Literal):
                     continue
-                if (getattr(v.aval, "shape", None) or ()) != tuple(out_shape):
+                v_shape = tuple(getattr(v.aval, "shape", None) or ())
+                off = rank - len(v_shape)
+                if off < 0 or any(
+                        s != out_shape[off + i] and s != 1
+                        for i, s in enumerate(v_shape)):
                     continue
                 spec = self.spec_of(v)
-                for d in range(rank):
-                    if not spec[d]:
+                for i, s in enumerate(v_shape):
+                    d = off + i
+                    if s != out_shape[d] or not spec[i]:
                         continue
                     if not merged[d]:
-                        merged[d] = spec[d]
-                    elif merged[d] != spec[d]:
-                        conflict_axes.update(set(spec[d]) - set(merged[d]))
+                        merged[d] = spec[i]
+                    elif merged[d] != spec[i]:
+                        conflict_axes.update(set(spec[i]) - set(merged[d]))
             for a in sorted(conflict_axes):
                 self.emit("all_gather", (a,),
                           _aval_bytes(out.aval)
@@ -440,7 +493,12 @@ class _Planner:
             self.run(inner, mul * trips)
             self._match_specs(eqn.outvars, inner.outvars, False)
             return
-        inner = params.get("jaxpr", params.get("call_jaxpr"))
+        # custom_vjp_call_jaxpr keeps its primal body under fun_jaxpr —
+        # recursing through it makes hand-differentiated kernels
+        # (moe_dispatch/combine) transparent instead of opaque leaves
+        inner = params.get("jaxpr",
+                           params.get("call_jaxpr",
+                                      params.get("fun_jaxpr")))
         inner = getattr(inner, "jaxpr", inner)
         self._match_specs(eqn.invars, inner.invars, True)
         self.run(inner, mul)
@@ -492,11 +550,38 @@ def _rule_dot_general(pl: _Planner, eqn, mul: float):
         if i not in tuple(rc) + tuple(rb):
             out_spec.append(tuple(rs[i]))
     final = pl._dedupe(tuple(out_spec), used, out_bytes, "dot_general", mul)
+    out_shape = tuple(getattr(out.aval, "shape", ()) or ())
+    moe = pl.moe
+    # GShard MoE dispatch: a token-sharded contraction assembling the
+    # capacity-padded [E, C, M] buffer that the expert axis consumes.
+    # GSPMD lowers that exchange to an all_to_all over 'expert' (each
+    # chip keeps only its experts' slots) plus the token-axis reduction
+    # of the surviving local slice — not an all-reduce of the full
+    # padded buffer on every chip.
+    is_moe_dispatch = (
+        moe is not None and reduce_axes and len(out_shape) >= 2
+        and int(out_shape[0]) == int(moe.experts)
+        and int(out_shape[1]) == int(moe.capacity)
+        and pl.mesh.get(moe.expert_axis, 1) > 1
+        and moe.expert_axis not in {a for e in final for a in e})
+    if is_moe_dispatch and not final[0]:
+        final = ((moe.expert_axis,),) + final[1:]
     pl.set_spec(out, final)
     if reduce_axes:
-        payload = out_bytes / _shard_count(final, pl.mesh)
-        pl.emit("all_reduce", tuple(sorted(set(reduce_axes))), payload,
-                True, "dot_general", mul)
+        if is_moe_dispatch:
+            e_ax = moe.expert_axis
+            e_n = _axes_product([e_ax], pl.mesh)
+            payload = out_bytes / _shard_count(final[1:], pl.mesh)
+            pl.emit("all_to_all", (e_ax,), payload, True,
+                    "dot_general(moe_dispatch)", mul)
+            rest = tuple(a for a in sorted(set(reduce_axes)) if a != e_ax)
+            if rest:
+                pl.emit("all_reduce", rest, payload / e_n, True,
+                        "dot_general(moe_dispatch)", mul)
+        else:
+            payload = out_bytes / _shard_count(final, pl.mesh)
+            pl.emit("all_reduce", tuple(sorted(set(reduce_axes))), payload,
+                    True, "dot_general", mul)
 
 
 def _rule_transpose(pl: _Planner, eqn, mul: float):
@@ -637,8 +722,25 @@ def _rule_gather(pl: _Planner, eqn, mul: float):
     if lookup_axes:
         payload = (_aval_bytes(out.aval)
                    / _shard_count(pl.spec_of(out), pl.mesh))
-        pl.emit("all_reduce", tuple(lookup_axes), payload, True,
-                "gather", mul)
+        moe = pl.moe
+        # MoE combine: tokens read their slots back out of the
+        # expert-sharded [E, C, M] buffer — each chip redistributes its
+        # local expert slice over the expert axis (all_to_all of the
+        # local slice), rather than all-reducing the gathered output
+        if (moe is not None and len(op_shape) >= 2
+                and int(op_shape[0]) == int(moe.experts)
+                and int(op_shape[1]) == int(moe.capacity)
+                and moe.expert_axis in lookup_axes):
+            local = (_aval_bytes(operand.aval)
+                     / _shard_count(ospec, pl.mesh))
+            pl.emit("all_to_all", (moe.expert_axis,), local, True,
+                    "gather(moe_combine)", mul)
+            rest = tuple(a for a in lookup_axes if a != moe.expert_axis)
+            if rest:
+                pl.emit("all_reduce", rest, payload, True, "gather", mul)
+        else:
+            pl.emit("all_reduce", tuple(lookup_axes), payload, True,
+                    "gather", mul)
 
 
 def _rule_scatter(pl: _Planner, eqn, mul: float):
@@ -719,6 +821,67 @@ def _rule_replicated(pl: _Planner, eqn, mul: float):
         pl.set_spec(out, _rep(_rank(out)))
 
 
+def _rule_top_k(pl: _Planner, eqn, mul: float):
+    """top_k reduces the trailing dim to k: leading dims keep their
+    sharding, the shrunken last dim replicates (MoE routing keeps its
+    token sharding through the expert choice)."""
+    spec = pl.spec_of(eqn.invars[0])
+    out_spec = (spec[:-1] + ((),)) if spec else ()
+    for out in eqn.outvars:
+        pl.set_spec(out, out_spec)
+
+
+def _rule_ppermute(pl: _Planner, eqn, mul: float):
+    """One ring hop: every chip forwards its LOCAL buffer to one
+    neighbor over a single ICI edge, so wire bytes = the payload itself
+    (factor 1.0), not the ring ``(n-1)/n`` formula.  The ×ring-length
+    multiplier arrives through ``mul``: ring attention's fori_loop
+    lowers to a scan whose trip count is the ring length."""
+    axes = eqn.params.get("axis_name", ())
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(str(a) for a in (axes or ()))
+    for v, out in zip(eqn.invars, eqn.outvars):
+        payload = (_aval_bytes(getattr(v, "aval", None) or out.aval)
+                   / _shard_count(pl.spec_of(v), pl.mesh))
+        pl.emit("ppermute", axes, payload, True, "ppermute", mul,
+                factor=1.0)
+        pl.set_spec(out, pl.spec_of(v))
+
+
+def _names_to_spec(names, rank: int) -> ShardSpec:
+    """shard_map in_names/out_names entry ({dim: (axes, ...)}) → spec."""
+    spec: List[Tuple[str, ...]] = [()] * rank
+    if isinstance(names, dict):
+        for dim, axes in names.items():
+            d = int(dim)
+            if 0 <= d < rank:
+                if isinstance(axes, str):
+                    axes = (axes,)
+                spec[d] = tuple(str(a) for a in axes)
+        return tuple(spec)
+    return _normalize_spec(names, rank)
+
+
+def _rule_shard_map(pl: _Planner, eqn, mul: float):
+    """Recurse into the per-shard body.  Inner avals are already LOCAL
+    (divided by the axes in in_names), so inner invars start replicated
+    — every byte and collective payload inside is per-chip as-is — and
+    the outer outputs take their global spec straight from out_names."""
+    inner = eqn.params["jaxpr"]
+    inner = getattr(inner, "jaxpr", inner)
+    for iv in inner.invars:
+        pl.set_spec(iv, _rep(_rank(iv)))
+    pl.run(inner, mul)
+    out_names = tuple(eqn.params.get("out_names", ()) or ())
+    for i, ov in enumerate(eqn.outvars):
+        rank = _rank(ov)
+        if i < len(out_names):
+            pl.set_spec(ov, _names_to_spec(out_names[i], rank))
+        else:
+            pl.set_spec(ov, _rep(rank))
+
+
 def _make_collective_rule(kind: str):
     def rule(pl: _Planner, eqn, mul: float):
         axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
@@ -763,6 +926,9 @@ _RULES = {
     "all_gather": _make_collective_rule("all_gather"),
     "psum_scatter": _make_collective_rule("reduce_scatter"),
     "all_to_all": _make_collective_rule("all_to_all"),
+    "ppermute": _rule_ppermute,
+    "shard_map": _rule_shard_map,
+    "top_k": _rule_top_k,
 }
 
 
@@ -781,7 +947,8 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
                data_inputs: Sequence[Tuple[str, int]] = (),
                data_axis: str = "data",
                s205_bytes: int = 1 << 20,
-               s206_bytes: int = 8 << 20) -> PlanReport:
+               s206_bytes: int = 8 << 20,
+               moe: Optional[MoEStatics] = None) -> PlanReport:
     """Propagate ``invar_specs`` (one PartitionSpec-like or None per
     jaxpr invar; ``constvar_specs`` likewise for constvars) through
     ``closed`` on the abstract ``mesh`` and build the
@@ -797,7 +964,7 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
     for v in mesh.values():
         n_chips *= v
     jaxpr = closed.jaxpr
-    pl = _Planner(mesh, profile)
+    pl = _Planner(mesh, profile, moe=moe)
     for v, spec in zip(jaxpr.invars, list(invar_specs) or []):
         pl.set_spec(v, _normalize_spec(spec, _rank(v)))
     for v, spec in zip(jaxpr.constvars, list(constvar_specs or [])):
@@ -819,7 +986,7 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
         n = _shard_count(pl.spec_of(v), pl.mesh)
         return -(-b // n)  # ceil: padding never under-counts
 
-    peak = _peak_live_bytes(jaxpr, sharded_bytes)
+    peak, peak_by_dtype = _peak_live_by_dtype(jaxpr, sharded_bytes)
 
     where = f"shardplan:{name}"
     diags: List[Diagnostic] = []
@@ -886,6 +1053,50 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
                     "whole batch is replicated; data parallelism buys "
                     "nothing for this input", where))
 
+    # S210 — unpriced collective primitive: the plan silently omits its
+    # traffic, which defeats the whole point of planning first
+    for prim, axes in sorted(set(pl.unknown_collectives)):
+        diags.append(Diagnostic(
+            "S210", ERROR,
+            f"unpriced collective primitive '{prim}' over mesh axes "
+            f"{list(axes) or '<unknown>'}: the planner has no "
+            "propagation/pricing rule for it, so its wire traffic is "
+            "MISSING from this plan — add a rule to shardplan._RULES "
+            "before trusting any number in this report", where))
+
+    # S211 — static expert capacity overflow: top-k routing mass vs the
+    # declared capacity-padded buffer; overflowing slots drop tokens
+    if moe is not None:
+        demand = int(moe.tokens) * int(moe.top_k)
+        supply = int(moe.experts) * int(moe.capacity)
+        if demand > supply:
+            diags.append(Diagnostic(
+                "S211", ERROR,
+                f"static expert capacity overflow: {moe.tokens} tokens × "
+                f"top-{moe.top_k} = {demand} routed slots but E×C = "
+                f"{moe.experts}×{moe.capacity} = {supply} at capacity "
+                f"factor {moe.capacity_factor:g} — "
+                f"{demand - supply} routing choices are statically "
+                "guaranteed to drop; raise the capacity factor or the "
+                "expert count", where))
+
+    # S212 — ring hop that cannot hide under compute: the per-hop
+    # permute must overlap one hop's worth of local attention compute
+    for c in pl.collectives:
+        if c.kind != "ppermute":
+            continue
+        hops = max(1.0, float(c.count))
+        window = compute_t / hops
+        if c.time_s > window:
+            diags.append(Diagnostic(
+                "S212", WARNING,
+                f"ring/sp hop moves {c.bytes_moved / 1024:.1f} KiB over "
+                f"{list(c.axes)} taking {c.time_s * 1e6:.1f} µs on "
+                f"{profile.name} ICI, but only {window * 1e6:.1f} µs of "
+                "per-hop compute exists to hide it — the ring is "
+                "ICI-bound; grow the per-chip sequence chunk or use a "
+                "faster interconnect", where))
+
     if hbm_budget_bytes is not None and peak > hbm_budget_bytes:
         diags.append(Diagnostic(
             "H110", ERROR,
@@ -902,7 +1113,8 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
         name=name, chip=profile, mesh=mesh, n_chips=n_chips,
         per_chip_peak_hbm_bytes=peak, collectives=pl.collectives,
         flops=flops, bytes=byts, diagnostics=sort_diagnostics(diags),
-        param_specs=param_specs, hbm_budget_bytes=hbm_budget_bytes)
+        param_specs=param_specs, hbm_budget_bytes=hbm_budget_bytes,
+        per_chip_peak_hbm_by_dtype=peak_by_dtype)
 
 
 def _mesh_str(mesh: Dict[str, int]) -> str:
@@ -966,7 +1178,8 @@ def plan_train_step(step_fn, inputs, labels, *,
         closed, specs, mesh=req.mesh, name=name, chip=req.chip,
         hbm_budget_bytes=req.hbm_budget_bytes, param_info=param_info,
         data_inputs=data_inputs, data_axis=layout.data_axis,
-        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes)
+        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes,
+        moe=req.moe)
 
 
 def plan_step(step, abstract_args: Sequence[Any], *, model,
@@ -1015,7 +1228,8 @@ def plan_step(step, abstract_args: Sequence[Any], *, model,
         hbm_budget_bytes=req.hbm_budget_bytes,
         extra_var_specs=extra, param_info=param_info,
         data_inputs=data_input_leaves, data_axis=layout.data_axis,
-        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes)
+        s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes,
+        moe=req.moe)
 
 
 def _iter_const_bindings(closed):
@@ -1024,7 +1238,8 @@ def _iter_const_bindings(closed):
     cond / custom_* all carry their own consts)."""
     yield from zip(closed.jaxpr.constvars, closed.consts)
     for eqn in closed.jaxpr.eqns:
-        for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr",
+                    "body_jaxpr"):
             inner = eqn.params.get(key)
             if inner is not None and hasattr(inner, "consts"):
                 yield from _iter_const_bindings(inner)
@@ -1070,17 +1285,32 @@ def _serving_arg_specs(model, layout, decode_args, prefill_args):
     return decode, prefill
 
 
+#: audit_shardplan's default step set and the canonical mesh each step
+#: falls back to when the caller's mesh lacks its required axis
+DEFAULT_AUDIT_STEPS = ("train", "decode", "prefill", "moe", "ring")
+_MOE_AUDIT_MESH = {"data": 2, "fsdp": 2, "expert": 2}
+_RING_AUDIT_MESH = {"data": 2, "sp": 2, "tp": 2}
+
+
 def audit_shardplan(*, chip: str = "cpu",
                     hbm_budget_bytes: Optional[int] = None,
                     mesh: Optional[Dict[str, int]] = None,
                     layout: Any = None,
                     s205_bytes: int = 1 << 10,
-                    s206_bytes: int = 8 << 20) -> List[PlanReport]:
-    """Plan all three default step kinds (train, paged decode, chunked
-    prefill) for a tiny Llama against the canonical llama SpecLayout on
-    a simulated ``(data=2, fsdp=2, tp=2)`` mesh — entirely on CPU, no
-    devices.  The ``lint_tpu.py --shardplan`` / CI entry point; callers
-    gate on ``report.errors()``.
+                    s206_bytes: int = 8 << 20,
+                    steps: Sequence[str] = DEFAULT_AUDIT_STEPS
+                    ) -> List[PlanReport]:
+    """Plan the default step kinds (train, paged decode, chunked
+    prefill, MoE block, ring/sp block) for tiny Llamas against the
+    canonical llama SpecLayout — entirely on CPU, no devices.  The
+    ``lint_tpu.py --shardplan`` / CI entry point; callers gate on
+    ``report.errors()``.
+
+    Train/decode/prefill plan on the caller's mesh (default
+    ``(data=2, fsdp=2, tp=2)``); the MoE step needs an ``expert`` axis
+    and the ring step an ``sp`` axis, so each falls back to its
+    canonical mesh (``_MOE_AUDIT_MESH`` / ``_RING_AUDIT_MESH``) when
+    the caller's mesh lacks it.  ``steps`` filters which kinds run.
 
     The S205 threshold defaults to 1 KiB here (not the production
     1 MiB): the CI model is tiny, and a CLEAN layout emits zero
@@ -1101,34 +1331,78 @@ def audit_shardplan(*, chip: str = "cpu",
     net = LlamaForCausalLM(cfg)
     reports: List[PlanReport] = []
 
-    model = paddle.Model(net)
-    model.prepare(AdamW(1e-3, parameters=net.parameters()),
-                  nn.CrossEntropyLoss())
-    ids = np.zeros((2, 16), np.int64)
-    reports.append(plan_train_step(
-        model._train_step_fn, [paddle.to_tensor(ids[:, :-1])],
-        [paddle.to_tensor(ids[:, 1:])], request=req))
+    if "train" in steps:
+        model = paddle.Model(net)
+        model.prepare(AdamW(1e-3, parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        ids = np.zeros((2, 16), np.int64)
+        reports.append(plan_train_step(
+            model._train_step_fn, [paddle.to_tensor(ids[:, :-1])],
+            [paddle.to_tensor(ids[:, 1:])], request=req))
 
     from ..models.generation import (make_chunked_prefill_step,
-                                     make_paged_decode_step)
+                                     make_moe_block_step,
+                                     make_paged_decode_step,
+                                     make_ring_sp_step)
     from .xray import _serving_abstract_args
 
     net.eval()
-    decode_args, prefill_args = _serving_abstract_args(
-        net, batch=4, num_blocks=32, block_size=8,
-        max_blocks_per_seq=8, chunk_tokens=32)
-    decode_specs, prefill_specs = _serving_arg_specs(
-        net, lay, decode_args, prefill_args)
-    reports.append(plan_step(
-        make_paged_decode_step(net), decode_args, model=net,
-        arg_specs=decode_specs, request=req,
-        name="serving::decode_step",
-        data_input_leaves=(("tokens", 0),)))
-    reports.append(plan_step(
-        make_chunked_prefill_step(net), prefill_args, model=net,
-        arg_specs=prefill_specs, request=req,
-        name="serving::prefill_step",
-        data_input_leaves=(("chunk_ids", 0),)))
+    if "decode" in steps or "prefill" in steps:
+        decode_args, prefill_args = _serving_abstract_args(
+            net, batch=4, num_blocks=32, block_size=8,
+            max_blocks_per_seq=8, chunk_tokens=32)
+        decode_specs, prefill_specs = _serving_arg_specs(
+            net, lay, decode_args, prefill_args)
+        if "decode" in steps:
+            reports.append(plan_step(
+                make_paged_decode_step(net), decode_args, model=net,
+                arg_specs=decode_specs, request=req,
+                name="serving::decode_step",
+                data_input_leaves=(("tokens", 0),)))
+        if "prefill" in steps:
+            reports.append(plan_step(
+                make_chunked_prefill_step(net), prefill_args, model=net,
+                arg_specs=prefill_specs, request=req,
+                name="serving::prefill_step",
+                data_input_leaves=(("chunk_ids", 0),)))
+
+    sds = jax.ShapeDtypeStruct
+    if "moe" in steps:
+        from ..kernels.moe_dispatch import moe_capacity
+
+        moe_mesh = (req.mesh if "expert" in (req.mesh or {})
+                    else dict(_MOE_AUDIT_MESH))
+        E, K, cf = 4, 2, 2.0
+        B, T = 4, 16
+        moe_req = dataclasses.replace(
+            req, mesh=moe_mesh,
+            moe=MoEStatics(experts=E, capacity=moe_capacity(B * T, E, K, cf),
+                           top_k=K, tokens=B * T, capacity_factor=cf))
+        moe_net = LlamaForCausalLM(LlamaConfig.tiny(
+            moe_num_experts=E, moe_top_k=K, moe_capacity_factor=cf))
+        moe_net.eval()
+        reports.append(plan_step(
+            make_moe_block_step(moe_net), (sds((B, T), np.int32),),
+            model=moe_net, arg_specs=(lay.batch_spec(),),
+            request=moe_req, name="moe::block_step",
+            data_input_leaves=(("tokens", 0),)))
+
+    if "ring" in steps:
+        from ..distributed.mesh import abstract_mesh
+
+        ring_mesh = (req.mesh if "sp" in (req.mesh or {})
+                     else dict(_RING_AUDIT_MESH))
+        ring_req = dataclasses.replace(req, mesh=ring_mesh, moe=None)
+        ring_net = LlamaForCausalLM(LlamaConfig.tiny(
+            context_parallel="ring"))
+        ring_net.eval()
+        reports.append(plan_step(
+            make_ring_sp_step(ring_net, mesh=abstract_mesh(ring_mesh)),
+            (sds((4, 32), np.int32),),
+            model=ring_net, arg_specs=(lay.batch_spec(),),
+            request=ring_req, name="ring::sp_step",
+            data_input_leaves=(("tokens", 0),)))
+
     for r in reports:
         export_plan_gauges(r)
     return reports
@@ -1148,3 +1422,7 @@ def export_plan_gauges(report: PlanReport):
     reg.gauge("shardplan_per_chip_peak_hbm_bytes",
               "shard-aware liveness peak HBM per chip of a planned step"
               ).set(report.per_chip_peak_hbm_bytes, step=report.name)
+    g = reg.gauge("shardplan_per_chip_peak_hbm_bytes_by_dtype",
+                  "per-chip bytes of one dtype at the liveness peak")
+    for dt, b in sorted(report.per_chip_peak_hbm_by_dtype.items()):
+        g.set(b, step=report.name, dtype=dt)

@@ -93,6 +93,8 @@ class ChipProfile:
     hbm_bytes: int           # capacity per chip
     ici_bandwidth: float = 1e11   # bytes/s per chip over the interconnect
     ici_latency: float = 1e-6     # per-collective launch latency, seconds
+    dcn_bandwidth: float = 6.25e9  # bytes/s per chip over the data-center net
+    dcn_latency: float = 1e-5      # per-collective DCN launch latency, seconds
 
     @property
     def ridge(self) -> float:
@@ -108,12 +110,24 @@ class ChipProfile:
 # 1 us on real ICI.  "cpu" is loopback shared memory on the dev box —
 # fast and near-zero-latency so CPU CI classifies the tiny model as
 # compute-heavy the way a real topology-free single host would.
+#
+# DCN figures are the per-chip share of the host NIC from the public
+# multislice / system-architecture pages: v4 and v5e hosts carry
+# 100–200 Gbps NICs over 4 chips, v5p and v6e (Trillium) quote 400 Gbps
+# per host.  DCN latency is cross-host (order 10 us), an order of
+# magnitude above one ICI hop — the multi-host planner prices DCN edges
+# from these instead of needing another CHIPS schema change.
 CHIPS: Dict[str, ChipProfile] = {
-    "v4": ChipProfile("v4", 275e12, 1228e9, 32 << 30, 300e9, 1e-6),
-    "v5e": ChipProfile("v5e", 197e12, 819e9, 16 << 30, 200e9, 1e-6),
-    "v5p": ChipProfile("v5p", 459e12, 2765e9, 95 << 30, 600e9, 1e-6),
-    "v6e": ChipProfile("v6e", 918e12, 1640e9, 32 << 30, 448e9, 1e-6),
-    "cpu": ChipProfile("cpu", 5e11, 50e9, 8 << 30, 200e9, 0.0),
+    "v4": ChipProfile("v4", 275e12, 1228e9, 32 << 30, 300e9, 1e-6,
+                      6.25e9, 1e-5),
+    "v5e": ChipProfile("v5e", 197e12, 819e9, 16 << 30, 200e9, 1e-6,
+                       3.125e9, 1e-5),
+    "v5p": ChipProfile("v5p", 459e12, 2765e9, 95 << 30, 600e9, 1e-6,
+                       12.5e9, 1e-5),
+    "v6e": ChipProfile("v6e", 918e12, 1640e9, 32 << 30, 448e9, 1e-6,
+                       12.5e9, 1e-5),
+    "cpu": ChipProfile("cpu", 5e11, 50e9, 8 << 30, 200e9, 0.0,
+                       1e9, 5e-6),
 }
 
 
@@ -198,7 +212,10 @@ def _sub_jaxprs(eqn):
                 (params["body_jaxpr"].jaxpr, 1)]
     if name == "scan":
         return [(params["jaxpr"].jaxpr, int(params.get("length", 1)))]
-    for key in ("jaxpr", "call_jaxpr"):
+    # custom_vjp_call_jaxpr keeps its primal body under ``fun_jaxpr``
+    # (custom_jvp uses call_jaxpr) — without it the analyzers are blind
+    # to anything wrapped for a hand-written backward, e.g. moe_dispatch
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
         inner = params.get(key)
         if inner is not None:
             inner = getattr(inner, "jaxpr", inner)  # Closed -> open
@@ -312,12 +329,24 @@ def _count_eqns(jaxpr) -> int:
 # liveness walk (peak HBM)
 # ---------------------------------------------------------------------------
 
-def _peak_live_bytes(jaxpr, var_bytes=_var_bytes) -> int:
+def _var_dtype(v) -> str:
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    return str(dt) if dt is not None else "opaque"
+
+
+def _peak_live_by_dtype(jaxpr, var_bytes=_var_bytes
+                        ) -> Tuple[int, Dict[str, int]]:
     """Linear-scan liveness over one open jaxpr: a var is live from its
     definition (entry for invars/constvars) to its last use (program end
     for outputs).  Call-like eqns add ``inner_peak - boundary`` as a
     transient — the inner program's scratch beyond what the caller
     already accounts for at the call boundary.
+
+    Returns ``(peak_bytes, {dtype: bytes held at the peak})`` — the
+    breakdown is a snapshot of the live set when the peak is reached
+    (call-like transients attributed by the inner program's own dtype
+    mix beyond the boundary), so int8/fp8 KV or weight buffers show up
+    as their own line instead of vanishing into one total.
 
     ``var_bytes`` maps a jaxpr var (or Literal) to its byte size;
     shardplan passes a shard-aware callback that divides each buffer by
@@ -332,30 +361,82 @@ def _peak_live_bytes(jaxpr, var_bytes=_var_bytes) -> int:
         if not isinstance(v, jax.core.Literal):
             last_use[v] = n  # live through the end
     live: Dict[Any, int] = {}
+    by_dtype: Dict[str, int] = {}
+
+    def _add(v):
+        b = var_bytes(v)
+        live[v] = b
+        if b:
+            dt = _var_dtype(v)
+            by_dtype[dt] = by_dtype.get(dt, 0) + b
+        return b
+
+    def _drop(v):
+        b = live.pop(v)
+        if b:
+            dt = _var_dtype(v)
+            rem = by_dtype.get(dt, 0) - b
+            if rem > 0:
+                by_dtype[dt] = rem
+            else:
+                by_dtype.pop(dt, None)
+        return b
+
     for v in tuple(jaxpr.invars) + tuple(jaxpr.constvars):
-        live[v] = var_bytes(v)
+        _add(v)
     current = sum(live.values())
     peak = current
+    snap = dict(by_dtype)
     for i, eqn in enumerate(jaxpr.eqns):
         for v in eqn.outvars:
             if v not in live:
-                live[v] = var_bytes(v)
-                current += live[v]
+                current += _add(v)
         transient = 0
+        extra_bd: Dict[str, int] = {}
         subs = _sub_jaxprs(eqn)
         if subs:
             boundary = (sum(var_bytes(v) for v in eqn.invars)
                         + sum(var_bytes(v) for v in eqn.outvars))
-            inner_peak = max(_peak_live_bytes(inner, var_bytes)
-                             for inner, _ in subs)
+            inner_peak, inner_bd = -1, {}
+            for inner, _ in subs:
+                ip, ibd = _peak_live_by_dtype(inner, var_bytes)
+                if ip > inner_peak:
+                    inner_peak, inner_bd = ip, ibd
             transient = max(0, inner_peak - boundary)
-        peak = max(peak, current + transient)
+            if transient > 0:
+                # attribute the scratch beyond the boundary by the inner
+                # program's dtype mix (minus what the boundary already
+                # holds per dtype), rescaled to sum to the transient
+                bound_bd: Dict[str, int] = {}
+                for v in tuple(eqn.invars) + tuple(eqn.outvars):
+                    b = var_bytes(v)
+                    if b:
+                        dt = _var_dtype(v)
+                        bound_bd[dt] = bound_bd.get(dt, 0) + b
+                extra = {dt: max(0, b - bound_bd.get(dt, 0))
+                         for dt, b in inner_bd.items()}
+                s = sum(extra.values())
+                if s > 0:
+                    extra_bd = {dt: int(round(b * transient / s))
+                                for dt, b in extra.items() if b}
+                else:
+                    extra_bd = {"opaque": transient}
+        if current + transient > peak:
+            peak = current + transient
+            snap = dict(by_dtype)
+            for dt, b in extra_bd.items():
+                snap[dt] = snap.get(dt, 0) + b
         for v in tuple(eqn.invars) + tuple(eqn.outvars):
             if isinstance(v, jax.core.Literal):
                 continue
             if last_use.get(v, -1) <= i and v in live:
-                current -= live.pop(v)
-    return peak
+                current -= _drop(v)
+    return peak, snap
+
+
+def _peak_live_bytes(jaxpr, var_bytes=_var_bytes) -> int:
+    """Peak-only view of :func:`_peak_live_by_dtype` (same walk)."""
+    return _peak_live_by_dtype(jaxpr, var_bytes)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -464,6 +545,10 @@ class ProgramReport:
     donated: Tuple[bool, ...]
     hazards: List[Diagnostic]
     hbm_budget_bytes: Optional[int] = None
+    # dtype -> bytes held when the liveness walk hits its peak; sums to
+    # peak_hbm_bytes (groundwork for int8/fp8 KV accounting)
+    peak_hbm_by_dtype: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def arithmetic_intensity(self) -> float:
@@ -584,7 +669,7 @@ def analyze_jaxpr(closed, *, donated: Sequence[bool] = (),
     _scan_f64(jaxpr, diags, where)
     donated = tuple(donated) or (False,) * len(jaxpr.invars)
     _scan_donation(jaxpr, donated, min_donation_bytes, diags, where)
-    peak = _peak_live_bytes(jaxpr)
+    peak, peak_by_dtype = _peak_live_by_dtype(jaxpr)
     budget = hbm_budget_bytes
     if budget is not None and peak > budget:
         diags.append(Diagnostic(
@@ -601,7 +686,7 @@ def analyze_jaxpr(closed, *, donated: Sequence[bool] = (),
         bytes=sum(o.bytes for o in ops),
         peak_hbm_bytes=peak, ops=ops, n_eqns=_count_eqns(jaxpr),
         donated=donated, hazards=sort_diagnostics(diags),
-        hbm_budget_bytes=budget)
+        hbm_budget_bytes=budget, peak_hbm_by_dtype=peak_by_dtype)
 
 
 def analyze_train_step(step_fn, inputs, labels, *,
@@ -731,6 +816,10 @@ def export_report_gauges(report: ProgramReport):
     reg.gauge("xray_peak_hbm_bytes",
               "liveness-walk peak live HBM of a traced step").set(
         report.peak_hbm_bytes, step=report.name)
+    g = reg.gauge("xray_peak_hbm_bytes_by_dtype",
+                  "bytes of one dtype held at the liveness-walk peak")
+    for dt, b in sorted(report.peak_hbm_by_dtype.items()):
+        g.set(b, step=report.name, dtype=dt)
 
 
 def _serving_abstract_args(model, *, batch, num_blocks, block_size,
@@ -757,10 +846,10 @@ def _serving_abstract_args(model, *, batch, num_blocks, block_size,
 def audit_default_steps(*, chip: str = "cpu",
                         hbm_budget_bytes: Optional[int] = None
                         ) -> List[ProgramReport]:
-    """Build a tiny Llama + hapi model and X-ray all three default step
-    kinds (train, paged decode, chunked prefill) on the CPU (1,1)
-    config — the ``lint_tpu.py --xray`` / CI entry point.  Returns the
-    three reports; callers gate on ``report.errors()``."""
+    """Build tiny Llama models and X-ray all five default step kinds
+    (train, paged decode, chunked prefill, MoE block, ring/sp block) —
+    the ``lint_tpu.py --xray`` / CI entry point.  Returns the reports;
+    callers gate on ``report.errors()``."""
     import paddle_tpu as paddle
     from .. import nn
     from ..models import LlamaConfig, LlamaForCausalLM
@@ -794,6 +883,27 @@ def audit_default_steps(*, chip: str = "cpu",
     reports.append(analyze(
         make_chunked_prefill_step(net), prefill_args,
         name="serving::prefill_step", chip=chip,
+        hbm_budget_bytes=hbm_budget_bytes))
+
+    from ..distributed.mesh import abstract_mesh
+    from ..models.generation import make_moe_block_step, make_ring_sp_step
+
+    sds = jax.ShapeDtypeStruct
+    moe_net = LlamaForCausalLM(LlamaConfig.tiny(
+        moe_num_experts=4, moe_top_k=2, moe_capacity_factor=2.0))
+    moe_net.eval()
+    reports.append(analyze(
+        make_moe_block_step(moe_net), (sds((4, 16), np.int32),),
+        name="moe::block_step", chip=chip,
+        hbm_budget_bytes=hbm_budget_bytes))
+
+    ring_net = LlamaForCausalLM(LlamaConfig.tiny(context_parallel="ring"))
+    ring_net.eval()
+    ring_mesh = abstract_mesh({"data": 2, "sp": 2, "tp": 2})
+    reports.append(analyze(
+        make_ring_sp_step(ring_net, mesh=ring_mesh),
+        (sds((4, 32), np.int32),),
+        name="ring::sp_step", chip=chip,
         hbm_budget_bytes=hbm_budget_bytes))
     for r in reports:
         export_report_gauges(r)

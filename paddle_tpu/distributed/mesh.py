@@ -9,6 +9,7 @@ are views over mesh axes.
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import Dict, List, Optional, Sequence
 
@@ -68,6 +69,36 @@ def set_mesh(mesh: Mesh):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh
     return mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    """Temporarily install ``mesh`` as the global mesh (restored on
+    exit).  Lets a step trace against a specific — possibly abstract —
+    mesh without clobbering the process-global one."""
+    global _GLOBAL_MESH
+    prev = _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _GLOBAL_MESH = prev
+
+
+def abstract_mesh(axes: Dict[str, int]):
+    """A devices-free ``jax.sharding.AbstractMesh`` over named axes, e.g.
+    ``abstract_mesh({"data": 2, "sp": 2})``.  Good enough for tracing
+    (shard_map, with_sharding_constraint) under ``make_jaxpr`` — which is
+    all the static analyzers need — without claiming real chips."""
+    from jax.sharding import AbstractMesh
+
+    pairs = tuple((str(k), int(v)) for k, v in axes.items())
+    try:
+        return AbstractMesh(pairs)
+    except TypeError:
+        # newer signature: AbstractMesh(shape_tuple, axis_names)
+        return AbstractMesh(tuple(s for _, s in pairs),
+                            tuple(n for n, _ in pairs))
 
 
 def fleet_mesh(dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,

@@ -8,6 +8,7 @@ device_put (eager) or with_sharding_constraint (inside a trace).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -36,11 +37,20 @@ _LLAMA_ROLE_PATTERNS = (
     ("norm", ("layernorm.weight", "norm.weight")),
 )
 
+#: MoE roles live in their own table: only models that opt into experts
+#: carry these parameters, and the default dense ``role_layout()`` must
+#: stay clean on an expert-less mesh (S201 checks every listed role).
+_MOE_ROLE_PATTERNS = (
+    ("moe_router", ("router.weight",)),
+    ("moe_expert_in", ("w_gate", "w_up")),
+    ("moe_expert_out", ("w_down",)),
+)
+
 
 def llama_param_role(name: str) -> Optional[str]:
     """Map a qualified llama parameter name (``named_parameters`` key) to
     its layout role, or None for a name no pattern covers."""
-    for role, pats in _LLAMA_ROLE_PATTERNS:
+    for role, pats in _LLAMA_ROLE_PATTERNS + _MOE_ROLE_PATTERNS:
         if any(name.endswith(p) for p in pats):
             return role
     return None
@@ -73,12 +83,21 @@ class SpecLayout:
     fsdp_axis: str = "fsdp"
     tp_axis: str = "tp"
     batch_axis: Optional[str] = "data"
+    #: MoE expert weights ([E, ...]) shard their leading dim here
+    expert_axis: str = "expert"
+    #: sequence-parallel activations split their sequence dim here
+    sp_axis: str = "sp"
 
     def batch_spec(self) -> PartitionSpec:
         """Spec for activation batch dims (inputs, labels, KV pools)."""
         if self.batch_axis is None:
             return PartitionSpec()
         return PartitionSpec(self.batch_axis)
+
+    def sequence_spec(self) -> PartitionSpec:
+        """Spec for [batch, seq, ...] activations on a sequence-parallel
+        mesh: batch on ``batch_axis``, sequence on ``sp``."""
+        return PartitionSpec(self.batch_axis, self.sp_axis)
 
     def spec_for_role(self, role: str) -> PartitionSpec:
         table = {
@@ -89,6 +108,14 @@ class SpecLayout:
             "mlp_in": PartitionSpec(self.fsdp_axis, self.tp_axis),
             "mlp_out": PartitionSpec(self.tp_axis, self.fsdp_axis),
             "norm": PartitionSpec(),
+            # MoE: router is a few KiB → replicated; stacked expert
+            # weights [E, in, out] put experts on the expert axis and
+            # keep the Megatron column/row split on the feature dims
+            "moe_router": PartitionSpec(),
+            "moe_expert_in": PartitionSpec(
+                self.expert_axis, self.fsdp_axis, self.tp_axis),
+            "moe_expert_out": PartitionSpec(
+                self.expert_axis, self.tp_axis, self.fsdp_axis),
         }
         if role not in table:
             raise KeyError(f"unknown param role {role!r}; known roles: "
@@ -103,10 +130,12 @@ class SpecLayout:
             return PartitionSpec()
         return self.spec_for_role(role)
 
-    def role_layout(self) -> Dict[str, PartitionSpec]:
-        """``{role: spec}`` — the shape check_sharding_readiness wants."""
-        return {role: self.spec_for_role(role)
-                for role, _ in _LLAMA_ROLE_PATTERNS}
+    def role_layout(self, moe: bool = False) -> Dict[str, PartitionSpec]:
+        """``{role: spec}`` — the shape check_sharding_readiness wants.
+        ``moe=True`` adds the expert roles (needs an ``expert`` mesh
+        axis; the dense default stays clean on a data/fsdp/tp mesh)."""
+        roles = _LLAMA_ROLE_PATTERNS + (_MOE_ROLE_PATTERNS if moe else ())
+        return {role: self.spec_for_role(role) for role, _ in roles}
 
 
 def llama_param_specs(model) -> Dict[str, PartitionSpec]:
@@ -152,6 +181,27 @@ def _spec_axes_known(spec: PartitionSpec, mesh: Mesh) -> bool:
     return all(a in mesh.shape for a in needed)
 
 
+#: (dangling axes, mesh axes) pairs already warned about — the no-op
+#: fallback below fires once per distinct mismatch, not per tensor
+_warned_dangling: set = set()
+
+
+def _warn_dangling_axes(spec: PartitionSpec, mesh: Mesh) -> None:
+    missing = tuple(sorted({a for a in jax.tree_util.tree_leaves(tuple(spec))
+                            if a and a not in mesh.shape}))
+    mesh_axes = tuple(mesh.shape)
+    key = (missing, mesh_axes)
+    if not missing or key in _warned_dangling:
+        return
+    _warned_dangling.add(key)
+    warnings.warn(
+        f"sharding spec {spec} names mesh axes {list(missing)} unknown on "
+        f"the active mesh (axes {list(mesh_axes)}); the annotation is a "
+        "no-op. Build the mesh with those axes (e.g. init_mesh) or drop "
+        "them from the spec.",
+        RuntimeWarning, stacklevel=3)
+
+
 def shard_tensor(x: Tensor, mesh: Optional[Mesh] = None, placements=None,
                  dist_attr=None) -> Tensor:
     """Annotate a tensor with a mesh sharding.
@@ -166,7 +216,8 @@ def shard_tensor(x: Tensor, mesh: Optional[Mesh] = None, placements=None,
     if not _spec_axes_known(spec, mesh):
         # a fallback mesh (executor/global) may lack this annotation's
         # axes (e.g. 'sp' on a (data, fsdp, tp) mesh) — keep the old
-        # no-op contract rather than erroring mid-model
+        # no-op contract rather than erroring mid-model, but say so once
+        _warn_dangling_axes(spec, mesh)
         return x
     sharding = NamedSharding(mesh, spec)
     if in_static_trace() or _is_tracer(x._value):
@@ -197,7 +248,10 @@ def mark_sharding(param: Tensor, placements, mesh=None) -> Tensor:
     spec = _pspec(placements)
     param._sharding_spec = spec
     mesh = _context_mesh(mesh, spec)
-    if mesh is None or not _spec_axes_known(spec, mesh):
+    if mesh is None:
+        return param
+    if not _spec_axes_known(spec, mesh):
+        _warn_dangling_axes(spec, mesh)
         return param
     sharding = NamedSharding(mesh, spec)
     if _is_tracer(param._value):

@@ -32,6 +32,24 @@ DEFAULT_BT = 256
 DEFAULT_BC = 128
 
 
+def moe_capacity(tokens: int, experts: int, top_k: int,
+                 capacity_factor: float = 1.0) -> int:
+    """GShard expert capacity: ceil(capacity_factor * T * K / E), the C
+    in the padded [E, C, M] dispatch buffer."""
+    return max(1, -(-int(tokens * top_k * capacity_factor) // experts))
+
+
+def _resolve_interpret(interpret):
+    """None → real kernel on TPU, XLA one-hot einsum fallback elsewhere
+    (keeps CPU traces analyzable: the static analyzers and tier-1 see
+    plain einsums instead of an opaque interpreted pallas_call).
+    Explicit True still forces pallas interpret mode (kernel-logic
+    parity testing); explicit False demands the real kernel."""
+    if interpret is None:
+        return False if jax.default_backend() == "tpu" else "xla"
+    return interpret
+
+
 def _dispatch_kernel(tok_ref, eidx_ref, sidx_ref, w_ref, o_ref, acc_ref, *,
                      expert_block_c0, K, bc):
     e = pl.program_id(0)
@@ -91,6 +109,8 @@ def _combine_kernel(eo_ref, eidx_ref, sidx_ref, w_ref, o_ref, acc_ref, *,
 
 
 def _dispatch_raw(tokens, eidx, sidx, weights, E, C, bt, bc, interpret):
+    if interpret == "xla":
+        return _dispatch_xla(tokens, eidx, sidx, weights, E, C)
     T, M = tokens.shape
     K = eidx.shape[1]
     bt_ = min(bt, T)
@@ -118,6 +138,8 @@ def _dispatch_raw(tokens, eidx, sidx, weights, E, C, bt, bc, interpret):
 
 
 def _combine_raw(expert_out, eidx, sidx, weights, bt, bj, interpret):
+    if interpret == "xla":
+        return _combine_xla(expert_out, eidx, sidx, weights)
     E, C, M = expert_out.shape
     T, K = eidx.shape
     bt_ = min(bt, T)
@@ -165,15 +187,13 @@ def moe_dispatch(tokens, eidx, sidx, weights, E, C, bt=DEFAULT_BT,
     eidx/sidx: [T, K] int32 expert id and capacity slot per choice (use
     slot >= C to drop a choice); weights: [T, K] scale per choice (1.0 for
     plain dispatch)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
     return _dispatch_raw(tokens, eidx, sidx, weights, E, C, bt, bc,
                          interpret)
 
 
 def _moe_dispatch_fwd(tokens, eidx, sidx, weights, E, C, bt, bc, interpret):
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
     out = _dispatch_raw(tokens, eidx, sidx, weights, E, C, bt, bc,
                         interpret)
     return out, (tokens, eidx, sidx, weights)
@@ -181,8 +201,7 @@ def _moe_dispatch_fwd(tokens, eidx, sidx, weights, E, C, bt, bc, interpret):
 
 def _moe_dispatch_bwd(E, C, bt, bc, interpret, res, g):
     tokens, eidx, sidx, weights = res
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
     # d tokens[t] = sum_k w[t,k] * g[e_k, s_k] — a combine of g
     safe_s = jnp.minimum(sidx, C - 1)
     valid = (sidx < C).astype(weights.dtype)
@@ -203,8 +222,7 @@ def moe_combine(expert_out, eidx, sidx, weights, bt=DEFAULT_BT,
                 bj=DEFAULT_BC, interpret=None):
     """Gather expert outputs back per token: out[t] = sum_k w[t,k] *
     expert_out[e_k, s_k].  Dropped choices (slot >= C) contribute 0."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
     C = expert_out.shape[1]
     safe_s = jnp.minimum(sidx, C - 1)
     valid = (sidx < C).astype(weights.dtype)
@@ -219,8 +237,7 @@ def _moe_combine_fwd(expert_out, eidx, sidx, weights, bt, bj, interpret):
 
 def _moe_combine_bwd(bt, bj, interpret, res, g):
     expert_out, eidx, sidx, weights = res
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
     E, C, M = expert_out.shape
     safe_s = jnp.minimum(sidx, C - 1)
     valid = (sidx < C).astype(weights.dtype)

@@ -55,9 +55,12 @@ def _block_attn_update(q, k, v, acc, m, l, q_offset, kv_offset, scale, causal):
     return acc_new, m_new, l_new
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
-    """Runs on each sp shard inside shard_map.  q/k/v: [B, T_local, H, D]."""
-    n = jax.lax.axis_size(axis_name)
+def _ring_attention_local(q, k, v, axis_name, causal, scale, ring_size=None):
+    """Runs on each sp shard inside shard_map.  q/k/v: [B, T_local, H, D].
+
+    ``ring_size`` is the static sp degree (the fori_loop trip count must
+    be concrete; jax.lax.axis_size does not exist on older jax)."""
+    n = ring_size if ring_size is not None else jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, T, H, D = q.shape
     if scale is None:
@@ -104,6 +107,7 @@ def ring_attention(q, k, v, mesh: Mesh = None, axis_name: str = "sp",
     spec = P(batch_axis, axis_name, None, None)
     fn = shard_map_compat(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          ring_size=int(mesh.shape[axis_name])),
         mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

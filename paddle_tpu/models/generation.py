@@ -397,6 +397,68 @@ def make_chunked_prefill_step(model):
     return step
 
 
+def make_moe_block_step(model):
+    """Full-sequence forward of a mixture-of-experts model
+    (LlamaConfig.moe_num_experts > 0) — the traced workload behind the
+    MoE static-analysis audits.  step(ids[B, T] int32) -> logits
+    [B, T, V] f32.  Off-TPU the dispatch/combine kernels resolve to
+    their XLA one-hot einsum fallback, so this exact program is what
+    CPU tier-1 checks for parity and the analyzers price."""
+    step = getattr(model, "_moe_block_step", None)
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_moe_block_step_fp", None)):
+        return step
+    fp = _weights_fingerprint(model)
+
+    from ..core.dispatch import no_grad_ctx
+
+    @jax.jit
+    @functools.partial(register_decode_step, kind="moe_block")
+    def step(ids):
+        with no_grad_ctx():
+            logits = model(Tensor(ids))
+            return logits._value.astype(jnp.float32)
+
+    model._moe_block_step = step
+    model._moe_block_step_fp = fp
+    return step
+
+
+def make_ring_sp_step(model, mesh=None):
+    """Full-sequence forward through the sequence-parallel attention
+    path (LlamaConfig.context_parallel = "ring"/"ulysses").  ``mesh``
+    (real or abstract) is installed around the traced body via
+    distributed.mesh.use_mesh so trace-time mesh resolution sees the
+    ``sp`` axis; None keeps whatever mesh is globally active — no `sp`
+    axis means the dense fallback, which IS the CPU parity path.
+    step(ids[B, T] int32) -> logits[B, T, V] f32."""
+    step = getattr(model, "_ring_sp_step", None)
+    if step is not None and _fingerprint_matches(
+            model, getattr(model, "_ring_sp_step_fp", None)) \
+            and getattr(model, "_ring_sp_step_mesh", None) is mesh:
+        return step
+    fp = _weights_fingerprint(model)
+
+    import contextlib
+
+    from ..core.dispatch import no_grad_ctx
+    from ..distributed.mesh import use_mesh
+
+    @jax.jit
+    @functools.partial(register_decode_step, kind="ring_sp")
+    def step(ids):
+        ctx = (use_mesh(mesh) if mesh is not None
+               else contextlib.nullcontext())
+        with no_grad_ctx(), ctx:
+            logits = model(Tensor(ids))
+            return logits._value.astype(jnp.float32)
+
+    model._ring_sp_step = step
+    model._ring_sp_step_fp = fp
+    model._ring_sp_step_mesh = mesh
+    return step
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
              eos_token_id=None, seed=None, use_static_cache=False,

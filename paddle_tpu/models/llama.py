@@ -56,6 +56,16 @@ class LlamaConfig:
     # fleet/utils/recompute.py) — XLA recomputes the layer in backward,
     # cutting live activations to ~one layer's worth.
     recompute: bool = False
+    # Mixture-of-experts MLP (GShard-style top-k routing through
+    # kernels/moe_dispatch; reference analog: incubate moe_layer over
+    # global_scatter/global_gather).  0 experts = dense LlamaMLP.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    # Sequence/context parallelism for the no-cache attention path:
+    # "" (dense), "ring" (kernels/ring_attention) or "ulysses".  Falls
+    # back to dense attention when the active mesh has no `sp` axis.
+    context_parallel: str = ""
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -371,6 +381,33 @@ class LlamaAttention(nn.Layer):
         # and single-token decode (row 0 sees all past keys).
         causal = True
 
+        cp = getattr(self.config, "context_parallel", "")
+        if cp and cache is None:
+            # sequence-parallel full-sequence attention: ring rotates KV
+            # shards over the `sp` axis, Ulysses re-shards heads with
+            # all-to-alls.  Both resolve the active mesh themselves and
+            # fall back to dense attention when there is no `sp` axis —
+            # that fallback IS the CPU parity path.
+            def _cp_attn(qv, kv, vv):
+                from ..distributed.mesh import get_mesh
+
+                m = get_mesh()
+                baxis = "data" if (m is not None
+                                   and "data" in m.shape) else None
+                if cp == "ulysses":
+                    from ..kernels.ulysses_attention import ulysses_attention
+
+                    return ulysses_attention(qv, kv, vv, causal=causal,
+                                             batch_axis=baxis)
+                from ..kernels.ring_attention import ring_attention
+
+                return ring_attention(qv, kv, vv, causal=causal,
+                                      batch_axis=baxis)
+
+            out = apply("context_parallel_attention", _cp_attn, q, k, v)
+            out = out.reshape([B, T, -1])
+            return self.o_proj(out)
+
         def _attn(qv, kv, vv):
             from ..core.flags import flag
             from ..kernels.flash_attention import (_attn_reference,
@@ -414,6 +451,78 @@ class LlamaMLP(nn.Layer):
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
+class LlamaMoEMLP(nn.Layer):
+    """Top-k routed mixture-of-experts MLP (GShard capacity-padded
+    dispatch through kernels/moe_dispatch).
+
+    Stacked expert weights: w_gate/w_up [E, h, m], w_down [E, m, h] —
+    the leading expert dim shards on the canonical `expert` mesh axis
+    (distributed.sharding moe_* roles); the router is a few KiB and
+    stays replicated.  Routing: softmax over router logits, lax.top_k,
+    then a running-count capacity-slot assignment; choices past the
+    expert's capacity C = ceil(cf*T*K/E) get slot >= C and are dropped
+    by dispatch/combine (the GShard contract).
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        from ..nn import initializer as I
+
+        h, m = config.hidden_size, config.intermediate_size
+        E = config.moe_num_experts
+        self.num_experts = E
+        self.top_k = config.moe_top_k
+        self.capacity_factor = config.moe_capacity_factor
+        self.router = nn.Linear(h, E, bias_attr=False)
+        std = 1.0 / math.sqrt(h)
+        init = I.Normal(std=std)
+        self.w_gate = self.create_parameter([E, h, m],
+                                            default_initializer=init)
+        self.w_up = self.create_parameter([E, h, m],
+                                          default_initializer=init)
+        self.w_down = self.create_parameter(
+            [E, m, h], default_initializer=I.Normal(std=1.0 / math.sqrt(m)))
+
+    def forward(self, x):
+        from ..kernels.moe_dispatch import (moe_capacity, moe_combine,
+                                            moe_dispatch)
+
+        E, K, cf = self.num_experts, self.top_k, self.capacity_factor
+        logits = self.router(x)  # [B, T, E]
+
+        def _moe(xv, lg, wg, wu, wd):
+            B, T, H = xv.shape
+            n_tok = B * T
+            C = moe_capacity(n_tok, E, K, cf)
+            tokens = xv.reshape(n_tok, H)
+            probs = jax.nn.softmax(
+                lg.reshape(n_tok, E).astype(jnp.float32), axis=-1)
+            gate, eidx = jax.lax.top_k(probs, K)       # [n_tok, K]
+            gate = (gate / jnp.maximum(gate.sum(-1, keepdims=True),
+                                       1e-9)).astype(xv.dtype)
+            eidx = eidx.astype(jnp.int32)
+            # capacity slot per routed choice: running count of earlier
+            # choices bound to the same expert (t-major, k-minor
+            # priority); overflow (slot >= C) is dropped downstream
+            flat_e = eidx.reshape(-1)
+            oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+            pos = jnp.cumsum(oh, axis=0) - oh
+            sidx = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+            sidx = sidx.reshape(n_tok, K).astype(jnp.int32)
+            disp = moe_dispatch(tokens, eidx, sidx, jnp.ones_like(gate),
+                                E, C)                  # [E, C, H]
+            g = jnp.einsum("ech,ehm->ecm", disp, wg.astype(disp.dtype))
+            u = jnp.einsum("ech,ehm->ecm", disp, wu.astype(disp.dtype))
+            act = (jax.nn.silu(g.astype(jnp.float32)).astype(disp.dtype)
+                   * u)
+            eo = jnp.einsum("ecm,emh->ech", act, wd.astype(disp.dtype))
+            out = moe_combine(eo, eidx, sidx, gate)    # [n_tok, H]
+            return out.reshape(B, T, H)
+
+        return apply("moe_mlp", _moe, x, logits, self.w_gate, self.w_up,
+                     self.w_down)
+
+
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -422,7 +531,9 @@ class LlamaDecoderLayer(nn.Layer):
         self.self_attn = LlamaAttention(config)
         self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
                                                      config.rms_norm_eps)
-        self.mlp = LlamaMLP(config)
+        self.mlp = (LlamaMoEMLP(config)
+                    if getattr(config, "moe_num_experts", 0) > 0
+                    else LlamaMLP(config))
 
     def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
                 position_offset=0):

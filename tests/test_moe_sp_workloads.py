@@ -1,0 +1,368 @@
+"""MoE + sequence-parallel workloads land fully analyzed.
+
+CPU parity for the dormant kernels first (moe_dispatch/moe_combine
+round-trip vs the dense one-hot einsum reference, ring_attention vs
+dense attention on a (1,1) mesh), then the model-level steps (MoE
+block and ring/sp block: traced step == eager forward, zero
+retraces), then the analyzer contracts: S210 (unpriced collective),
+S211 (static expert capacity overflow), S212 (ICI-bound ring hop),
+the ppermute golden pricing through shard_map + fori_loop, the
+dtype-aware per-chip HBM breakdown, the dangling-axes one-shot
+warning, and the `lint_tpu.py --shardplan --steps` CLI gate.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis.shardplan import (DEFAULT_AUDIT_STEPS, MoEStatics,
+                                           audit_shardplan, plan_jaxpr)
+from paddle_tpu.analysis.xray import CHIPS, ChipProfile
+from paddle_tpu.kernels.moe_dispatch import (_combine_xla, _dispatch_xla,
+                                             moe_capacity, moe_combine,
+                                             moe_dispatch)
+from paddle_tpu.kernels.ring_attention import ring_attention
+from paddle_tpu.kernels.ulysses_attention import _plain_attention
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import (make_moe_block_step,
+                                          make_ring_sp_step)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(diags):
+    return [d.code for d in diags]
+
+
+@pytest.fixture(scope="module")
+def ring_rep():
+    (rep,) = audit_shardplan(steps=("ring",))
+    return rep
+
+
+@pytest.fixture(scope="module")
+def moe_rep():
+    (rep,) = audit_shardplan(steps=("moe",))
+    return rep
+
+
+def _routing(rng, T, E, K, C):
+    """eidx/sidx/weights the way LlamaMoEMLP assigns slots: running
+    per-expert count in (t-major, k-minor) order; slot >= C drops."""
+    gates = rng.random((T, K)).astype(np.float32)
+    eidx = np.stack([rng.permutation(E)[:K] for _ in range(T)]).astype(
+        np.int32)
+    counts = np.zeros(E, np.int64)
+    sidx = np.zeros((T, K), np.int32)
+    for t in range(T):
+        for k in range(K):
+            e = eidx[t, k]
+            sidx[t, k] = counts[e]
+            counts[e] += 1
+    return eidx, sidx, gates
+
+
+# ---------------------------------------------------------------------------
+# kernel CPU parity: the dormant pallas kernels vs the XLA reference
+# ---------------------------------------------------------------------------
+
+class TestMoEKernelParity:
+    def test_dispatch_interpret_matches_xla_reference(self):
+        rng = np.random.default_rng(0)
+        T, M, E, K = 32, 16, 4, 2
+        C = moe_capacity(T, E, K, 1.25)
+        tokens = rng.standard_normal((T, M)).astype(np.float32)
+        eidx, sidx, w = _routing(rng, T, E, K, C)
+        ref = _dispatch_xla(jnp.asarray(tokens), jnp.asarray(eidx),
+                            jnp.asarray(sidx), jnp.asarray(w), E, C)
+        out = moe_dispatch(jnp.asarray(tokens), jnp.asarray(eidx),
+                           jnp.asarray(sidx), jnp.asarray(w), E, C,
+                           interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_combine_interpret_matches_xla_reference(self):
+        rng = np.random.default_rng(1)
+        T, M, E, K = 16, 8, 4, 2
+        C = moe_capacity(T, E, K, 1.5)
+        eo = rng.standard_normal((E, C, M)).astype(np.float32)
+        eidx, sidx, w = _routing(rng, T, E, K, C)
+        assert (sidx < C).all()  # in-capacity: the XLA gather is exact
+        ref = _combine_xla(jnp.asarray(eo), jnp.asarray(eidx),
+                           jnp.asarray(sidx), jnp.asarray(w))
+        out = moe_combine(jnp.asarray(eo), jnp.asarray(eidx),
+                          jnp.asarray(sidx), jnp.asarray(w),
+                          interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_dispatch_combine_roundtrip_is_gated_identity(self):
+        """combine(dispatch(x, ones), gates) == x * gates.sum(k) while
+        every slot is in capacity — the GShard contract the MoE layer
+        builds on."""
+        rng = np.random.default_rng(2)
+        T, M, E, K = 24, 8, 4, 2
+        C = moe_capacity(T, E, K, 2.0)
+        tokens = rng.standard_normal((T, M)).astype(np.float32)
+        eidx, sidx, gates = _routing(rng, T, E, K, C)
+        assert (sidx < C).all()
+        disp = moe_dispatch(jnp.asarray(tokens), jnp.asarray(eidx),
+                            jnp.asarray(sidx),
+                            jnp.ones((T, K), jnp.float32), E, C)
+        back = moe_combine(disp, jnp.asarray(eidx), jnp.asarray(sidx),
+                           jnp.asarray(gates))
+        expect = tokens * gates.sum(1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(back), expect, atol=1e-5)
+
+    def test_dropped_slot_contributes_zero(self):
+        E, C, M = 2, 2, 4
+        eo = jnp.ones((E, C, M), jnp.float32)
+        eidx = jnp.array([[0, 1]], jnp.int32)
+        sidx = jnp.array([[0, C]], jnp.int32)  # second choice overflows
+        w = jnp.array([[1.0, 1.0]], jnp.float32)
+        out = moe_combine(eo, eidx, sidx, w)
+        np.testing.assert_allclose(np.asarray(out), np.ones((1, M)))
+
+
+class TestRingAttentionParity:
+    def test_ring_matches_dense_on_1x1_mesh(self):
+        rng = np.random.default_rng(3)
+        B, T, H, D = 2, 16, 4, 8
+        q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+        k = rng.standard_normal((B, T, H, D)).astype(np.float32)
+        v = rng.standard_normal((B, T, H, D)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "sp"))
+        out = ring_attention(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), mesh=mesh, causal=True)
+        ref = _plain_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), True, 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_ring_matches_dense_with_gqa_kv(self):
+        rng = np.random.default_rng(4)
+        B, T, H, Hkv, D = 1, 8, 4, 2, 8
+        q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+        k = rng.standard_normal((B, T, Hkv, D)).astype(np.float32)
+        v = rng.standard_normal((B, T, Hkv, D)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "sp"))
+        out = ring_attention(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), mesh=mesh, causal=True)
+        ref = _plain_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), True, 1.0 / np.sqrt(D))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# model-level steps: traced step == eager forward, zero retraces
+# ---------------------------------------------------------------------------
+
+class TestMoEModelStep:
+    @pytest.fixture(scope="class")
+    def net(self):
+        paddle.seed(7)
+        net = LlamaForCausalLM(LlamaConfig.tiny(
+            moe_num_experts=4, moe_top_k=2, moe_capacity_factor=2.0))
+        net.eval()
+        return net
+
+    def test_step_matches_eager_forward(self, net):
+        step = make_moe_block_step(net)
+        ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % 16
+        traced = np.asarray(step(ids))
+        with paddle.no_grad():
+            eager = np.asarray(net(paddle.to_tensor(ids))._value)
+        assert np.isfinite(traced).all()
+        np.testing.assert_allclose(traced, eager.astype(np.float32),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_zero_retraces_across_calls(self, net):
+        step = make_moe_block_step(net)
+        ids = np.zeros((2, 8), np.int32)
+        step(ids)
+        step(ids + 1)
+        assert step._cache_size() == 1
+
+
+class TestRingModelStep:
+    def test_step_matches_eager_and_never_retraces(self):
+        paddle.seed(8)
+        net = LlamaForCausalLM(LlamaConfig.tiny(context_parallel="ring"))
+        net.eval()
+        step = make_ring_sp_step(net)  # no sp axis: dense fallback path
+        ids = np.arange(2 * 8, dtype=np.int32).reshape(2, 8) % 16
+        traced = np.asarray(step(ids))
+        with paddle.no_grad():
+            eager = np.asarray(net(paddle.to_tensor(ids))._value)
+        np.testing.assert_allclose(traced, eager.astype(np.float32),
+                                   atol=1e-4, rtol=1e-4)
+        step(ids)
+        assert step._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# analyzer contracts: S210 / S211 / S212 + golden pricing
+# ---------------------------------------------------------------------------
+
+class TestS210UnpricedCollective:
+    def test_pmin_inside_shard_map_is_an_error(self):
+        from paddle_tpu.distributed.mesh import (abstract_mesh,
+                                                 shard_map_compat)
+
+        mesh = abstract_mesh({"sp": 2})
+        fn = shard_map_compat(lambda x: jax.lax.pmin(x, "sp"), mesh,
+                              (P("sp"),), P(None))
+        closed = jax.make_jaxpr(fn)(jnp.zeros(8, jnp.float32))
+        rep = plan_jaxpr(closed, [P("sp")], mesh={"sp": 2},
+                         name="s210-probe")
+        assert "S210" in _codes(rep.errors())
+        (d,) = [d for d in rep.diagnostics if d.code == "S210"]
+        assert "pmin" in d.message
+
+    def test_priced_collectives_do_not_trip_s210(self, ring_rep):
+        assert "S210" not in _codes(ring_rep.diagnostics)
+
+
+class TestS211CapacityOverflow:
+    def test_overflowing_capacity_factor_is_an_error(self):
+        closed = jax.make_jaxpr(lambda x: x + 1.0)(jnp.zeros(4))
+        moe = MoEStatics(experts=4, capacity=2, top_k=2, tokens=64,
+                         capacity_factor=0.25)
+        rep = plan_jaxpr(closed, [P()], mesh={"expert": 2},
+                         name="s211-probe", moe=moe)
+        assert "S211" in _codes(rep.errors())
+        (d,) = [d for d in rep.diagnostics if d.code == "S211"]
+        assert "128" in d.message and "8" in d.message  # demand vs supply
+
+    def test_audited_capacity_factor_has_headroom(self, moe_rep):
+        assert "S211" not in _codes(moe_rep.diagnostics)
+
+
+class TestS212RingBoundByICI:
+    def test_slow_ici_makes_the_ring_hop_unhideable(self):
+        CHIPS["_s212_probe"] = ChipProfile(
+            name="_s212_probe", peak_flops=1e15, hbm_bandwidth=1e12,
+            hbm_bytes=8 << 30, ici_bandwidth=1e3)
+        try:
+            (rep,) = audit_shardplan(chip="_s212_probe", steps=("ring",))
+        finally:
+            del CHIPS["_s212_probe"]
+        s212 = [d for d in rep.diagnostics if d.code == "S212"]
+        assert s212 and all(d.severity == "warning" for d in s212)
+
+    def test_normal_ici_hides_the_hop(self, ring_rep):
+        assert "S212" not in _codes(ring_rep.diagnostics)
+
+
+class TestRingPlanGolden:
+    """Tiny llama, (data=2,sp=2,tp=2), B=4 T=32 Hkv=2 D=16: the local
+    KV shard is [4, 16, 2, 16] f32 = 8 KiB, each ring edge carries half
+    of it per hop (payload 4096 B), 2 ppermutes (K and V) per layer x 2
+    layers, ring length 2 folded into count."""
+
+    @pytest.fixture()
+    def rep(self, ring_rep):
+        return ring_rep
+
+    def test_ppermute_count_and_payload(self, rep):
+        pp = [c for c in rep.collectives if c.kind == "ppermute"]
+        assert len(pp) == 4
+        for c in pp:
+            assert c.axes == ("sp",)
+            assert c.payload_bytes == 4096
+            assert c.count == 2.0  # x ring length inside the fori_loop
+            assert c.planned
+
+    def test_every_ring_collective_is_planned(self, rep):
+        assert all(c.planned for c in rep.collectives)
+        assert rep.errors() == []
+
+
+class TestMoEPlanGolden:
+    """E=4 C=32 M=64 f32: the capacity-padded [E, C, M] buffer is
+    32 KiB; both halves of the expert exchange (dispatch einsum and
+    combine gather) must be priced as all_to_all('expert')."""
+
+    @pytest.fixture()
+    def rep(self, moe_rep):
+        return moe_rep
+
+    def test_dispatch_and_combine_a2a_per_layer(self, rep):
+        a2a = [c for c in rep.collectives if c.kind == "all_to_all"]
+        assert sorted(c.primitive for c in a2a) == [
+            "dot_general(moe_dispatch)", "dot_general(moe_dispatch)",
+            "gather(moe_combine)", "gather(moe_combine)"]
+        for c in a2a:
+            assert c.axes == ("expert",)
+            assert c.planned
+        disp = [c for c in a2a
+                if c.primitive == "dot_general(moe_dispatch)"]
+        assert all(c.payload_bytes == 4 * 32 * 64 * 4 for c in disp)
+
+    def test_moe_plan_is_clean(self, rep):
+        assert all(c.planned for c in rep.collectives)
+        assert rep.errors() == []
+
+
+class TestDtypeAwareHBM:
+    def test_breakdown_sums_to_the_peak(self, moe_rep, ring_rep):
+        for rep in (moe_rep, ring_rep):
+            by = rep.per_chip_peak_hbm_by_dtype
+            assert "float32" in by and len(by) >= 2
+            assert sum(by.values()) == rep.per_chip_peak_hbm_bytes
+
+
+# ---------------------------------------------------------------------------
+# dangling-axes one-shot warning (distributed.sharding satellite)
+# ---------------------------------------------------------------------------
+
+class TestDanglingAxesWarning:
+    def test_unknown_axis_warns_once(self):
+        from paddle_tpu.distributed import sharding
+        from paddle_tpu.distributed.mesh import init_mesh, reset_mesh
+
+        sharding._warned_dangling.clear()
+        init_mesh({"data": 1}, devices=jax.devices()[:1])
+        try:
+            x = paddle.to_tensor(np.zeros((4, 4), np.float32))
+            with pytest.warns(RuntimeWarning, match="expert"):
+                sharding.shard_tensor(x, placements=P("expert", None))
+            import warnings as _w
+
+            with _w.catch_warnings():
+                _w.simplefilter("error")  # second time must be silent
+                sharding.shard_tensor(x, placements=P("expert", None))
+        finally:
+            reset_mesh()
+            sharding._warned_dangling.clear()
+
+
+# ---------------------------------------------------------------------------
+# five-step audit + CLI gate
+# ---------------------------------------------------------------------------
+
+class TestFiveStepAudit:
+    def test_default_steps_cover_all_five(self):
+        assert DEFAULT_AUDIT_STEPS == ("train", "decode", "prefill",
+                                       "moe", "ring")
+
+    @pytest.mark.slow
+    def test_cli_moe_gate_exits_zero(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint_tpu.py"),
+             "--shardplan", "--steps", "moe",
+             "--mesh", "data=2,fsdp=2,expert=2", "--fail-on-unplanned"],
+            capture_output=True, text=True, env=env, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "0 unplanned collective(s)" in out.stdout

@@ -396,14 +396,12 @@ class TestEngineXray:
 # ---------------------------------------------------------------------------
 
 class TestAuditDefaultSteps:
-    def test_all_three_steps_clean_under_cpu_budget(self):
+    def test_all_five_steps_clean_under_cpu_budget(self):
         reports = xray.audit_default_steps(
             chip="cpu", hbm_budget_bytes=xray.CHIPS["cpu"].hbm_bytes)
-        assert len(reports) == 3
+        assert len(reports) == 5
         names = {r.name for r in reports}
-        assert {"hapi::train_step", "serving::paged_decode_step",
-                "serving::chunked_prefill_step"} <= names \
-            or len(names) == 3
+        assert {"moe::block_step", "ring::sp_step"} <= names
         for r in reports:
             assert r.flops > 0
             assert r.peak_hbm_bytes < xray.CHIPS["cpu"].hbm_bytes

@@ -37,6 +37,17 @@ echo "== shard plan (SPMD layout + per-chip HBM + collectives) =="
 # per-chip HBM budget breach fail CI (README: Sharding plan analyzer)
 python tools/lint_tpu.py --shardplan
 
+echo "== shard plan: MoE + sequence-parallel workloads =="
+# the MoE block on an expert mesh and the ring-attention block on an sp
+# mesh must land fully planned: every collective layout-implied (the
+# a2a dispatch/combine pair, the per-hop ppermutes), zero unplanned,
+# zero unpriced primitives (S210), no capacity overflow (S211)
+# (README: Planning new workloads)
+python tools/lint_tpu.py --shardplan --steps moe \
+  --mesh data=2,fsdp=2,expert=2 --fail-on-unplanned
+python tools/lint_tpu.py --shardplan --steps ring \
+  --mesh data=2,sp=2,tp=2 --fail-on-unplanned
+
 echo "== mesh execution (2x2x2 SPMD on forced host devices) =="
 # runtime MeshExecutor over an emulated 8-device host: train-loss parity
 # (2,2,2) vs (1,1,1), serving token parity vs generate() with tp=2, zero

@@ -64,6 +64,13 @@ def _shardplan_main(argv):
                         help="mesh axis the batch dim is sharded on "
                         "(injection knob: 'tp' deliberately misplaces "
                         "the batch to exercise the S205/S208 gate)")
+    parser.add_argument("--steps", default=None,
+                        help="comma list of step kinds to audit "
+                        "(train,decode,prefill,moe,ring); default: all")
+    parser.add_argument("--fail-on-unplanned", action="store_true",
+                        help="exit non-zero if any collective in the "
+                        "plan is unplanned (spec conflict), even when "
+                        "no ERROR diagnostic fired")
     args = parser.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(
@@ -94,22 +101,31 @@ def _shardplan_main(argv):
     budget = (int(args.hbm_budget_gib * 2**30)
               if args.hbm_budget_gib is not None
               else xray.CHIPS[args.chip].hbm_bytes)
+    steps = (tuple(s.strip() for s in args.steps.split(",") if s.strip())
+             if args.steps else shardplan.DEFAULT_AUDIT_STEPS)
     reports = shardplan.audit_shardplan(
-        chip=args.chip, hbm_budget_bytes=budget, mesh=mesh, layout=layout)
+        chip=args.chip, hbm_budget_bytes=budget, mesh=mesh, layout=layout,
+        steps=steps)
     n_err = 0
+    n_unplanned = 0
     for r in reports:
         print(r.summary())
         print(r.table())
         for d in r.diagnostics:
             print(f"  {d}")
         n_err += len(r.errors())
+        n_unplanned += sum(1 for c in r.collectives if not c.planned)
     total_bytes = sum(c.total_bytes for r in reports
                       for c in r.collectives)
     print(f"lint-tpu --shardplan: {len(reports)} step(s), "
           f"{int(total_bytes)} collective byte(s) on the wire, "
           f"{sum(len(r.diagnostics) for r in reports)} diagnostic(s), "
-          f"{n_err} error(s)")
-    return 1 if n_err else 0
+          f"{n_err} error(s), {n_unplanned} unplanned collective(s)")
+    if n_err:
+        return 1
+    if args.fail_on_unplanned and n_unplanned:
+        return 1
+    return 0
 
 
 def _xray_main(argv):
