@@ -24,17 +24,19 @@ from typing import Any, List, Optional, Sequence
 from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
 from .hazards import (scan, scan_checkpoint_writes, scan_decode_step,
-                      scan_decode_steps, scan_function, scan_program,
-                      scan_static_function, scan_wall_clock_deadlines,
-                      sort_diagnostics)
+                      scan_decode_steps, scan_device_count_assumptions,
+                      scan_function, scan_program, scan_static_function,
+                      scan_wall_clock_deadlines, sort_diagnostics)
 from . import astlint
+from . import topology
 from . import xray
 from .xray import (ProgramReport, analyze, analyze_train_step,
                    audit_default_steps, check_sharding_readiness)
+from .topology import (RankedLayout, Topology, format_recommendations)
 from . import shardplan
 from .shardplan import (Collective, PlanReport, PlanRequest,
                         audit_shardplan, plan_jaxpr, plan_step,
-                        plan_train_step)
+                        plan_train_step, recommend_layouts)
 
 __all__ = [
     "Diagnostic",
@@ -48,6 +50,7 @@ __all__ = [
     "scan_decode_steps",
     "scan_checkpoint_writes",
     "scan_wall_clock_deadlines",
+    "scan_device_count_assumptions",
     "sort_diagnostics",
     "set_pass_verification",
     "pass_verification",
@@ -60,13 +63,18 @@ __all__ = [
     "audit_default_steps",
     "check_sharding_readiness",
     "shardplan",
+    "topology",
     "Collective",
     "PlanReport",
     "PlanRequest",
+    "RankedLayout",
+    "Topology",
     "audit_shardplan",
+    "format_recommendations",
     "plan_jaxpr",
     "plan_step",
     "plan_train_step",
+    "recommend_layouts",
     "ERROR",
     "WARNING",
     "INFO",

@@ -45,6 +45,19 @@ exactly that class of defect:
   (timestamps for logs/filenames are legitimate wall-clock uses, but
   deserve a look when they sit in serving/resilience paths).
 
+- **H112 single-process device-count assumption**:
+  ``jax.device_count()`` / ``len(jax.devices())`` return the GLOBAL
+  device count — under ``jax.distributed`` a process can only address
+  its ``jax.local_device_count()`` chips, so sizing a per-process mesh,
+  loop, or placement list from the global count breaks the moment a
+  second host joins (WARNING); a hardcoded chip count passed to a mesh
+  constructor (``Mesh``/``init_mesh``/``make_mesh``/
+  ``create_device_mesh``) bakes one fleet shape into code that should
+  derive it from the runtime (ERROR).
+  ``scan_device_count_assumptions()`` audits source trees; suppress a
+  deliberate global-count use with ``# lint-tpu: disable=H112`` on the
+  flagged line.
+
 Program-level scans are pure metadata walks (no execution); source-level
 scans are AST walks with real file/line locations.
 """
@@ -65,6 +78,7 @@ __all__ = [
     "scan_decode_steps",
     "scan_checkpoint_writes",
     "scan_wall_clock_deadlines",
+    "scan_device_count_assumptions",
     "scan",
     "sort_diagnostics",
 ]
@@ -606,6 +620,146 @@ def scan_wall_clock_deadlines(paths) -> List[Diagnostic]:
         except (OSError, SyntaxError):
             continue
         scanner = _WallClockScanner(f)
+        scanner.visit(tree)
+        diags.extend(scanner.diags)
+    return sort_diagnostics(diags)
+
+
+#: callees whose arguments lay out devices — a hardcoded chip count
+#: here bakes one fleet shape into the code (H112 ERROR).  abstract_mesh
+#: is deliberately absent: it builds device-free simulation meshes for
+#: the planner, where literal sizes are the point.
+_MESH_CTORS = frozenset({
+    "Mesh", "init_mesh", "make_mesh", "create_device_mesh",
+    "ProcessMesh",
+})
+
+
+class _DeviceCountScanner(ast.NodeVisitor):
+    """H112: single-process device-count assumptions.
+
+    ``jax.device_count()`` and ``len(jax.devices())`` count the GLOBAL
+    fleet; under ``jax.distributed`` only ``jax.local_device_count()``
+    chips are addressable per process, so meshes/loops/placements sized
+    from the global count double-count the moment a second host joins
+    (WARNING — a global mesh over all processes is sometimes intended;
+    suppress with ``# lint-tpu: disable=H112``).  An int literal > 1
+    handed to a mesh constructor is an ERROR: the fleet shape belongs
+    to runtime discovery or config, never the source."""
+
+    def __init__(self, filename: str, lines: List[str]):
+        self.filename = filename
+        self.lines = lines
+        self.diags: List[Diagnostic] = []
+
+    def _suppressed(self, lineno: int) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            return "lint-tpu: disable=H112" in self.lines[lineno - 1]
+        return False
+
+    def _emit(self, severity: str, message: str, lineno: int):
+        if self._suppressed(lineno):
+            return
+        self.diags.append(Diagnostic(
+            "H112", severity, message, f"{self.filename}:{lineno}"))
+
+    @staticmethod
+    def _is_jax_attr(node, attr: str) -> bool:
+        return (isinstance(node, ast.Attribute) and node.attr == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+
+    @staticmethod
+    def _literal_counts(node) -> List[int]:
+        """int literals > 1 inside an arg: bare, or in tuple/list/dict
+        literals (``Mesh(devs.reshape(2, 4), ...)`` style reshapes are
+        caught at the reshape call via the ctor's positional args)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool) and node.value > 1:
+            return [node.value]
+        out: List[int] = []
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                out.extend(_DeviceCountScanner._literal_counts(e))
+        elif isinstance(node, ast.Dict):
+            for v in node.values:
+                out.extend(_DeviceCountScanner._literal_counts(v))
+        return out
+
+    def visit_Call(self, node):
+        fn = node.func
+        # jax.device_count()  (NOT jax.local_device_count())
+        if self._is_jax_attr(fn, "device_count"):
+            self._emit(WARNING,
+                       "jax.device_count() is the GLOBAL device count — "
+                       "under jax.distributed a process addresses only "
+                       "jax.local_device_count() chips; sizing a "
+                       "per-process mesh, loop, or placement list from "
+                       "the global count breaks on the second host "
+                       "(suppress if a global/world size is intended)",
+                       node.lineno)
+        # len(jax.devices())
+        if isinstance(fn, ast.Name) and fn.id == "len" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Call) \
+                and self._is_jax_attr(node.args[0].func, "devices"):
+            self._emit(WARNING,
+                       "len(jax.devices()) counts the GLOBAL fleet — "
+                       "only jax.local_devices() are addressable per "
+                       "process under jax.distributed; use "
+                       "jax.local_device_count() for per-process "
+                       "sizing (suppress if a global/world size is "
+                       "intended)", node.lineno)
+        # hardcoded chip count in a mesh constructor
+        callee = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else None
+        if callee in _MESH_CTORS:
+            counts: List[int] = []
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                counts.extend(self._literal_counts(arg))
+            if counts:
+                self._emit(ERROR,
+                           f"hardcoded chip count(s) {sorted(counts)} in "
+                           f"{callee}(...) — the fleet shape is baked "
+                           "into the source and silently wrong on any "
+                           "other host/chip configuration; derive it "
+                           "from jax.local_device_count() / "
+                           "jax.process_count() or take it from config",
+                           node.lineno)
+        self.generic_visit(node)
+
+
+def scan_device_count_assumptions(paths) -> List[Diagnostic]:
+    """H112-audit python sources for single-process device-count
+    assumptions.  ``paths`` is a file, a directory (walked for
+    ``.py``), or a list of either — typically ``paddle_tpu/`` and
+    ``examples/``.  Global-count reads (``jax.device_count()`` /
+    ``len(jax.devices())``) are WARNINGs, hardcoded chip counts in mesh
+    construction are ERRORs; suppress a deliberate global-count use
+    with ``# lint-tpu: disable=H112`` on the flagged line."""
+    import os
+
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in sorted(files):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        if "lint-tpu: disable-file=H112" in src:
+            continue
+        scanner = _DeviceCountScanner(f, src.splitlines())
         scanner.visit(tree)
         diags.extend(scanner.diags)
     return sort_diagnostics(diags)

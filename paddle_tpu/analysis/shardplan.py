@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from .topology import Topology, format_recommendations, rank_layouts
 from .verifier import ERROR, WARNING, Diagnostic
 from .xray import (CHIPS, ChipProfile, _aval_bytes, _collect_costs,
                    _peak_live_by_dtype, _peak_live_bytes, _var_bytes,
@@ -65,12 +66,26 @@ __all__ = [
     "MoEStatics",
     "PlanReport",
     "PlanRequest",
+    "Topology",
     "audit_shardplan",
     "export_plan_gauges",
     "plan_jaxpr",
     "plan_step",
     "plan_train_step",
+    "recommend_layouts",
 ]
+
+#: step kinds where a request round-trips the step on the critical
+#: path — any DCN-crossing collective inside one is an S213 ERROR
+LATENCY_CRITICAL_STEP_KINDS = frozenset(
+    {"decode", "beam_decode", "paged_decode", "prefill",
+     "chunked_prefill"})
+
+#: S213 noise floor: a DCN edge must move at least this many wire
+#: bytes per step to be flagged — scalar-sized control reduces (the
+#: conservative gather rule prices an aligned per-shard lookup as an
+#: 8-byte all_reduce) are priced into the totals but not latency-gated
+_S213_FLOOR_BYTES = 256
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +167,10 @@ class Collective:
     planned: bool
     primitive: str
     count: float = 1.0
+    # link level the bytes ride: "ici" (intra-host, the only level a
+    # flat single-host plan has) or "dcn" (cross-host phase of a
+    # topology-decomposed collective)
+    level: str = "ici"
 
     @property
     def total_bytes(self) -> float:
@@ -197,6 +216,16 @@ class PlanReport:
     # per_chip_peak_hbm_bytes); the dtype-aware gauge for int8/fp8 KV
     per_chip_peak_hbm_by_dtype: Dict[str, int] = dataclasses.field(
         default_factory=dict)
+    # multi-host pricing context.  When a Topology is set,
+    # ``collectives`` holds the hierarchically decomposed per-link
+    # phases and ``flat_collectives`` keeps the raw single-level
+    # inventory the propagation produced (what the layout recommender
+    # reprices under other assignments); without one the two lists are
+    # the same object.
+    topology: Optional[Topology] = None
+    flat_collectives: List[Collective] = dataclasses.field(
+        default_factory=list)
+    step_kind: Optional[str] = None
 
     @property
     def comm_bytes(self) -> float:
@@ -205,6 +234,88 @@ class PlanReport:
     @property
     def comm_time_s(self) -> float:
         return sum(c.total_time_s for c in self.collectives)
+
+    @property
+    def ici_comm_bytes(self) -> float:
+        return sum(c.total_bytes for c in self.collectives
+                   if c.level != "dcn")
+
+    @property
+    def dcn_comm_bytes(self) -> float:
+        return sum(c.total_bytes for c in self.collectives
+                   if c.level == "dcn")
+
+    @property
+    def ici_comm_time_s(self) -> float:
+        return sum(c.total_time_s for c in self.collectives
+                   if c.level != "dcn")
+
+    @property
+    def dcn_comm_time_s(self) -> float:
+        return sum(c.total_time_s for c in self.collectives
+                   if c.level == "dcn")
+
+    @property
+    def chips_per_host_count(self) -> int:
+        if self.topology is not None:
+            return self.topology.chips_per_host_count
+        return max(1, self.n_chips)   # single host holds the mesh
+
+    @property
+    def per_host_peak_hbm_bytes(self) -> int:
+        """HBM the busiest host must hold: per-chip peak × chips on
+        one host (every chip of a host peaks in the same SPMD step)."""
+        return self.per_chip_peak_hbm_bytes * self.chips_per_host_count
+
+    @property
+    def dcn_bytes_per_host(self) -> float:
+        """DCN ingress+egress through one host's NIC per step — every
+        resident chip's DCN wire bytes funnel through the host."""
+        return self.dcn_comm_bytes * self.chips_per_host_count
+
+    def to_json(self) -> Dict[str, Any]:
+        """Machine-readable plan for ``lint_tpu --shardplan --json`` —
+        CI diffs these across PRs instead of grepping the text table."""
+        topo = self.topology
+        return {
+            "name": self.name,
+            "step_kind": self.step_kind,
+            "chip": self.chip.name,
+            "mesh": dict(self.mesh),
+            "n_chips": int(self.n_chips),
+            "hosts": int(topo.hosts) if topo else 1,
+            "chips_per_host": (list(topo.chips_per_host) if topo
+                               else [max(1, self.n_chips)]),
+            "axis_levels": ({a: topo.level_of(a, self.mesh)
+                             for a in self.mesh} if topo else
+                            {a: "ici" for a in self.mesh}),
+            "per_chip_peak_hbm_bytes": int(self.per_chip_peak_hbm_bytes),
+            "per_host_peak_hbm_bytes": int(self.per_host_peak_hbm_bytes),
+            "per_chip_peak_hbm_by_dtype": {
+                k: int(v)
+                for k, v in sorted(self.per_chip_peak_hbm_by_dtype.items())},
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "wire_bytes": {"ici": int(self.ici_comm_bytes),
+                           "dcn": int(self.dcn_comm_bytes)},
+            "comm_time_s": {"ici": self.ici_comm_time_s,
+                            "dcn": self.dcn_comm_time_s},
+            "dcn_bytes_per_host": int(self.dcn_bytes_per_host),
+            "compute_time_s": self.compute_time_s,
+            "unplanned_collectives": sum(
+                1 for c in self.collectives if not c.planned),
+            "collectives": [
+                {"kind": c.kind, "axes": list(c.axes), "level": c.level,
+                 "payload_bytes": int(c.payload_bytes),
+                 "bytes_moved": int(c.bytes_moved), "count": c.count,
+                 "time_s": c.time_s, "planned": c.planned,
+                 "primitive": c.primitive}
+                for c in self.collectives],
+            "diagnostics": [
+                {"code": d.code, "severity": d.severity,
+                 "message": d.message, "where": d.where}
+                for d in self.diagnostics],
+            "param_specs": dict(self.param_specs),
+        }
 
     @property
     def compute_time_s(self) -> float:
@@ -220,13 +331,13 @@ class PlanReport:
     def table(self, top: int = 12) -> str:
         """Collective inventory: kind, mesh axes, wire KiB/chip, µs,
         planned-or-conflict, producing primitive."""
-        rows = [f"{'collective':<16}{'axes':<14}{'KiB/chip':>10}"
-                f"{'µs':>8}  plan  primitive"]
+        rows = [f"{'collective':<16}{'axes':<14}{'link':<6}"
+                f"{'KiB/chip':>10}{'µs':>8}  plan  primitive"]
         ordered = sorted(self.collectives,
                          key=lambda c: (-c.total_bytes, c.kind, c.primitive))
         for c in ordered[:top]:
             rows.append(
-                f"{c.kind:<16}{'×'.join(c.axes):<14}"
+                f"{c.kind:<16}{'×'.join(c.axes):<14}{c.level:<6}"
                 f"{c.total_bytes / 1024:>10.2f}{c.total_time_s * 1e6:>8.2f}"
                 f"  {'yes' if c.planned else 'NO':<4}  {c.primitive}")
         return "\n".join(rows)
@@ -236,14 +347,28 @@ class PlanReport:
                   if self.hbm_budget_bytes else "")
         mesh = ",".join(f"{k}={v}" for k, v in self.mesh.items())
         unplanned = sum(1 for c in self.collectives if not c.planned)
-        return (f"[shardplan] {self.name} on ({mesh}) @ {self.chip.name}: "
-                f"per-chip peak HBM "
+        if self.topology is not None:
+            topo = (f" [{self.topology.hosts} host(s) × "
+                    f"{self.chips_per_host_count} chips]")
+            comm = (f"comm {self.comm_time_s * 1e6:.1f} µs "
+                    f"(ICI {self.ici_comm_time_s * 1e6:.1f} + "
+                    f"DCN {self.dcn_comm_time_s * 1e6:.1f})")
+            host_hbm = (f", per-host peak HBM "
+                        f"{self.per_host_peak_hbm_bytes / 2**20:.2f} MiB"
+                        f", DCN {self.dcn_bytes_per_host / 2**20:.3f} "
+                        "MiB/host/step")
+        else:
+            topo = ""
+            comm = f"comm {self.comm_time_s * 1e6:.1f} µs"
+            host_hbm = ""
+        return (f"[shardplan] {self.name} on ({mesh}){topo} "
+                f"@ {self.chip.name}: per-chip peak HBM "
                 f"{self.per_chip_peak_hbm_bytes / 2**20:.2f} MiB{budget}, "
                 f"{len(self.collectives)} collective(s) "
                 f"({unplanned} unplanned, "
                 f"{self.comm_bytes / 2**20:.3f} MiB on wire), "
-                f"comm {self.comm_time_s * 1e6:.1f} µs vs compute "
-                f"{self.compute_time_s * 1e6:.1f} µs, "
+                f"{comm} vs compute "
+                f"{self.compute_time_s * 1e6:.1f} µs{host_hbm}, "
                 f"{len(self.diagnostics)} diagnostic(s)")
 
 
@@ -262,6 +387,9 @@ class PlanRequest:
     s206_bytes: int = 8 << 20     # replicated-param WARNING threshold
     raise_on_error: bool = True
     moe: Optional[MoEStatics] = None  # set for MoE steps (S211 + a2a pricing)
+    # multi-host topology: when set, collectives over host-spanning
+    # axes decompose into ICI/DCN phases and per-host budgets apply
+    topology: Optional[Topology] = None
 
     def resolved_layout(self):
         if self.layout is not None:
@@ -948,7 +1076,9 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
                data_axis: str = "data",
                s205_bytes: int = 1 << 20,
                s206_bytes: int = 8 << 20,
-               moe: Optional[MoEStatics] = None) -> PlanReport:
+               moe: Optional[MoEStatics] = None,
+               topology: Optional[Topology] = None,
+               step_kind: Optional[str] = None) -> PlanReport:
     """Propagate ``invar_specs`` (one PartitionSpec-like or None per
     jaxpr invar; ``constvar_specs`` likewise for constvars) through
     ``closed`` on the abstract ``mesh`` and build the
@@ -956,10 +1086,15 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
 
     ``param_info`` is ``[(name, nbytes, spec)]`` for S206;
     ``data_inputs`` is ``[(label, invar_index)]`` naming which invars
-    carry a batch dimension S208 should check.
+    carry a batch dimension S208 should check.  A ``topology``
+    hierarchically decomposes host-spanning collectives into per-link
+    ICI/DCN phases; ``step_kind`` names the registered step kind for
+    the S213 latency-criticality check.
     """
     profile = CHIPS[chip] if isinstance(chip, str) else chip
     mesh = {str(k): int(v) for k, v in dict(mesh).items()}
+    if topology is not None:
+        topology.validate(mesh)
     n_chips = 1
     for v in mesh.values():
         n_chips *= v
@@ -972,6 +1107,32 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
     for v, spec in extra_var_specs:
         pl.set_spec(v, _normalize_spec(spec, _rank(v)))
     pl.run(jaxpr)
+
+    # hierarchical decomposition: each flat collective whose axes span
+    # hosts becomes per-link phases, re-priced against the matching
+    # link profile; the flat list survives for the layout recommender
+    flat_collectives = pl.collectives
+    if topology is None:
+        collectives = flat_collectives
+    else:
+        collectives = []
+        for c in flat_collectives:
+            pay = float(c.payload_bytes)
+            f0 = (c.bytes_moved / pay
+                  if c.kind == "ppermute" and pay else None)
+            for ph in topology.phases(c.kind, c.axes, pay, mesh,
+                                      factor=f0):
+                moved = int(ph.payload_bytes * ph.factor)
+                if moved <= 0:
+                    continue
+                collectives.append(Collective(
+                    kind=ph.kind, axes=ph.axes,
+                    payload_bytes=int(ph.payload_bytes),
+                    bytes_moved=moved,
+                    time_s=estimate_collective_time(moved, profile,
+                                                    level=ph.level),
+                    planned=c.planned, primitive=c.primitive,
+                    count=c.count, level=ph.level))
 
     # whole-program cost (all chips) for the S207 comparison
     acc: Dict[str, List[float]] = {}
@@ -1022,17 +1183,32 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
             "tensor; shard it on 'fsdp' unless it is genuinely tiny",
             where))
 
-    # S207 — collective-bound step
-    comm_t = sum(c.total_time_s for c in pl.collectives)
+    # S207 — collective-bound step, level-aware: the bound is the
+    # slowest link the step actually touches, not aggregate bandwidth
+    comm_t = sum(c.total_time_s for c in collectives)
     compute_t = estimate_compute_time(flops / max(1, n_chips),
                                       byts / max(1, n_chips), profile)
     if comm_t > compute_t:
+        ici_t = sum(c.total_time_s for c in collectives
+                    if c.level != "dcn")
+        dcn_t = comm_t - ici_t
+        if topology is not None and dcn_t > 0:
+            slow = "DCN" if dcn_t >= ici_t else "ICI"
+            split = (f" (ICI {ici_t * 1e6:.1f} µs + DCN "
+                     f"{dcn_t * 1e6:.1f} µs; bound by the {slow} link)")
+            hint = ("move the heaviest axis onto ICI, shard less "
+                    "aggressively, or grow the per-chip work")
+        else:
+            split = ""
+            hint = ("shard less aggressively or grow the per-chip "
+                    "work")
+            slow = "ICI"
         diags.append(Diagnostic(
             "S207", ERROR,
             f"collective-bound: estimated comm {comm_t * 1e6:.1f} µs "
             f"exceeds per-chip compute {compute_t * 1e6:.1f} µs on "
-            f"{profile.name} — the mesh spends the step waiting on ICI; "
-            "shard less aggressively or grow the per-chip work", where))
+            f"{profile.name}{split} — the mesh spends the step waiting "
+            f"on {slow}; {hint}", where))
 
     # S208 — batch dim not on the data axis
     d_size = mesh.get(data_axis, 1)
@@ -1082,8 +1258,9 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
 
     # S212 — ring hop that cannot hide under compute: the per-hop
     # permute must overlap one hop's worth of local attention compute
-    for c in pl.collectives:
-        if c.kind != "ppermute":
+    # (ICI hops only — a DCN-priced hop is S215's finding)
+    for c in collectives:
+        if c.kind != "ppermute" or c.level == "dcn":
             continue
         hops = max(1.0, float(c.count))
         window = compute_t / hops
@@ -1096,6 +1273,93 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
                 "per-hop compute exists to hide it — the ring is "
                 "ICI-bound; grow the per-chip sequence chunk or use a "
                 "faster interconnect", where))
+
+    # S213 — DCN-crossing collective inside a latency-critical step:
+    # decode/prefill sit on the request critical path, and one 10 µs+
+    # DCN round per layer is the difference between serving and not.
+    # Edges under the floor (scalar-sized control reduces the
+    # conservative gather rule prices) stay priced but unflagged.
+    if topology is not None and step_kind in LATENCY_CRITICAL_STEP_KINDS:
+        edge_bytes: Dict[Tuple[str, Tuple[str, ...]], float] = {}
+        for c in collectives:
+            if c.level == "dcn":
+                key = (c.kind, c.axes)
+                edge_bytes[key] = edge_bytes.get(key, 0.0) + c.total_bytes
+        hot = {k: b for k, b in edge_bytes.items()
+               if b >= _S213_FLOOR_BYTES}
+        if hot:
+            total = sum(hot.values())
+            n = sum(1 for c in collectives if c.level == "dcn"
+                    and (c.kind, c.axes) in hot)
+            edges = sorted(f"{kind} over {'×'.join(axes)}"
+                           for kind, axes in hot)
+            diags.append(Diagnostic(
+                "S213", ERROR,
+                f"DCN-crossing collective in latency-critical step "
+                f"kind {step_kind!r}: {n} phase(s) "
+                f"({'; '.join(edges)}) move {total / 1024:.1f} KiB/chip "
+                "over the data-center network on the request critical "
+                "path — keep every serving axis (tp/sp) inside one "
+                "host's ICI domain and cross hosts only on the batch "
+                "axis, which decode never reduces over", where))
+
+    # S214 — a hotter axis rides DCN while a colder same-size axis
+    # rides ICI: swapping the assignment is free at plan time
+    if topology is not None:
+        axis_splits = topology.splits(mesh)
+        traffic: Dict[str, float] = {}
+        for c in flat_collectives:
+            for a in c.axes:
+                traffic[a] = traffic.get(a, 0.0) + c.total_bytes
+        dcn_axes = [a for a in mesh
+                    if axis_splits.get(a, (1, 1))[1] > 1]
+        ici_axes = [a for a in mesh if mesh[a] > 1
+                    and axis_splits.get(a, (1, 1))[1] == 1]
+        best = None
+        for d in dcn_axes:
+            for i in ici_axes:
+                if mesh[d] != mesh[i]:
+                    continue  # unequal sizes: swap changes the layout
+                gain = traffic.get(d, 0.0) - traffic.get(i, 0.0)
+                if gain > 0 and (best is None or gain > best[0]):
+                    best = (gain, d, i)
+        if best is not None:
+            _, d, i = best
+            diags.append(Diagnostic(
+                "S214", WARNING,
+                f"high-traffic axis {d!r} "
+                f"({traffic.get(d, 0.0) / 1024:.1f} KiB/chip) is mapped "
+                f"to DCN while axis {i!r} "
+                f"({traffic.get(i, 0.0) / 1024:.1f} KiB/chip) rides "
+                f"ICI — both are size {mesh[d]}; swap the assignment "
+                f"(axis_levels={{{i!r}: 'dcn', {d!r}: 'ici'}}) to move "
+                "the heavy traffic onto the fast link", where))
+
+    # S215 — DCN phase that cannot hide behind the step's compute
+    # window (the cross-host mirror of S212's ICI check); one finding
+    # per (kind, axes) edge, reporting its slowest phase
+    if topology is not None:
+        worst: Dict[Tuple[str, Tuple[str, ...]], Collective] = {}
+        for c in collectives:
+            if c.level != "dcn":
+                continue
+            window = compute_t / max(1.0, float(c.count))
+            if c.time_s <= window:
+                continue
+            key = (c.kind, c.axes)
+            if key not in worst or c.time_s > worst[key].time_s:
+                worst[key] = c
+        for (kind, axes), c in sorted(worst.items()):
+            window = compute_t / max(1.0, float(c.count))
+            diags.append(Diagnostic(
+                "S215", WARNING,
+                f"DCN phase {kind} over {list(axes)} moves "
+                f"{c.bytes_moved / 1024:.1f} KiB/chip taking "
+                f"{c.time_s * 1e6:.1f} µs on {profile.name} DCN, but "
+                f"only {window * 1e6:.1f} µs of per-occurrence compute "
+                "exists to hide it — the cross-host traffic sits "
+                "exposed on the step's critical path; overlap it "
+                "against compute or move the axis onto ICI", where))
 
     if hbm_budget_bytes is not None and peak > hbm_budget_bytes:
         diags.append(Diagnostic(
@@ -1111,10 +1375,11 @@ def plan_jaxpr(closed, invar_specs: Sequence[Any], *,
                    for pname, _, spec in param_info}
     return PlanReport(
         name=name, chip=profile, mesh=mesh, n_chips=n_chips,
-        per_chip_peak_hbm_bytes=peak, collectives=pl.collectives,
+        per_chip_peak_hbm_bytes=peak, collectives=collectives,
         flops=flops, bytes=byts, diagnostics=sort_diagnostics(diags),
         param_specs=param_specs, hbm_budget_bytes=hbm_budget_bytes,
-        per_chip_peak_hbm_by_dtype=peak_by_dtype)
+        per_chip_peak_hbm_by_dtype=peak_by_dtype, topology=topology,
+        flat_collectives=flat_collectives, step_kind=step_kind)
 
 
 def _mesh_str(mesh: Dict[str, int]) -> str:
@@ -1179,15 +1444,15 @@ def plan_train_step(step_fn, inputs, labels, *,
         hbm_budget_bytes=req.hbm_budget_bytes, param_info=param_info,
         data_inputs=data_inputs, data_axis=layout.data_axis,
         s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes,
-        moe=req.moe)
+        moe=req.moe, topology=req.topology, step_kind="train")
 
 
 def plan_step(step, abstract_args: Sequence[Any], *, model,
               arg_specs: Sequence[Any],
               request: Optional[PlanRequest] = None,
               name: str = "<step>",
-              data_input_leaves: Sequence[Tuple[str, int]] = ()
-              ) -> PlanReport:
+              data_input_leaves: Sequence[Tuple[str, int]] = (),
+              step_kind: Optional[str] = None) -> PlanReport:
     """Plan a serving-style step traced with ``jax.make_jaxpr``.  The
     model weights are captured as jit CONSTANTS, so they surface as
     jaxpr constvars — matched back to named parameters by identity.
@@ -1229,7 +1494,7 @@ def plan_step(step, abstract_args: Sequence[Any], *, model,
         extra_var_specs=extra, param_info=param_info,
         data_inputs=data_input_leaves, data_axis=layout.data_axis,
         s205_bytes=req.s205_bytes, s206_bytes=req.s206_bytes,
-        moe=req.moe)
+        moe=req.moe, topology=req.topology, step_kind=step_kind)
 
 
 def _iter_const_bindings(closed):
@@ -1298,7 +1563,8 @@ def audit_shardplan(*, chip: str = "cpu",
                     layout: Any = None,
                     s205_bytes: int = 1 << 10,
                     s206_bytes: int = 8 << 20,
-                    steps: Sequence[str] = DEFAULT_AUDIT_STEPS
+                    steps: Sequence[str] = DEFAULT_AUDIT_STEPS,
+                    topology: Optional[Topology] = None
                     ) -> List[PlanReport]:
     """Plan the default step kinds (train, paged decode, chunked
     prefill, MoE block, ring/sp block) for tiny Llamas against the
@@ -1324,7 +1590,8 @@ def audit_shardplan(*, chip: str = "cpu",
     req = PlanRequest(mesh=mesh or {"data": 2, "fsdp": 2, "tp": 2},
                       layout=layout, chip=chip,
                       hbm_budget_bytes=hbm_budget_bytes,
-                      s205_bytes=s205_bytes, s206_bytes=s206_bytes)
+                      s205_bytes=s205_bytes, s206_bytes=s206_bytes,
+                      topology=topology)
     lay = req.resolved_layout()
     paddle.seed(0)
     cfg = LlamaConfig.tiny()
@@ -1358,13 +1625,15 @@ def audit_shardplan(*, chip: str = "cpu",
                 make_paged_decode_step(net), decode_args, model=net,
                 arg_specs=decode_specs, request=req,
                 name="serving::decode_step",
-                data_input_leaves=(("tokens", 0),)))
+                data_input_leaves=(("tokens", 0),),
+                step_kind="paged_decode"))
         if "prefill" in steps:
             reports.append(plan_step(
                 make_chunked_prefill_step(net), prefill_args, model=net,
                 arg_specs=prefill_specs, request=req,
                 name="serving::prefill_step",
-                data_input_leaves=(("chunk_ids", 0),)))
+                data_input_leaves=(("chunk_ids", 0),),
+                step_kind="chunked_prefill"))
 
     sds = jax.ShapeDtypeStruct
     if "moe" in steps:
@@ -1385,7 +1654,8 @@ def audit_shardplan(*, chip: str = "cpu",
             make_moe_block_step(moe_net), (sds((B, T), np.int32),),
             model=moe_net, arg_specs=(lay.batch_spec(),),
             request=moe_req, name="moe::block_step",
-            data_input_leaves=(("tokens", 0),)))
+            data_input_leaves=(("tokens", 0),),
+            step_kind="moe_block"))
 
     if "ring" in steps:
         from ..distributed.mesh import abstract_mesh
@@ -1401,7 +1671,8 @@ def audit_shardplan(*, chip: str = "cpu",
             (sds((4, 32), np.int32),),
             model=ring_net, arg_specs=(lay.batch_spec(),),
             request=ring_req, name="ring::sp_step",
-            data_input_leaves=(("tokens", 0),)))
+            data_input_leaves=(("tokens", 0),),
+            step_kind="ring_sp"))
 
     for r in reports:
         export_plan_gauges(r)
@@ -1419,6 +1690,12 @@ def export_plan_gauges(report: PlanReport):
     reg.gauge("shardplan_comm_bytes",
               "total per-chip collective wire bytes of a planned step"
               ).set(report.comm_bytes, step=report.name)
+    reg.gauge("shardplan_ici_comm_bytes",
+              "per-chip wire bytes a planned step puts on intra-host ICI"
+              ).set(report.ici_comm_bytes, step=report.name)
+    reg.gauge("shardplan_dcn_comm_bytes",
+              "per-chip wire bytes a planned step puts on cross-host DCN"
+              ).set(report.dcn_comm_bytes, step=report.name)
     reg.gauge("shardplan_per_chip_peak_hbm_bytes",
               "shard-aware liveness peak HBM per chip of a planned step"
               ).set(report.per_chip_peak_hbm_bytes, step=report.name)
@@ -1426,3 +1703,26 @@ def export_plan_gauges(report: PlanReport):
                   "per-chip bytes of one dtype at the liveness peak")
     for dt, b in sorted(report.per_chip_peak_hbm_by_dtype.items()):
         g.set(b, step=report.name, dtype=dt)
+
+
+def recommend_layouts(report: PlanReport, *,
+                      hosts: Optional[int] = None,
+                      chips_per_host: Optional[Tuple[int, ...]] = None):
+    """Rank every valid axis→level assignment for ``report``'s mesh by
+    the comm time it would give this step — repricing the flat
+    collective inventory the propagation already produced (no
+    re-trace).  ``hosts`` defaults to the report's topology.  Returns
+    :class:`~paddle_tpu.analysis.topology.RankedLayout` objects, best
+    first; render with
+    :func:`~paddle_tpu.analysis.topology.format_recommendations`."""
+    if hosts is None:
+        if report.topology is None:
+            raise ValueError(
+                "recommend_layouts needs hosts=: the report was "
+                "planned without a Topology")
+        hosts = report.topology.hosts
+        if chips_per_host is None:
+            chips_per_host = report.topology.chips_per_host
+    flat = report.flat_collectives or report.collectives
+    return rank_layouts(flat, report.mesh, report.chip, hosts,
+                        chips_per_host)

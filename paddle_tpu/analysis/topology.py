@@ -1,0 +1,365 @@
+"""Multi-host topology model for the static shard planner.
+
+The shard planner (shardplan.py) prices every collective against a
+single flat interconnect — correct for one host, wrong the moment a
+mesh axis spans hosts: inter-host traffic rides the data-center
+network (DCN), which is an order of magnitude slower than ICI in both
+bandwidth and latency (``ChipProfile.dcn_*`` vs ``ici_*``).
+
+A :class:`Topology` describes ``hosts × chips_per_host`` plus an
+axis→link-level assignment (``"ici"`` or ``"dcn"``).  Under it every
+planned collective whose mesh axes span hosts is **decomposed
+hierarchically** into per-link phases — the standard multislice
+lowering:
+
+    all_reduce(S)      → reduce_scatter(S, ici) + all_reduce(S/n_i, dcn)
+                         + all_gather(S, ici)
+    all_gather(S)      → all_gather(S/n_i, dcn) + all_gather(S, ici)
+    reduce_scatter(S)  → reduce_scatter(S, ici) + reduce_scatter(S/n_i, dcn)
+    all_to_all(S)      → all_to_all(S, dcn) + all_to_all(S, ici)
+    ppermute(S)        → ppermute(S, dcn)   (a synchronous ring hop is
+                         gated by its slowest edge — one DCN factor on
+                         the axis makes the whole hop a DCN hop)
+
+where ``n_i``/``n_d`` are the ICI/DCN factor products of the
+collective's axes.  Each phase is priced with the same ring formulas
+the flat planner uses (all_reduce moves ``2·S·(n−1)/n`` per chip, the
+others ``S·(n−1)/n``) against the matching link profile.  The DCN-side
+all_reduce runs on the ``S/n_i`` shard the intra-host reduce_scatter
+left behind — that payload reduction is the whole point of the
+hierarchical decomposition.
+
+The :func:`recommend_layouts` recommender enumerates every valid
+axis→level assignment for a mesh, reprices a step's flat collective
+inventory under each, and returns them ranked by total comm time — the
+static answer to "which axis should I put on DCN".
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "LinkPhase",
+    "RankedLayout",
+    "Topology",
+    "format_recommendations",
+]
+
+ICI = "ici"
+DCN = "dcn"
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkPhase:
+    """One link-level phase of a decomposed collective: the planner
+    turns each into a priced ``Collective`` carrying ``level``."""
+
+    kind: str                  # all_reduce | all_gather | ...
+    level: str                 # "ici" | "dcn"
+    axes: Tuple[str, ...]      # participating mesh axes at this level
+    payload_bytes: float       # logical payload entering this phase
+    factor: float              # ring factor: wire bytes = payload·factor
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """``hosts`` × ``chips_per_host`` and the axis→link assignment.
+
+    ``chips_per_host`` is the per-host ICI grid shape, e.g. ``(2, 2)``
+    for a 4-chip host; the grid shape only labels the intra-host
+    fabric (ICI pricing is per-chip aggregate), its *product* is what
+    budgets use.  ``axis_levels`` pins mesh axes to ``"ici"`` or
+    ``"dcn"``; unpinned axes are assigned by :meth:`splits` — walking
+    the mesh in order, axes go to DCN until the DCN factor product
+    covers ``hosts``, the rest stay on ICI (the multislice default:
+    outermost/data axis crosses hosts).
+    """
+
+    hosts: int = 1
+    chips_per_host: Tuple[int, ...] = (4,)
+    axis_levels: Mapping[str, str] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        if int(self.hosts) < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        for ax, lvl in dict(self.axis_levels).items():
+            if lvl not in (ICI, DCN):
+                raise ValueError(
+                    f"axis_levels[{ax!r}] must be 'ici' or 'dcn', "
+                    f"got {lvl!r}")
+
+    @property
+    def chips_per_host_count(self) -> int:
+        n = 1
+        for d in self.chips_per_host:
+            n *= int(d)
+        return n
+
+    @property
+    def total_chips(self) -> int:
+        return int(self.hosts) * self.chips_per_host_count
+
+    # -- axis factor splits --------------------------------------------------
+
+    def splits(self, mesh: Dict[str, int]) -> Dict[str, Tuple[int, int]]:
+        """axis → ``(n_ici, n_dcn)`` factor split.  A DCN-assigned axis
+        of size ``s`` contributes ``gcd(s, remaining_hosts)`` to the
+        DCN level (an axis larger than the host count spans: part of it
+        crosses hosts, the rest stays intra-host); pinned axes consume
+        DCN capacity first, then unpinned axes in mesh order."""
+        mesh = {str(k): int(v) for k, v in mesh.items()}
+        out: Dict[str, Tuple[int, int]] = {}
+        remaining = int(self.hosts)
+
+        def take(size: int, remaining: int) -> Tuple[int, int]:
+            n_d = math.gcd(size, remaining) if remaining > 1 else 1
+            return size // n_d, n_d
+
+        for ax, size in mesh.items():
+            lvl = self.axis_levels.get(ax)
+            if lvl == DCN:
+                n_i, n_d = take(size, remaining)
+                out[ax] = (n_i, n_d)
+                remaining //= n_d
+            elif lvl == ICI:
+                out[ax] = (size, 1)
+        for ax, size in mesh.items():
+            if ax in out:
+                continue
+            n_i, n_d = take(size, remaining)
+            out[ax] = (n_i, n_d)
+            remaining //= n_d
+        return out
+
+    def validate(self, mesh: Dict[str, int]):
+        """Raise ValueError when the mesh cannot be laid onto this
+        topology: total chips must match hosts × chips/host, and the
+        DCN factor product must cover every host (a mesh spanning only
+        part of the fleet means dead hosts the plan would not see)."""
+        mesh = {str(k): int(v) for k, v in mesh.items()}
+        n = 1
+        for v in mesh.values():
+            n *= v
+        if n != self.total_chips:
+            raise ValueError(
+                f"mesh {mesh} has {n} chips but the topology is "
+                f"{self.hosts} host(s) × {self.chips_per_host_count} "
+                f"chips/host = {self.total_chips}")
+        splits = self.splits(mesh)
+        dcn_product = 1
+        for n_i, n_d in splits.values():
+            dcn_product *= n_d
+        if dcn_product != self.hosts:
+            raise ValueError(
+                f"axis→level assignment spans {dcn_product} of "
+                f"{self.hosts} hosts (splits {splits}) — no axis "
+                "factorization crosses the remaining hosts; assign a "
+                "host-divisible axis to 'dcn' or fix the mesh")
+
+    def level_of(self, axis: str, mesh: Dict[str, int]) -> str:
+        """The link level ``axis`` lands on (``"dcn"`` when any factor
+        of it crosses hosts)."""
+        n_i, n_d = self.splits(mesh).get(axis, (1, 1))
+        return DCN if n_d > 1 else ICI
+
+    # -- hierarchical decomposition ------------------------------------------
+
+    def phases(self, kind: str, axes: Sequence[str], payload: float,
+               mesh: Dict[str, int],
+               factor: Optional[float] = None) -> List[LinkPhase]:
+        """Decompose one flat collective into priced link phases.
+
+        ``factor`` overrides the ring factor for kinds the flat planner
+        priced specially (ppermute's per-hop 1.0).
+        """
+        splits = self.splits(mesh)
+        axes = tuple(a for a in axes if mesh.get(a, 1) > 1)
+        ici_axes = tuple(a for a in axes if splits.get(a, (1, 1))[0] > 1)
+        dcn_axes = tuple(a for a in axes if splits.get(a, (1, 1))[1] > 1)
+        n_i = 1
+        n_d = 1
+        for a in axes:
+            s = splits.get(a, (mesh.get(a, 1), 1))
+            n_i *= s[0]
+            n_d *= s[1]
+
+        def ring(kind: str, n: int) -> float:
+            return 2.0 * (n - 1) / n if kind == "all_reduce" \
+                else (n - 1) / n
+
+        if kind == "ppermute":
+            # a synchronous neighbour-exchange ring step completes when
+            # its slowest edge does: any DCN factor on the axis makes
+            # the hop DCN-priced end to end
+            level = DCN if n_d > 1 else ICI
+            return [LinkPhase("ppermute", level, axes, payload,
+                              1.0 if factor is None else factor)]
+        if n_d <= 1:
+            return [LinkPhase(kind, ICI, axes, payload,
+                              ring(kind, n_i) if factor is None
+                              else factor)]
+        if n_i <= 1:
+            return [LinkPhase(kind, DCN, axes, payload,
+                              ring(kind, n_d) if factor is None
+                              else factor)]
+        if kind == "all_reduce":
+            return [
+                LinkPhase("reduce_scatter", ICI, ici_axes, payload,
+                          (n_i - 1) / n_i),
+                LinkPhase("all_reduce", DCN, dcn_axes, payload / n_i,
+                          2.0 * (n_d - 1) / n_d),
+                LinkPhase("all_gather", ICI, ici_axes, payload,
+                          (n_i - 1) / n_i),
+            ]
+        if kind == "all_gather":
+            # DCN leg first, on the smallest shard — each host gathers
+            # the missing inter-host shards over DCN, then broadcasts
+            # intra-host over ICI
+            return [
+                LinkPhase("all_gather", DCN, dcn_axes, payload / n_i,
+                          (n_d - 1) / n_d),
+                LinkPhase("all_gather", ICI, ici_axes, payload,
+                          (n_i - 1) / n_i),
+            ]
+        if kind == "reduce_scatter":
+            return [
+                LinkPhase("reduce_scatter", ICI, ici_axes, payload,
+                          (n_i - 1) / n_i),
+                LinkPhase("reduce_scatter", DCN, dcn_axes,
+                          payload / n_i, (n_d - 1) / n_d),
+            ]
+        if kind == "all_to_all":
+            # the (n_d−1)/n_d fraction of each chip's payload targets
+            # other hosts and rides DCN; the intra-host remainder is an
+            # ICI exchange over the ici factor
+            return [
+                LinkPhase("all_to_all", DCN, dcn_axes, payload,
+                          (n_d - 1) / n_d),
+                LinkPhase("all_to_all", ICI, ici_axes, payload,
+                          (n_i - 1) / n_i),
+            ]
+        # unknown kind spanning hosts: conservatively price the whole
+        # payload on the slow link so the plan never under-counts DCN
+        return [LinkPhase(kind, DCN, axes, payload,
+                          ring("other", n_d * n_i) if factor is None
+                          else factor)]
+
+
+# ---------------------------------------------------------------------------
+# layout recommender
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RankedLayout:
+    """One enumerated axis→level assignment, priced against a step."""
+
+    assignment: Tuple[Tuple[str, str], ...]   # ((axis, level), ...)
+    topology: Topology
+    ici_bytes: float
+    dcn_bytes: float
+    comm_time_s: float
+
+    @property
+    def dcn_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a, lvl in self.assignment if lvl == DCN)
+
+    def describe(self) -> str:
+        dcn = ",".join(self.dcn_axes) or "<none>"
+        return (f"dcn={dcn:<12} comm {self.comm_time_s * 1e6:9.1f} µs  "
+                f"(ICI {self.ici_bytes / 1024:9.1f} KiB, "
+                f"DCN {self.dcn_bytes / 1024:9.1f} KiB)")
+
+
+def enumerate_topologies(mesh: Dict[str, int], hosts: int,
+                         chips_per_host: Optional[Tuple[int, ...]] = None
+                         ) -> List[Topology]:
+    """Every distinct axis→level assignment whose DCN product covers
+    ``hosts`` exactly.  Assignments where a DCN-pinned axis contributes
+    no DCN factor (gcd 1) duplicate a smaller subset and are skipped."""
+    mesh = {str(k): int(v) for k, v in mesh.items()}
+    if chips_per_host is None:
+        total = 1
+        for v in mesh.values():
+            total *= v
+        if total % hosts:
+            raise ValueError(
+                f"mesh {mesh} ({total} chips) is not divisible by "
+                f"{hosts} hosts")
+        chips_per_host = (total // hosts,)
+    axes = [a for a, s in mesh.items() if s > 1]
+    out: List[Topology] = []
+    seen = set()
+    for r in range(len(axes) + 1):
+        for subset in itertools.combinations(axes, r):
+            levels = {a: (DCN if a in subset else ICI) for a in axes}
+            topo = Topology(hosts=hosts, chips_per_host=chips_per_host,
+                            axis_levels=levels)
+            splits = topo.splits(mesh)
+            if any(splits[a][1] == 1 for a in subset):
+                continue  # a pinned axis got no DCN factor: degenerate
+            product = 1
+            for n_i, n_d in splits.values():
+                product *= n_d
+            if product != hosts:
+                continue
+            key = tuple(sorted((a, splits[a][1]) for a in subset))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(topo)
+    return out
+
+
+def rank_layouts(flat_collectives, mesh: Dict[str, int], chip,
+                 hosts: int,
+                 chips_per_host: Optional[Tuple[int, ...]] = None
+                 ) -> List[RankedLayout]:
+    """Reprice a step's *flat* collective inventory under every valid
+    axis→level assignment and rank by total comm time (ties: least DCN
+    bytes).  Repricing reuses the propagation result — no re-trace."""
+    from .xray import estimate_collective_time
+
+    ranked: List[RankedLayout] = []
+    for topo in enumerate_topologies(mesh, hosts, chips_per_host):
+        splits = topo.splits(mesh)
+        ici_b = dcn_b = time_s = 0.0
+        for c in flat_collectives:
+            pay = float(c.payload_bytes)
+            factor = (c.bytes_moved / pay
+                      if c.kind == "ppermute" and pay else None)
+            for ph in topo.phases(c.kind, c.axes, pay, mesh,
+                                  factor=factor):
+                moved = ph.payload_bytes * ph.factor
+                time_s += estimate_collective_time(
+                    moved, chip, level=ph.level) * c.count
+                if ph.level == DCN:
+                    dcn_b += moved * c.count
+                else:
+                    ici_b += moved * c.count
+        assignment = tuple(
+            (a, DCN if splits[a][1] > 1 else ICI)
+            for a in mesh if mesh[a] > 1)
+        ranked.append(RankedLayout(
+            assignment=assignment, topology=topo, ici_bytes=ici_b,
+            dcn_bytes=dcn_b, comm_time_s=time_s))
+    ranked.sort(key=lambda r: (r.comm_time_s, r.dcn_bytes,
+                               r.assignment))
+    return ranked
+
+
+def format_recommendations(ranked: Sequence[RankedLayout],
+                           top: int = 8) -> str:
+    """Ranked table for the CLI: best assignment first."""
+    rows = [f"{'rank':<6}{'dcn axes':<14}{'comm µs':>10}"
+            f"{'ICI KiB':>12}{'DCN KiB':>12}"]
+    for i, r in enumerate(ranked[:top]):
+        dcn = ",".join(r.dcn_axes) or "<none>"
+        rows.append(f"{i + 1:<6}{dcn:<14}"
+                    f"{r.comm_time_s * 1e6:>10.1f}"
+                    f"{r.ici_bytes / 1024:>12.1f}"
+                    f"{r.dcn_bytes / 1024:>12.1f}")
+    return "\n".join(rows)

@@ -116,7 +116,12 @@ class ChipProfile:
 # 100–200 Gbps NICs over 4 chips, v5p and v6e (Trillium) quote 400 Gbps
 # per host.  DCN latency is cross-host (order 10 us), an order of
 # magnitude above one ICI hop — the multi-host planner prices DCN edges
-# from these instead of needing another CHIPS schema change.
+# from these instead of needing another CHIPS schema change.  "cpu"'s
+# DCN, like its ICI, is loopback: an emulated multi-host topology on
+# one dev box crosses no real NIC, and CPU CI must classify the tiny
+# model the way the real chips would (compute-bound when the layout is
+# sane) while keeping DCN strictly slower than ICI so the level split
+# stays visible in every report.
 CHIPS: Dict[str, ChipProfile] = {
     "v4": ChipProfile("v4", 275e12, 1228e9, 32 << 30, 300e9, 1e-6,
                       6.25e9, 1e-5),
@@ -127,7 +132,7 @@ CHIPS: Dict[str, ChipProfile] = {
     "v6e": ChipProfile("v6e", 918e12, 1640e9, 32 << 30, 448e9, 1e-6,
                        12.5e9, 1e-5),
     "cpu": ChipProfile("cpu", 5e11, 50e9, 8 << 30, 200e9, 0.0,
-                       1e9, 5e-6),
+                       25e9, 2e-7),
 }
 
 
@@ -141,9 +146,15 @@ def estimate_compute_time(flops: float, bytes_moved: float,
 
 
 def estimate_collective_time(bytes_on_wire: float,
-                             chip: ChipProfile) -> float:
+                             chip: ChipProfile,
+                             level: str = "ici") -> float:
     """Time for one collective that puts ``bytes_on_wire`` on each
-    chip's ICI links (ring-formula bytes, computed by the caller)."""
+    chip's links (ring-formula bytes, computed by the caller).
+    ``level`` selects the link profile: ``"ici"`` (intra-host, the
+    default — single-host plans never say otherwise) or ``"dcn"``
+    (cross-host phases of a hierarchically decomposed collective)."""
+    if level == "dcn":
+        return bytes_on_wire / chip.dcn_bandwidth + chip.dcn_latency
     return bytes_on_wire / chip.ici_bandwidth + chip.ici_latency
 
 

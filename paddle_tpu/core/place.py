@@ -168,4 +168,7 @@ def is_compiled_with_cinn() -> bool:
 
 
 def device_count() -> int:
-    return len(jax.devices())
+    # paddle.device.cuda.device_count() is the count THIS process can
+    # place tensors on — under jax.distributed that is the local set,
+    # not the global fleet (H112)
+    return len(jax.local_devices())

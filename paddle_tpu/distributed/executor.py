@@ -129,7 +129,8 @@ class MeshExecutor:
     """
 
     def __init__(self, axes: Dict[str, int], *, layout: SpecLayout = None,
-                 devices: Sequence[Any] = None, register: bool = True):
+                 devices: Sequence[Any] = None, register: bool = True,
+                 topology=None):
         names = list(axes)
         sizes = [int(axes[k]) for k in names]
         if not names or any(s < 1 for s in sizes):
@@ -153,6 +154,11 @@ class MeshExecutor:
             np.asarray(devs[:need]).reshape(sizes), tuple(names))
         self.axes: Dict[str, int] = dict(zip(names, sizes))
         self.layout = layout if layout is not None else SpecLayout()
+        # analysis.Topology: makes every shard plan this executor
+        # requests price host-spanning collectives at DCN rates; the
+        # reconcile_* entry points then refuse to bless a single-host
+        # runtime against a multi-host-priced plan
+        self.topology = topology
         self.reports: Dict[str, Tuple[Any, List[Any]]] = {}
         self._replicated = NamedSharding(self.mesh, PartitionSpec())
         if register:
@@ -426,7 +432,28 @@ class MeshExecutor:
 
         return _shardplan.PlanRequest(mesh=dict(self.axes),
                                       layout=self.layout,
-                                      raise_on_error=False)
+                                      raise_on_error=False,
+                                      topology=self.topology)
+
+    def _check_plan_topology(self, plan) -> None:
+        """A plan priced for a multi-host Topology cannot be reconciled
+        against a single-host runtime: the DCN phases it prices do not
+        exist on this mesh, so S209 'agreement' would be meaningless.
+        Raise instead of silently blessing the wrong fleet shape."""
+        topo = getattr(plan, "topology", None)
+        if topo is None or int(topo.hosts) <= 1:
+            return
+        procs = jax.process_count()
+        if procs < int(topo.hosts):
+            raise RuntimeError(
+                f"shard plan was priced for a {topo.hosts}-host topology "
+                f"({topo.hosts} × {topo.chips_per_host_count} chips) but "
+                f"this runtime spans {procs} process(es) over "
+                f"{self.mesh.size} device(s) — the DCN collective phases "
+                "the plan prices cannot exist on a single-host mesh; "
+                f"launch under jax.distributed with {topo.hosts} "
+                "processes, or drop `topology` from the MeshExecutor / "
+                "PlanRequest to reconcile a single-host plan")
 
     def _reconcile_compiled(self, plan, compiled, *, name,
                             trailing_out_expect=None):
@@ -504,6 +531,7 @@ class MeshExecutor:
         steady-state entry is what gets audited).  Returns
         ``(PlanReport, [S209 diagnostics])``."""
         plan = model.shardplan(inputs, labels, request=self._plan_request())
+        self._check_plan_topology(plan)
         fn = model._train_step_fn
         sfn = getattr(fn, "_fn", fn)
         entries = [e for e in sfn._cache.values()
@@ -585,7 +613,10 @@ class MeshExecutor:
                  prefill_args, prefill_specs, (("chunk_ids", 0),))):
             plan = _shardplan.plan_step(
                 step, args, model=model, arg_specs=specs, request=req,
-                name=name, data_input_leaves=data_leaves)
+                name=name, data_input_leaves=data_leaves,
+                step_kind=("paged_decode" if "decode" in name
+                           else "chunked_prefill"))
+            self._check_plan_topology(plan)
             fn = step
             if hasattr(fn, "_fn") and hasattr(fn, "compiles"):
                 fn = fn._fn

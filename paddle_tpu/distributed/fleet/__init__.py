@@ -38,7 +38,9 @@ class _Fleet:
         hc = self.strategy.hybrid_configs
         import jax
 
-        n = len(jax.devices())
+        # hybrid degrees are WORLD degrees: the global device count is
+        # the intended denominator here, not the per-process one
+        n = len(jax.devices())  # lint-tpu: disable=H112
         dp = hc.get("dp_degree", 1) or 1
         mp = hc.get("mp_degree", 1) or 1
         pp = hc.get("pp_degree", 1) or 1

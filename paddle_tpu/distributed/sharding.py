@@ -335,7 +335,9 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
             "sharding_degree>1, or init_mesh({'dp': ..., 'sharding': ...}))"
             " before calling group_sharded_parallel")
     if mesh is None:
-        n = len(jax.devices())
+        # group-sharded state spans the WHOLE fleet: a global mesh over
+        # every process's devices is the intent, not a per-process one
+        n = len(jax.devices())  # lint-tpu: disable=H112
         if group is not None and getattr(group, "nranks", n) != n:
             raise ValueError(
                 f"group.nranks={group.nranks} != visible devices {n}: "

@@ -224,12 +224,14 @@ class Engine:
                 self._decode_step, decode_args, model=self.model,
                 arg_specs=decode_specs, request=req,
                 name="serving::decode_step",
-                data_input_leaves=(("tokens", 0),)),
+                data_input_leaves=(("tokens", 0),),
+                step_kind="paged_decode"),
             shardplan.plan_step(
                 self._prefill_step, prefill_args, model=self.model,
                 arg_specs=prefill_specs, request=req,
                 name="serving::prefill_step",
-                data_input_leaves=(("chunk_ids", 0),)),
+                data_input_leaves=(("chunk_ids", 0),),
+                step_kind="chunked_prefill"),
         ]
         errors = [d for r in reports for d in r.errors()]
         for r in reports:

@@ -286,8 +286,10 @@ def cuda_places(device_ids=None):
 
     from ..core.place import CUDAPlace
 
+    # a placement list is per-process: only local devices are
+    # addressable under jax.distributed (H112)
     ids = device_ids if device_ids is not None else range(
-        len(jax.devices()))
+        len(jax.local_devices()))
     return [CUDAPlace(i) for i in ids]
 
 

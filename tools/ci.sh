@@ -48,6 +48,30 @@ python tools/lint_tpu.py --shardplan --steps moe \
 python tools/lint_tpu.py --shardplan --steps ring \
   --mesh data=2,sp=2,tp=2 --fail-on-unplanned
 
+echo "== dcn plan (multi-host topology: hierarchical ICI/DCN pricing) =="
+# all five registered steps priced on an emulated 2-host x (2,2)
+# topology: host-crossing collectives decompose into ICI + DCN phases;
+# a DCN edge in a latency-critical step (S213), an avoidably-DCN hot
+# axis (S214), or an unhideable DCN phase (S215) at ERROR fails CI
+# (README: Multi-host planning)
+python tools/lint_tpu.py --shardplan --hosts 2 --chips-per-host 2,2 \
+  --fail-on-unplanned
+python tools/lint_tpu.py --shardplan --steps moe \
+  --mesh data=2,fsdp=2,expert=2 --hosts 2 --fail-on-unplanned
+python tools/lint_tpu.py --shardplan --steps ring \
+  --mesh data=2,sp=2,tp=2 --hosts 2 --fail-on-unplanned
+# the machine-readable report must stay parseable (consumed by fleet
+# dashboards); validate the JSON shape end to end
+python tools/lint_tpu.py --shardplan --hosts 2 --steps train --json \
+  | python -c "import json,sys; r=json.load(sys.stdin)[0]; \
+assert r['hosts'] == 2 and 'dcn' in r['wire_bytes'], r"
+
+echo "== hazard scan (H112 single-process device-count assumptions) =="
+# jax.device_count()/len(jax.devices()) in per-process code paths and
+# hardcoded chip counts in mesh constructors break under multi-host
+# launch; ERROR findings fail CI (README: Hazards)
+python tools/lint_tpu.py --hazards
+
 echo "== mesh execution (2x2x2 SPMD on forced host devices) =="
 # runtime MeshExecutor over an emulated 8-device host: train-loss parity
 # (2,2,2) vs (1,1,1), serving token parity vs generate() with tp=2, zero

@@ -8,6 +8,9 @@ Usage:
     python tools/lint_tpu.py --list-rules
     python tools/lint_tpu.py --xray [--hbm-budget-gib N] [--chip v5e]
     python tools/lint_tpu.py --shardplan [--mesh data=2,fsdp=2,tp=2]
+    python tools/lint_tpu.py --shardplan --hosts 2 [--dcn-axes tp]
+                             [--recommend] [--json]
+    python tools/lint_tpu.py --hazards [paths...]
 
 Exit status 1 when any unsuppressed ERROR-severity finding exists (the
 ``lint`` stage of tools/ci.sh gates on this).  Suppress with
@@ -27,6 +30,14 @@ llama SpecLayout through the same jaxprs on a simulated mesh (default
 data=2,fsdp=2,tp=2 — no devices required), prints the per-chip peak
 HBM and collective inventory, and fails on resharding conflicts
 (S205), comm-bound plans (S207), or a per-chip HBM budget breach.
+With ``--hosts N`` the same plan is priced for a multi-host topology:
+host-crossing collectives decompose into ICI + DCN phases and the
+S213/S214/S215 DCN diagnostics arm; ``--recommend`` prints the ranked
+axis->DCN layout table and ``--json`` emits the machine-readable
+per-step report.
+
+``--hazards`` scans source (no tracing) for H112 single-process
+device-count assumptions and exits 1 on ERROR findings.
 """
 import importlib.util
 import os
@@ -71,7 +82,30 @@ def _shardplan_main(argv):
                         help="exit non-zero if any collective in the "
                         "plan is unplanned (spec conflict), even when "
                         "no ERROR diagnostic fired")
+    parser.add_argument("--hosts", type=int, default=None,
+                        help="price the plan for a multi-host topology: "
+                        "N hosts, chips split evenly (mesh total / N per "
+                        "host); collectives crossing the host boundary "
+                        "decompose into ICI + DCN phases")
+    parser.add_argument("--chips-per-host", default=None,
+                        help="per-host chip grid, e.g. 2,2 (default: "
+                        "mesh total / hosts as a flat count)")
+    parser.add_argument("--dcn-axes", default=None,
+                        help="comma list of mesh axes pinned to the DCN "
+                        "link level (injection knob: --dcn-axes tp puts "
+                        "the tensor-parallel axis across hosts to "
+                        "exercise the S213/S214 gate)")
+    parser.add_argument("--recommend", action="store_true",
+                        help="print the ranked axis->DCN layout table "
+                        "per step (requires --hosts)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the per-step reports as a JSON list "
+                        "on stdout instead of the human tables")
     args = parser.parse_args(argv)
+    if (args.recommend or args.dcn_axes or args.chips_per_host) \
+            and not args.hosts:
+        parser.error("--recommend/--dcn-axes/--chips-per-host require "
+                     "--hosts N")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
@@ -103,29 +137,85 @@ def _shardplan_main(argv):
               else xray.CHIPS[args.chip].hbm_bytes)
     steps = (tuple(s.strip() for s in args.steps.split(",") if s.strip())
              if args.steps else shardplan.DEFAULT_AUDIT_STEPS)
+    topology = None
+    if args.hosts:
+        total = 1
+        for size in mesh.values():
+            total *= size
+        if args.chips_per_host:
+            chips = tuple(int(c) for c in args.chips_per_host.split(","))
+        else:
+            if total % args.hosts:
+                parser.error(f"mesh has {total} chips, not divisible "
+                             f"into {args.hosts} hosts")
+            chips = (total // args.hosts,)
+        levels = {}
+        if args.dcn_axes:
+            for axis in args.dcn_axes.split(","):
+                levels[axis.strip()] = "dcn"
+        topology = shardplan.Topology(
+            hosts=args.hosts, chips_per_host=chips, axis_levels=levels)
     reports = shardplan.audit_shardplan(
         chip=args.chip, hbm_budget_bytes=budget, mesh=mesh, layout=layout,
-        steps=steps)
+        steps=steps, topology=topology)
     n_err = 0
     n_unplanned = 0
     for r in reports:
-        print(r.summary())
-        print(r.table())
-        for d in r.diagnostics:
-            print(f"  {d}")
+        if not args.as_json:
+            print(r.summary())
+            print(r.table())
+            for d in r.diagnostics:
+                print(f"  {d}")
+            if args.recommend:
+                ranked = shardplan.recommend_layouts(r)
+                print(f"  layout recommendations — {r.name}:")
+                for line in shardplan.format_recommendations(
+                        ranked).splitlines():
+                    print(f"    {line}")
         n_err += len(r.errors())
         n_unplanned += sum(1 for c in r.collectives if not c.planned)
-    total_bytes = sum(c.total_bytes for r in reports
-                      for c in r.collectives)
-    print(f"lint-tpu --shardplan: {len(reports)} step(s), "
-          f"{int(total_bytes)} collective byte(s) on the wire, "
-          f"{sum(len(r.diagnostics) for r in reports)} diagnostic(s), "
-          f"{n_err} error(s), {n_unplanned} unplanned collective(s)")
+    if args.as_json:
+        import json
+        print(json.dumps([r.to_json() for r in reports], indent=2))
+    else:
+        total_bytes = sum(c.total_bytes for r in reports
+                          for c in r.collectives)
+        print(f"lint-tpu --shardplan: {len(reports)} step(s), "
+              f"{int(total_bytes)} collective byte(s) on the wire, "
+              f"{sum(len(r.diagnostics) for r in reports)} diagnostic(s), "
+              f"{n_err} error(s), {n_unplanned} unplanned collective(s)")
     if n_err:
         return 1
     if args.fail_on_unplanned and n_unplanned:
         return 1
     return 0
+
+
+def _hazards_main(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="source-level hazard scan: H112 single-process "
+        "device-count assumptions (jax.device_count() / len(jax."
+        "devices()) in per-process code paths, hardcoded chip counts "
+        "in mesh constructors)")
+    parser.add_argument("paths", nargs="*",
+                        default=["paddle_tpu", "examples"],
+                        help="files or directories to scan "
+                        "(default: paddle_tpu/ examples/)")
+    args = parser.parse_args(argv)
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    from paddle_tpu.analysis.hazards import (ERROR,
+                                             scan_device_count_assumptions)
+
+    findings = scan_device_count_assumptions(args.paths)
+    for d in findings:
+        print(f"  {d}")
+    n_err = sum(1 for d in findings if d.severity == ERROR)
+    print(f"lint-tpu --hazards: {len(args.paths)} path(s), "
+          f"{len(findings)} finding(s), {n_err} error(s)")
+    return 1 if n_err else 0
 
 
 def _xray_main(argv):
@@ -167,4 +257,6 @@ if __name__ == "__main__":
         sys.exit(_xray_main(args[1:]))
     if args and args[0] == "--shardplan":
         sys.exit(_shardplan_main(args[1:]))
+    if args and args[0] == "--hazards":
+        sys.exit(_hazards_main(args[1:]))
     sys.exit(_load_astlint().main(args))
