@@ -174,6 +174,16 @@ def _emit(result: dict):
     sys.stdout.flush()
 
 
+def _n_chips() -> int:
+    """Device count for per-chip normalization of serving headlines."""
+    try:
+        import jax
+
+        return max(1, len(jax.devices()))
+    except Exception:  # noqa: BLE001
+        return 1
+
+
 def _eager_overhead_us(n_ops: int = 1000):
     """Per-op eager-dispatch overhead: Tensor-path chained adds vs raw jnp
     (SURVEY §7 'eager-mode performance' hard part; the reference's hot
@@ -450,7 +460,9 @@ def _serving_bench(on_tpu: bool):
     eng.run_until_complete()
     dt = time.perf_counter() - t0
     tokens = eng.stats()["counters"]["tokens_generated"]
-    return round(tokens / dt, 1)
+    tps = tokens / dt
+    return round(tps, 1), {
+        "tokens_per_sec_per_chip": round(tps / _n_chips(), 1)}
 
 
 def _prefix_cache_bench(on_tpu: bool):
@@ -496,23 +508,28 @@ def _prefix_cache_bench(on_tpu: bool):
         # seeds the shared prefix (request 0's production role)
         eng.generate([prompts[0]], max_new_tokens=2)
         ttfts = []
+        t0 = time.perf_counter()
         for p in prompts[1:]:   # sequential: TTFT unpolluted by batching
             req = eng.submit(p, max_new_tokens=max_new)
             eng.run_until_complete()
             ttfts.append(
                 eng.metrics.requests[req.request_id].to_dict()["ttft_s"])
+        dt = time.perf_counter() - t0
         eng.pool.check_leaks()  # zero leak failures is part of the bar
-        return float(np.median(ttfts)), eng._prefill_step.compiles
+        tokens = eng.stats()["counters"]["tokens_generated"]
+        return (float(np.median(ttfts)), eng._prefill_step.compiles,
+                tokens / dt)
 
-    off_t, off_c = run(False)
-    on_t, on_c = run(True)
+    off_t, off_c, _ = run(False)
+    on_t, on_c, on_tps = run(True)
     ratio = off_t / on_t if on_t > 0 else float("inf")
     print(f"# prefix_cache: ttft_off={off_t * 1e3:.2f}ms "
           f"ttft_on={on_t * 1e3:.2f}ms speedup={ratio:.2f}x "
           f"prefill_compiles off={off_c} on={on_c} "
           f"(chunked: constant across all prompt lengths)",
           file=sys.stderr)
-    return round(ratio, 3)
+    return round(ratio, 3), {
+        "tokens_per_sec_per_chip": round(on_tps / _n_chips(), 1)}
 
 
 def _resilience_bench(on_tpu: bool):
@@ -708,17 +725,86 @@ def _overload_bench(on_tpu: bool):
                  if m.to_dict()["ttft_s"] is not None]
         p99 = float(np.percentile(ttfts, 99)) if ttfts else float("nan")
         return (c["goodput_tokens"], c["requests_shed"],
-                c["requests_shed"] / len(reqs), p99)
+                c["requests_shed"] / len(reqs), p99,
+                c["tokens_generated"])
 
-    g_off, shed_off, rate_off, p99_off = run(False)
-    g_on, shed_on, rate_on, p99_on = run(True)
+    g_off, shed_off, rate_off, p99_off, _ = run(False)
+    t_mid = time.perf_counter()
+    g_on, shed_on, rate_on, p99_on, tok_on = run(True)
+    dt_on = time.perf_counter() - t_mid
     assert shed_off == 0                 # nothing sheds with it off
     ratio = g_on / g_off if g_off > 0 else float("inf")
     print(f"# overload: goodput off={g_off} on={g_on} tokens "
           f"(ratio {ratio:.2f}x), shed rate off={rate_off:.2f} "
           f"on={rate_on:.2f}, p99 ttft off={p99_off * 1e3:.1f}ms "
           f"on={p99_on * 1e3:.1f}ms", file=sys.stderr)
-    return round(float(ratio), 3)
+    return round(float(ratio), 3), {
+        "tokens_per_sec_per_chip": round(
+            tok_on / dt_on / _n_chips(), 1)}
+
+
+def _paged_attn_bench(on_tpu: bool):
+    """BENCH_ONLY=paged_attn: fused vs scatter/gather paged-attention
+    decode (kernels/paged_attention).  Times the COMPILED paged decode
+    step — the whole serving TPOT unit — with the fused kernel pinned
+    on vs off, on identical shapes and pool state: same model, same
+    block tables, same mid-stream frontiers.  Reported value is the
+    fused decode step time (TPOT) in ms; the unfused time and the
+    speedup ride in the JSON line and print to stderr."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import make_paged_decode_step
+
+    if on_tpu:
+        cfg = LlamaConfig.tiny(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype="bfloat16")
+        B, bs, nbs, steps, warmup = 16, 32, 64, 50, 8
+    else:
+        cfg = LlamaConfig.tiny()
+        B, bs, nbs, steps, warmup = 4, 8, 8, 10, 2
+    nb = 1 + B * nbs        # block 0 reserved as the garbage block
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    kvh = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    dt_kv = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    pools = [(jnp.zeros((nb, bs, kvh, hd), dt_kv),
+              jnp.zeros((nb, bs, kvh, hd), dt_kv))
+             for _ in range(cfg.num_hidden_layers)]
+    bt = jnp.asarray(1 + np.arange(B * nbs).reshape(B, nbs), jnp.int32)
+    # mid-stream frontiers at 3/4 of max context: the gather/split-K
+    # sweep has real work, matching steady-state decode
+    ctx = (bs * nbs * 3) // 4
+    lengths = jnp.asarray(np.full(B, ctx), jnp.int32)
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(1, cfg.vocab_size, (B, 1)), jnp.int32)
+
+    def time_step(step):
+        jax.block_until_ready(step(tok, pools, bt, lengths)[0])  # compile
+        for _ in range(warmup):
+            jax.block_until_ready(step(tok, pools, bt, lengths)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            jax.block_until_ready(step(tok, pools, bt, lengths)[0])
+        return (time.perf_counter() - t0) / steps
+
+    t_unfused = time_step(make_paged_decode_step(model, fused=False))
+    t_fused = time_step(make_paged_decode_step(model, fused=True))
+    speedup = t_unfused / t_fused if t_fused > 0 else float("inf")
+    print(f"# paged_attn: decode step unfused={t_unfused * 1e3:.3f}ms "
+          f"fused={t_fused * 1e3:.3f}ms speedup={speedup:.2f}x "
+          f"(B={B}, ctx={ctx}, block_size={bs})", file=sys.stderr)
+    return round(t_fused * 1e3, 3), {
+        "unfused_tpot_ms": round(t_unfused * 1e3, 3),
+        "fused_vs_unfused_speedup": round(speedup, 3),
+        "tokens_per_sec_per_chip": round(B / t_fused / _n_chips(), 1)}
 
 
 def _moe_plan_bench(on_tpu):
@@ -786,11 +872,17 @@ def _run_single(which: str, on_tpu: bool):
            "mesh_train": _mesh_train_bench,
            "overload": _overload_bench,
            "moe_plan": _moe_plan_bench,
-           "dcn_plan": _dcn_plan_bench}
+           "dcn_plan": _dcn_plan_bench,
+           "paged_attn": _paged_attn_bench}
     metric, unit = _ONLY_METRICS[which]
     value = fns[which](on_tpu)
-    _emit({"metric": metric, "value": value, "unit": unit,
-           "vs_baseline": None})
+    extras = {}
+    if isinstance(value, tuple):
+        value, extras = value
+    out = {"metric": metric, "value": value, "unit": unit,
+           "vs_baseline": None}
+    out.update(extras)   # serving headlines: tokens_per_sec_per_chip &c.
+    _emit(out)
 
 
 def run_bench():
@@ -1039,7 +1131,10 @@ def run_bench():
         print(f"# bert dp bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     try:
-        extra["serve_llama_tokens_per_sec"] = _serving_bench(on_tpu)
+        serve_tps, serve_extras = _serving_bench(on_tpu)
+        extra["serve_llama_tokens_per_sec"] = serve_tps
+        extra["serve_llama_tokens_per_sec_per_chip"] = \
+            serve_extras["tokens_per_sec_per_chip"]
     except Exception as e:  # noqa: BLE001
         print(f"# serving bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1066,11 +1161,19 @@ _ONLY_METRICS = {
     "overload": ("overload_goodput_ratio", "x"),
     "moe_plan": ("moe_plan_comm_kib", "KiB"),
     "dcn_plan": ("dcn_plan_dcn_wire_kib", "KiB"),
+    "paged_attn": ("paged_attn_fused_tpot_ms", "ms"),
 }
 
 
 def main():
     import os
+
+    if "--retune" in sys.argv[1:] or \
+            os.environ.get("BENCH_RETUNE", "") in ("1", "true", "True"):
+        # autotune escape hatch: ignore cached tile winners and
+        # re-measure once (kernels/autotune reads this env switch, so
+        # no paddle_tpu import is needed before the backend probe)
+        os.environ["PADDLE_TPU_RETUNE"] = "1"
 
     only = os.environ.get("BENCH_ONLY", "")
     try:

@@ -20,7 +20,15 @@ of timing out after burning prefill — then injects a hung decode step
 the watchdog detects and retries, and asserts the engine recovers to
 ``SERVING`` with zero retraces.
 
-Run:  python examples/serve_llama.py [--prefix-cache | --overload-chaos]
+With ``--fused`` (the CI fused-kernels stage) the demo runs the same
+staggered workload through TWO engines — fused serving kernels forced
+on (``ServingConfig(fused_kernels=True)``: fused paged-attention decode
++ RMSNorm→matmul epilogues, the XLA fallback off-TPU) and forced off —
+and asserts token-for-token identical outputs, agreement with plain
+``generate()``, and zero retraces on the fused steps.
+
+Run:  python examples/serve_llama.py
+          [--prefix-cache | --overload-chaos | --fused]
 """
 import argparse
 
@@ -154,6 +162,50 @@ def overload_chaos_demo(model):
     print("overload chaos: shed + stall recovery OK, zero retraces")
 
 
+def fused_demo(model):
+    from paddle_tpu.models.generation import generate
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+               for L in (3, 8, 5, 12, 4, 9, 6, 7)]
+    max_new = 16
+
+    outs = {}
+    engines = {}
+    for label, fused in (("fused", True), ("unfused", False)):
+        eng = Engine(model, ServingConfig(max_batch_size=4, block_size=8,
+                                          num_blocks=64,
+                                          fused_kernels=fused))
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run_until_complete()
+        outs[label] = [r.output_ids()[r.prompt_len:].tolist()
+                       for r in reqs]
+        engines[label] = eng
+
+    for i, (f, u) in enumerate(zip(outs["fused"], outs["unfused"])):
+        assert f == u, f"request {i}: fused {f} != unfused {u}"
+    print(f"token parity: {len(prompts)} requests, fused == unfused")
+
+    # the fused engine must also agree with plain generate() — the
+    # whole-sequence reference path with no paging at all
+    for i, prompt in enumerate(prompts[:3]):
+        ref = generate(model, paddle.to_tensor(prompt[None, :]),
+                       max_new_tokens=max_new)
+        ref_new = np.asarray(ref.numpy() if hasattr(ref, "numpy")
+                             else ref)[0, len(prompt):].tolist()
+        assert outs["fused"][i] == ref_new, \
+            f"request {i}: fused {outs['fused'][i]} != generate {ref_new}"
+    print("token parity: fused engine == generate() reference")
+
+    for label, eng in engines.items():
+        assert eng._decode_step.retraces == 0, label
+        assert eng._prefill_step.retraces == 0, label
+        assert eng.decode_cache_size() == 1, label
+        eng.pool.check_leaks()
+    print("fused serving: zero retraces, one compiled decode "
+          "executable per engine")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prefix-cache", action="store_true",
@@ -162,6 +214,10 @@ def main():
     ap.add_argument("--overload-chaos", action="store_true",
                     help="seeded burst + injected stall: load shedding, "
                          "watchdog retry, recovery to SERVING")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused serving kernels forced on vs off: "
+                         "token parity, generate() agreement, zero "
+                         "retraces")
     args = ap.parse_args()
 
     paddle.seed(0)
@@ -171,6 +227,8 @@ def main():
         prefix_cache_demo(model)
     elif args.overload_chaos:
         overload_chaos_demo(model)
+    elif args.fused:
+        fused_demo(model)
     else:
         staggered_demo(model)
 

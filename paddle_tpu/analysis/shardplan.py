@@ -497,6 +497,15 @@ class _Planner:
         handler = _RULES.get(name)
         if handler is not None:
             handler(self, eqn, mul)
+        elif name == "pallas_call":
+            # a priced LEAF, not a call: its params carry a "jaxpr" (the
+            # per-block kernel body), but walking that would misread
+            # one grid cell as the whole op — and its internal grid axes
+            # must never read as unknown collectives (S210).  The fused
+            # serving kernels run unsharded (models/llama.py falls back
+            # to the gather path under a live mesh), so outputs
+            # replicate and no wire traffic is emitted.
+            self._default_specs_only(eqn)
         elif name in ("cond", "while", "scan", "pjit") or \
                 "jaxpr" in eqn.params or "call_jaxpr" in eqn.params \
                 or "fun_jaxpr" in eqn.params:
@@ -555,6 +564,14 @@ class _Planner:
                                  _aval_bytes(out.aval),
                                  eqn.primitive.name, mul)
             self.set_spec(out, final)
+
+    def _default_specs_only(self, eqn):
+        """Replicated outputs, zero emitted traffic — for opaque priced
+        leaves (pallas_call) whose operands the planner must not try to
+        reshard through broadcast rules."""
+        for out in eqn.outvars:
+            rank = len(tuple(getattr(out.aval, "shape", ()) or ()))
+            self.set_spec(out, _rep(rank))
 
     def _match_specs(self, outer_vars, inner_vars, outer_to_inner: bool):
         """Shape-aware pairing for call-like eqns: equal shapes copy the
@@ -1614,7 +1631,8 @@ def audit_shardplan(*, chip: str = "cpu",
     from .xray import _serving_abstract_args
 
     net.eval()
-    if "decode" in steps or "prefill" in steps:
+    serving_kinds = {"decode", "prefill", "fused_decode", "fused_prefill"}
+    if serving_kinds & set(steps):
         decode_args, prefill_args = _serving_abstract_args(
             net, batch=4, num_blocks=32, block_size=8,
             max_blocks_per_seq=8, chunk_tokens=32)
@@ -1632,6 +1650,24 @@ def audit_shardplan(*, chip: str = "cpu",
                 make_chunked_prefill_step(net), prefill_args, model=net,
                 arg_specs=prefill_specs, request=req,
                 name="serving::prefill_step",
+                data_input_leaves=(("chunk_ids", 0),),
+                step_kind="chunked_prefill"))
+        # fused serving steps (kernels/fusion forced on, XLA fallback
+        # off-TPU): same shapes and latency-critical step kinds as the
+        # unfused plans — the CI gate that the fused programs plan
+        # without S210 unknown-collective blind spots
+        if "fused_decode" in steps:
+            reports.append(plan_step(
+                make_paged_decode_step(net, fused=True), decode_args,
+                model=net, arg_specs=decode_specs, request=req,
+                name="serving::decode_step[fused]",
+                data_input_leaves=(("tokens", 0),),
+                step_kind="paged_decode"))
+        if "fused_prefill" in steps:
+            reports.append(plan_step(
+                make_chunked_prefill_step(net, fused=True), prefill_args,
+                model=net, arg_specs=prefill_specs, request=req,
+                name="serving::prefill_step[fused]",
                 data_input_leaves=(("chunk_ids", 0),),
                 step_kind="chunked_prefill"))
 

@@ -51,6 +51,7 @@ lands against a verifier that already exists.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -216,6 +217,13 @@ def _sub_jaxprs(eqn):
     liveness takes the max transient)."""
     name = eqn.primitive.name
     params = eqn.params
+    if name == "pallas_call":
+        # a priced LEAF, not a call: the kernel body jaxpr under
+        # params["jaxpr"] is per-BLOCK code — walking it would charge
+        # one grid cell as if it were the whole op.  The kernel's cost
+        # comes from the kernels.costs registry (or its own
+        # CostEstimate) in _eqn_flops/_eqn_bytes.
+        return []
     if name == "cond":
         return [(b.jaxpr, 1) for b in params["branches"]]
     if name == "while":
@@ -267,8 +275,38 @@ def _conv_flops(eqn) -> float:
     return 2.0 * _elems(out) * per_out
 
 
+def _pallas_kernel_name(eqn) -> str:
+    """The kernel's ``name=`` as it appears in the cost registry."""
+    info = eqn.params.get("name_and_src_info")
+    name = getattr(info, "name", None) or eqn.params.get("name")
+    return str(name) if name else "unnamed"
+
+
+def _pallas_cost(eqn):
+    """Registered KernelCost for a pallas_call eqn, else the kernel's
+    own CostEstimate param, else None (generic pricing)."""
+    from ..kernels.costs import price_eqn_avals
+
+    in_avals = [(tuple(v.aval.shape), str(v.aval.dtype))
+                for v in eqn.invars
+                if not isinstance(v, jax.core.Literal)]
+    out_avals = [(tuple(v.aval.shape), str(v.aval.dtype))
+                 for v in eqn.outvars]
+    cost = price_eqn_avals(_pallas_kernel_name(eqn), in_avals, out_avals)
+    if cost is not None:
+        return cost
+    est = eqn.params.get("cost_estimate")
+    if est is not None and getattr(est, "bytes_accessed", 0):
+        return est
+    return None
+
+
 def _eqn_flops(eqn) -> float:
     name = eqn.primitive.name
+    if name == "pallas_call":
+        cost = _pallas_cost(eqn)
+        if cost is not None:
+            return float(cost.flops)
     if name == "dot_general":
         return _dot_flops(eqn)
     if name == "conv_general_dilated":
@@ -292,6 +330,13 @@ def _eqn_flops(eqn) -> float:
 
 
 def _eqn_bytes(eqn) -> float:
+    if eqn.primitive.name == "pallas_call":
+        cost = _pallas_cost(eqn)
+        if cost is not None:
+            # the registered/declared traffic model — e.g. paged decode
+            # reads the pool THROUGH the block table, so its bytes are
+            # the gathered context, not the whole pool operand
+            return float(cost.bytes_accessed)
     return float(sum(_var_bytes(v) for v in eqn.invars)
                  + sum(_var_bytes(v) for v in eqn.outvars))
 
@@ -322,7 +367,12 @@ def _collect_costs(jaxpr, mul: float, acc: Dict[str, List[float]]):
                 for inner, m in subs:
                     _collect_costs(inner, mul * m, acc)
             continue
-        cur = acc.setdefault(eqn.primitive.name, [0.0, 0.0, 0.0])
+        key = eqn.primitive.name
+        if key == "pallas_call":
+            # per-kernel row so the fused steps read as their kernels,
+            # not one anonymous pallas bucket
+            key = f"pallas_call:{_pallas_kernel_name(eqn)}"
+        cur = acc.setdefault(key, [0.0, 0.0, 0.0])
         cur[0] += mul * _eqn_flops(eqn)
         cur[1] += mul * _eqn_bytes(eqn)
         cur[2] += mul
@@ -855,12 +905,19 @@ def _serving_abstract_args(model, *, batch, num_blocks, block_size,
 
 
 def audit_default_steps(*, chip: str = "cpu",
-                        hbm_budget_bytes: Optional[int] = None
+                        hbm_budget_bytes: Optional[int] = None,
+                        fused: bool = False
                         ) -> List[ProgramReport]:
     """Build tiny Llama models and X-ray all five default step kinds
     (train, paged decode, chunked prefill, MoE block, ring/sp block) —
     the ``lint_tpu.py --xray`` / CI entry point.  Returns the reports;
-    callers gate on ``report.errors()``."""
+    callers gate on ``report.errors()``.
+
+    ``fused=True`` additionally audits the FUSED serving steps
+    (``serving::decode_step[fused]`` / ``serving::prefill_step[fused]``,
+    forced via models.generation's ``fused=True`` so the programs carry
+    the fused kernels even off-TPU) — the ``lint_tpu.py --xray --fused``
+    / CI gate that the pallas_call leaves price cleanly."""
     import paddle_tpu as paddle
     from .. import nn
     from ..models import LlamaConfig, LlamaForCausalLM
@@ -895,6 +952,41 @@ def audit_default_steps(*, chip: str = "cpu",
         make_chunked_prefill_step(net), prefill_args,
         name="serving::prefill_step", chip=chip,
         hbm_budget_bytes=hbm_budget_bytes))
+    if fused:
+        reports.append(analyze(
+            make_paged_decode_step(net, fused=True), decode_args,
+            name="serving::decode_step[fused]", chip=chip,
+            hbm_budget_bytes=hbm_budget_bytes))
+        reports.append(analyze(
+            make_chunked_prefill_step(net, fused=True), prefill_args,
+            name="serving::prefill_step[fused]", chip=chip,
+            hbm_budget_bytes=hbm_budget_bytes))
+        # off-TPU the fused steps lower to the XLA fallback, so ALSO
+        # audit the decode kernel itself in interpret mode — this is
+        # the gate that a real pallas_call leaf prices through the
+        # kernels.costs registry on any backend
+        from ..kernels.paged_attention import fused_paged_decode
+
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        kvh = cfg.num_key_value_heads
+        f32 = np.float32
+        sds32 = jax.ShapeDtypeStruct
+        kernel_args = (
+            sds32((4, 1, cfg.num_attention_heads, hd), f32),    # q
+            sds32((4, 1, kvh, hd), f32),                        # k_new
+            sds32((4, 1, kvh, hd), f32),                        # v_new
+            sds32((32, 8, kvh, hd), f32),                       # k_pool
+            sds32((32, 8, kvh, hd), f32),                       # v_pool
+            sds32((4, 8), np.int32),                            # table
+            sds32((4,), np.int32),                              # pos
+            sds32((cfg.max_position_embeddings, hd // 2), f32),  # cos
+            sds32((cfg.max_position_embeddings, hd // 2), f32),  # sin
+        )
+        reports.append(analyze(
+            functools.partial(fused_paged_decode, use_pallas=True,
+                              interpret=True),
+            kernel_args, name="kernel::fused_paged_decode", chip=chip,
+            hbm_budget_bytes=hbm_budget_bytes))
 
     from ..distributed.mesh import abstract_mesh
     from ..models.generation import make_moe_block_step, make_ring_sp_step

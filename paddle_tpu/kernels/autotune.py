@@ -8,6 +8,12 @@ in memory for the process and as JSON on disk across processes.
 
 Gated by FLAGS_use_autotune (core/flags); without it callers use their
 static defaults and never pay the search.
+
+Cache keys are CHIP-QUALIFIED: the same op/shape tunes differently on
+v5e vs v6e vs the CPU fallback, so the accelerator kind is stamped
+into every key.  ``--retune`` (bench.py) or PADDLE_TPU_RETUNE=1 is the
+escape hatch: cached winners are ignored and re-measured once, then
+the fresh result overwrites the disk cache.
 """
 from __future__ import annotations
 
@@ -19,6 +25,35 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 _mem_cache: Dict[str, Any] = {}
 _disk_loaded = False
 _dirty = False
+_chip_name: Optional[str] = None
+_retune = False
+
+
+def _chip() -> str:
+    """Accelerator kind for the cache key (e.g. ``TPU_v5e`` or
+    ``cpu``) — resolved once; device enumeration is not free."""
+    global _chip_name
+    if _chip_name is None:
+        try:
+            import jax
+
+            kind = jax.devices()[0].device_kind
+            _chip_name = str(kind).strip().replace(" ", "_") or \
+                jax.default_backend()
+        except Exception:
+            _chip_name = "unknown"
+    return _chip_name
+
+
+def set_retune(enabled: bool):
+    """Ignore cached winners and re-measure (bench --retune)."""
+    global _retune
+    _retune = bool(enabled)
+
+
+def retune_enabled() -> bool:
+    return _retune or os.environ.get("PADDLE_TPU_RETUNE", "") in (
+        "1", "true", "True")
 
 
 def _cache_path() -> str:
@@ -48,9 +83,14 @@ def _save_disk():
     try:
         path = _cache_path()
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # atomic publish (resilience tmp+fsync+rename idiom): a reader
+        # racing this write sees either the old cache or the new one,
+        # never a torn JSON file
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             json.dump(_mem_cache, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
         _dirty = False
     except Exception:
@@ -58,7 +98,9 @@ def _save_disk():
 
 
 def cache_key(op: str, *parts) -> str:
-    return f"{op}|" + "|".join(str(p) for p in parts)
+    """(chip, op, shape-key) — the chip prefix keeps one shared disk
+    cache correct across accelerator generations."""
+    return f"{_chip()}|{op}|" + "|".join(str(p) for p in parts)
 
 
 def autotune(op: str, key_parts: Iterable,
@@ -75,7 +117,7 @@ def autotune(op: str, key_parts: Iterable,
     _load_disk()
     key = cache_key(op, *key_parts)
     hit = _mem_cache.get(key)
-    if hit is not None:
+    if hit is not None and not retune_enabled():
         return tuple(hit)
 
     best, best_t = None, float("inf")

@@ -1,0 +1,129 @@
+"""Per-kernel FLOP/byte cost annotations for the analysis layer.
+
+A ``pallas_call`` is opaque to the jaxpr walkers: its inner jaxpr is
+written in BLOCK shapes, so recursing into it multiplies every cost by
+the grid and the analyzers either over-count wildly or fall back to an
+elementwise guess.  Kernels instead register a cost function here,
+keyed on the ``name=`` they pass to ``pl.pallas_call`` — ``xray``
+prices the equation through the registry and ``shardplan`` treats the
+call as a priced leaf instead of an unknown.
+
+Entries are VALIDATED AT REGISTRATION: the cost function is evaluated
+on a representative sample of abstract operands and the result is
+checked (flops >= 0, bytes > 0, a transcendental count >= 0, dtype
+names that resolve) so a bad annotation fails loudly at import time,
+not as a silently-wrong roofline three layers up.
+
+No jax import here — the registry must stay importable from analysis
+code paths that refuse heavy imports.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCost:
+    """One kernel invocation's priced cost.
+
+    flops / bytes_accessed cover the WHOLE call (all grid steps);
+    transcendentals counts exp/log/rsqrt-class element ops, weighted by
+    the analyzers the same way jaxpr transcendentals are.  ``dtype`` is
+    the accumulation dtype name, recorded for the roofline breakdown.
+    """
+
+    flops: float
+    bytes_accessed: float
+    transcendentals: float = 0.0
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if not (self.flops >= 0.0):
+            raise ValueError(
+                f"KernelCost.flops must be >= 0, got {self.flops!r}")
+        if not (self.bytes_accessed > 0.0):
+            raise ValueError(
+                "KernelCost.bytes_accessed must be > 0 (every kernel "
+                f"touches memory), got {self.bytes_accessed!r}")
+        if not (self.transcendentals >= 0.0):
+            raise ValueError(
+                "KernelCost.transcendentals must be >= 0, got "
+                f"{self.transcendentals!r}")
+        try:
+            np.dtype(self.dtype)
+        except TypeError as e:
+            raise ValueError(
+                f"KernelCost.dtype {self.dtype!r} is not a dtype "
+                f"name numpy recognises") from e
+
+
+#: abstract operand passed to cost functions: (shape tuple, dtype name)
+AbstractArg = Tuple[Tuple[int, ...], str]
+
+CostFn = Callable[[Sequence[AbstractArg], Sequence[AbstractArg]],
+                  KernelCost]
+
+_REGISTRY: Dict[str, CostFn] = {}
+
+
+def register_kernel_cost(name: str, fn: CostFn, *,
+                         sample_in: Sequence[AbstractArg],
+                         sample_out: Sequence[AbstractArg]) -> CostFn:
+    """Register ``fn`` as the cost model for pallas kernels named
+    ``name`` (the ``pl.pallas_call(..., name=...)`` string).
+
+    ``sample_in`` / ``sample_out`` are representative abstract operands
+    the function is evaluated on RIGHT NOW — a cost function that
+    raises, or returns something other than a valid KernelCost, fails
+    here at import time instead of producing a silent garbage roofline.
+    """
+    if not name or not isinstance(name, str):
+        raise ValueError(f"kernel cost name must be a non-empty string, "
+                         f"got {name!r}")
+    probe = fn(tuple(sample_in), tuple(sample_out))
+    if not isinstance(probe, KernelCost):
+        raise TypeError(
+            f"cost fn for kernel {name!r} returned {type(probe).__name__}, "
+            f"expected KernelCost")
+    _REGISTRY[name] = fn
+    return fn
+
+
+def lookup_kernel_cost(name: str) -> Optional[CostFn]:
+    return _REGISTRY.get(name)
+
+
+def registered_kernels() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def price_eqn_avals(name: str,
+                    in_avals: Sequence[AbstractArg],
+                    out_avals: Sequence[AbstractArg]
+                    ) -> Optional[KernelCost]:
+    """Price one pallas_call occurrence; None when the kernel has no
+    registered annotation (caller falls back to its generic guess)."""
+    fn = _REGISTRY.get(name)
+    if fn is None:
+        return None
+    return fn(tuple(in_avals), tuple(out_avals))
+
+
+def _np_bytes(aval: AbstractArg) -> float:
+    shape, dtype = aval
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return float(n) * np.dtype(dtype).itemsize
+
+
+def io_bytes(in_avals: Sequence[AbstractArg],
+             out_avals: Sequence[AbstractArg]) -> float:
+    """Sum of operand + result bytes — the natural bytes_accessed for a
+    single-pass kernel (each operand read once, each output written
+    once; that is the whole point of fusing)."""
+    return (sum(_np_bytes(a) for a in in_avals)
+            + sum(_np_bytes(a) for a in out_avals))

@@ -1,0 +1,64 @@
+"""Serving-fusion mode: one switch for the fused decode hot path.
+
+The fused paged-attention decode kernel and the RMSNorm->matmul
+epilogue fusions change WHICH program the model traces to, so the
+decision must be made at trace time and must be consistent for the
+lifetime of a compiled step (the zero-retrace contract).  The step
+builders in models/generation.py resolve the mode ONCE per step and
+pin it around the traced body with ``serving_fusion(...)``; the model
+code consults ``fusion_enabled()`` wherever the fused and unfused
+paths fork.
+
+Resolution order:
+  1. an active ``serving_fusion(...)`` context (the step builders);
+  2. else the default: FLAGS_use_fused_serving AND a TPU backend.
+
+On CPU the fused path lowers to the numerically-identical XLA
+fallback, so forcing it on (``serving_fusion(True)`` /
+``ServingConfig(fused_kernels=True)``) is how tier-1 and CI cover the
+exact fused math without a TPU.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_tls = threading.local()
+
+
+def _default_enabled() -> bool:
+    from ..core.flags import flag
+
+    return bool(flag("use_fused_serving")) and \
+        jax.default_backend() == "tpu"
+
+
+def fusion_enabled() -> bool:
+    """The trace-time fused/unfused fork the model code consults."""
+    override = getattr(_tls, "override", None)
+    if override is not None:
+        return bool(override)
+    return _default_enabled()
+
+
+def resolve_serving_fusion(fused=None) -> bool:
+    """Pin a step's fusion mode: an explicit request wins, else the
+    flag/backend default.  Called once per step build so the compiled
+    program never flips mode between calls."""
+    if fused is None:
+        return _default_enabled()
+    return bool(fused)
+
+
+@contextlib.contextmanager
+def serving_fusion(enabled: bool):
+    """Force the fusion mode for the duration (used around traced step
+    bodies; runs at trace time, costs nothing per executed step)."""
+    prev = getattr(_tls, "override", None)
+    _tls.override = bool(enabled)
+    try:
+        yield
+    finally:
+        _tls.override = prev

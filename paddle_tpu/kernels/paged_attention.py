@@ -1,0 +1,462 @@
+"""Fused paged-attention decode kernel (Pallas) with an XLA fallback.
+
+The serving decode hot path was four separate HBM round trips per
+layer: rotate q/k (RoPE), scatter the new k/v into the block pool,
+gather every sequence's blocks back out, then run masked softmax
+attention over the gathered copy.  This module fuses the gather + q
+RoPE + attention into ONE Pallas kernel: the block table rides in as a
+scalar-prefetch operand, so each grid step DMAs exactly one KV block
+straight from the pool — the gathered [B, L, H, D] context copy never
+exists in HBM.
+
+Flash-decoding split-K: the context pages are divided into
+``num_splits`` independent chunks.  Each (batch, split) cell produces
+an UNNORMALIZED partial — running max ``m``, exp-sum ``l`` and
+accumulator ``acc`` — and the chunks are combined afterwards with the
+standard log-sum-exp merge.  Splits are parallel grid cells, so one
+128k-context straggler occupies ``num_splits`` cells instead of
+serializing its whole context behind everyone else's decode step.
+
+Numerics contract: ``_xla_partials`` + ``_combine_splits`` is the
+SAME split-K math in plain XLA ops (identical masking semantics, f32
+accumulation, identical combine code object).  On CPU the fused path
+lowers through it, so tier-1 and the jaxpr audits cover the exact
+fused-step math with no pallas_call in the program.  The unfused
+reference (``paged_decode_reference``) reproduces models/llama.py's
+scatter/gather path for parity tests.
+
+``num_splits`` is autotuned (FLAGS_use_autotune) through
+kernels/autotune keyed on (chip, head_dim, kv_block_size,
+max_blocks_per_seq, dtype) and persisted to the JSON cache.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from .costs import KernelCost, register_kernel_cost
+
+KERNEL_NAME = "fused_paged_decode"
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _rotate_half(x, c, s):
+    """Rotate-half RoPE, matching models/llama.py apply_rope: c/s carry
+    the per-position cos/sin rows broadcast against x's last dim."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _scatter_token(pool, new, block_table, positions):
+    """Write one token per sequence into its pool slot — the T == 1
+    case of models/llama.py's ``_scatter`` (same index math, same
+    column clamp)."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    nbs = block_table.shape[1]
+    rows = jnp.arange(block_table.shape[0])
+    col = jnp.minimum(positions // bs, nbs - 1)
+    idx = block_table[rows, col] * bs + positions % bs          # [B]
+    flat = pool.reshape(nb * bs, pool.shape[2], pool.shape[3])
+    flat = flat.at[idx].set(new.astype(pool.dtype))
+    return flat.reshape(pool.shape)
+
+
+# ---------------------------------------------------------------------------
+# split-K partials: Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(bt_ref, pos_ref, q_ref, cos_ref, sin_ref, k_ref, v_ref,
+                   o_ref, m_out_ref, l_out_ref,
+                   qrot_ref, acc_ref, m_ref, l_ref, *, bs, pages_per_split,
+                   scale):
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        # rotate + pre-scale q once per (batch, split) cell: RoPE lives
+        # inside the kernel, and folding 1/sqrt(D) into q here keeps the
+        # score math a bare dot
+        qv = q_ref[0].astype(jnp.float32)               # [KVH, rep, D]
+        c = cos_ref[0].astype(jnp.float32)              # [half]
+        sn = sin_ref[0].astype(jnp.float32)
+        qrot_ref[:] = _rotate_half(qv, c, sn) * scale
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # one gathered KV block: [bs, KVH, D] -> [KVH, bs, D]
+    kb = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)
+    vb = jnp.swapaxes(v_ref[0].astype(jnp.float32), 0, 1)
+
+    scores = jax.lax.dot_general(
+        qrot_ref[:], kb, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)             # [KVH, rep, bs]
+
+    page = s * pages_per_split + p                      # logical page
+    k_pos = page * bs + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 2)
+    scores = jnp.where(k_pos <= pos_ref[b], scores, NEG_INF)
+
+    m_cur = jnp.max(scores, axis=-1, keepdims=True)     # [KVH, rep, 1]
+    m_new = jnp.maximum(m_ref[:], m_cur)
+    alpha = jnp.exp(m_ref[:] - m_new)
+    pexp = jnp.exp(scores - m_new)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        pexp, vb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)             # [KVH, rep, D]
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+
+    @pl.when(p == pages_per_split - 1)
+    def _emit():
+        o_ref[0, 0] = acc_ref[:]
+        # per-row scalars broadcast over the lane dim (flash kernel lse
+        # idiom: a 1-wide trailing dim is not a legal TPU output tile)
+        m_out_ref[0, 0] = jnp.broadcast_to(m_ref[:], m_out_ref.shape[2:])
+        l_out_ref[0, 0] = jnp.broadcast_to(l_ref[:], l_out_ref.shape[2:])
+
+
+def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
+                     block_table, positions, num_splits, scale, interpret):
+    """q: UNROTATED [B, KVH, rep, D]; returns (acc [B,S,KVH,rep,D] f32,
+    m [B,S,KVH,rep] f32, l [B,S,KVH,rep] f32)."""
+    B, KVH, rep, D = q.shape
+    bs = k_pool.shape[1]
+    nbs = block_table.shape[1]
+    P = nbs // num_splits
+    half = D // 2
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, num_splits, P),
+        in_specs=[
+            pl.BlockSpec((1, KVH, rep, D),
+                         lambda b, s, p, bt, pos: (b, 0, 0, 0)),
+            pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
+            pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
+            pl.BlockSpec((1, bs, KVH, D),
+                         lambda b, s, p, bt, pos, _P=P:
+                         (bt[b, s * _P + p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, KVH, D),
+                         lambda b, s, p, bt, pos, _P=P:
+                         (bt[b, s * _P + p], 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, KVH, rep, D),
+                         lambda b, s, p, bt, pos: (b, s, 0, 0, 0)),
+            pl.BlockSpec((1, 1, KVH, rep, _LANES),
+                         lambda b, s, p, bt, pos: (b, s, 0, 0, 0)),
+            pl.BlockSpec((1, 1, KVH, rep, _LANES),
+                         lambda b, s, p, bt, pos: (b, s, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((KVH, rep, D), jnp.float32),
+            pltpu.VMEM((KVH, rep, D), jnp.float32),
+            pltpu.VMEM((KVH, rep, 1), jnp.float32),
+            pltpu.VMEM((KVH, rep, 1), jnp.float32),
+        ],
+    )
+    L = nbs * bs
+    H = KVH * rep
+    esize = jnp.dtype(k_pool.dtype).itemsize
+    acc, m_b, l_b = pl.pallas_call(
+        functools.partial(_decode_kernel, bs=bs, pages_per_split=P,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, num_splits, KVH, rep, D),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, num_splits, KVH, rep, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((B, num_splits, KVH, rep, _LANES),
+                                 jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not interpret) else None,
+        cost_estimate=pl.CostEstimate(
+            flops=4.0 * B * H * D * L,
+            bytes_accessed=float(2 * B * L * KVH * D * esize),
+            transcendentals=float(B * H * L)),
+        interpret=interpret,
+        name=KERNEL_NAME,
+    )(block_table, positions, q, cos_b, sin_b, k_pool, v_pool)
+    return acc, m_b[..., 0], l_b[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# split-K partials: numerically-identical XLA lowering
+# ---------------------------------------------------------------------------
+
+def _xla_partials(q_rot, k_pool, v_pool, block_table, positions,
+                  num_splits):
+    """Same split-K partials in plain XLA: q_rot is the ROTATED and
+    pre-scaled [B, KVH, rep, D] f32 query (scale folded in, exactly as
+    the kernel does at p == 0)."""
+    B = q_rot.shape[0]
+    bs = k_pool.shape[1]
+    nbs = block_table.shape[1]
+    Lp = (nbs // num_splits) * bs                       # keys per split
+    kb = k_pool[block_table].astype(jnp.float32)        # [B,nbs,bs,KVH,D]
+    vb = v_pool[block_table].astype(jnp.float32)
+    kb = kb.reshape(B, num_splits, Lp, kb.shape[3], kb.shape[4])
+    vb = vb.reshape(B, num_splits, Lp, vb.shape[3], vb.shape[4])
+    scores = jnp.einsum("bkrd,bslkd->bskrl", q_rot, kb,
+                        preferred_element_type=jnp.float32)
+    k_pos = jnp.arange(nbs * bs).reshape(num_splits, Lp)
+    valid = k_pos[None, :, None, None, :] <= \
+        positions[:, None, None, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                        # [B,S,KVH,rep]
+    pexp = jnp.exp(scores - m[..., None])
+    l = jnp.sum(pexp, axis=-1)
+    acc = jnp.einsum("bskrl,bslkd->bskrd", pexp, vb,
+                     preferred_element_type=jnp.float32)
+    return acc, m, l
+
+
+def _combine_splits(acc, m, l):
+    """Log-sum-exp merge of the per-split partials — shared verbatim by
+    both lowerings, so the combine rounding is identical."""
+    m_g = jnp.max(m, axis=1)                            # [B,KVH,rep]
+    w = jnp.exp(m - m_g[:, None])                       # [B,S,KVH,rep]
+    l_g = jnp.sum(w * l, axis=1)
+    out = jnp.sum(w[..., None] * acc, axis=1)
+    return out / jnp.maximum(l_g, 1e-30)[..., None]     # [B,KVH,rep,D]
+
+
+# ---------------------------------------------------------------------------
+# autotuning
+# ---------------------------------------------------------------------------
+
+def _split_candidates(nbs):
+    return [s for s in (1, 2, 4, 8, 16) if s <= nbs and nbs % s == 0]
+
+
+def _default_splits(nbs):
+    """Static heuristic: ~4-way split-K once the table is deep enough
+    to amortize the combine, else fewer."""
+    best = 1
+    for s in _split_candidates(nbs):
+        if s <= max(1, nbs // 2) and s <= 4:
+            best = s
+    return best
+
+
+def _autotuned_splits(q, k_pool, block_table, interpret):
+    """num_splits via the autotune cache (FLAGS_use_autotune), keyed on
+    (chip, head_dim, kv_block_size, max_blocks_per_seq, dtype) — chip
+    is stamped into the key by kernels/autotune itself."""
+    from ..core.flags import flag
+    from . import autotune as at
+
+    nbs = block_table.shape[1]
+    if not flag("use_autotune"):
+        return _default_splits(nbs)
+    D = q.shape[-1]
+    bs = k_pool.shape[1]
+    key = (D, bs, nbs, str(k_pool.dtype))
+    if isinstance(q, jax.core.Tracer):
+        hit = at.lookup("paged_attn_decode", key)
+        return hit[0] if hit else _default_splits(nbs)
+    cands = _split_candidates(nbs)
+    if len(cands) == 1:
+        return cands[0]
+
+    jitted = {}
+
+    def run(cfg):
+        fn = jitted.get(cfg)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                fused_paged_decode, num_splits=cfg[0],
+                interpret=interpret))
+            jitted[cfg] = fn
+        out, kp, vp = fn(*_autotune_args)
+        jax.block_until_ready(out)
+
+    # the eager caller's actual operands double as the timing workload
+    _autotune_args = _AUTOTUNE_OPERANDS.get("args")
+    if _autotune_args is None:
+        return _default_splits(nbs)
+    best = at.autotune("paged_attn_decode", key,
+                       [(s,) for s in cands], run)
+    return best[0] if best else _default_splits(nbs)
+
+
+_AUTOTUNE_OPERANDS: dict = {}
+
+
+def autotune_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
+                          positions, cos, sin):
+    """Eagerly search num_splits for these operand shapes and persist
+    the winner (bench.py / warmup entry point — under a jit trace the
+    kernel can only LOOK UP a previously-persisted winner)."""
+    _AUTOTUNE_OPERANDS["args"] = (q, k_new, v_new, k_pool, v_pool,
+                                  block_table, positions, cos, sin)
+    try:
+        return _autotuned_splits(q, k_pool, block_table,
+                                 jax.default_backend() != "tpu")
+    finally:
+        _AUTOTUNE_OPERANDS.clear()
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def fused_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
+                       positions, cos, sin, *, num_splits=None,
+                       use_pallas=None, interpret=None):
+    """One fused decode step of paged attention.
+
+    q: [B, 1, H, D] UNROTATED queries; k_new/v_new: [B, 1, KVH, D]
+    unrotated new-token key/value; k_pool/v_pool: [nb, bs, KVH, D]
+    block pools; block_table: [B, max_blocks] int32; positions: [B]
+    int32 per-sequence write frontiers; cos/sin: [max_pos, D/2] RoPE
+    tables.  Returns (attn_out [B, 1, H, D], new_k_pool, new_v_pool).
+
+    RoPE is applied to q and k_new at ``positions[b]``, the rotated
+    k/v are scattered into the pools, and attention runs over the
+    updated pools through the block table with causal masking
+    ``k_pos <= positions[b]`` (garbage-block-0 rows sit past the
+    frontier and are masked off).  On TPU the gather + q-RoPE +
+    attention is one Pallas kernel; elsewhere the numerically-identical
+    XLA split-K lowering runs instead.
+    """
+    from ..core.flags import flag
+
+    B, T, H, D = q.shape
+    if T != 1:
+        raise ValueError(f"fused_paged_decode is single-token (T == 1), "
+                         f"got T == {T}")
+    KVH = k_new.shape[2]
+    rep = H // KVH
+    nbs = block_table.shape[1]
+    positions = jnp.asarray(positions, jnp.int32)
+    scale = 1.0 / math.sqrt(D)
+
+    if use_pallas is None:
+        use_pallas = bool(flag("use_pallas_kernels")) and \
+            jax.default_backend() == "tpu" and _HAS_PLTPU
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if num_splits is None:
+        num_splits = _autotuned_splits(q, k_pool, block_table, interpret)
+    if nbs % num_splits:
+        num_splits = _default_splits(nbs)
+
+    # per-sequence RoPE rows + scatter of the rotated new token (tiny:
+    # B rows — XLA prologue shared verbatim by both lowerings)
+    c = cos[positions]                                  # [B, half] f32
+    s = sin[positions]
+    k_rot = _rotate_half(k_new[:, 0].astype(jnp.float32),
+                         c[:, None, :], s[:, None, :]).astype(k_new.dtype)
+    new_k_pool = _scatter_token(k_pool, k_rot, block_table, positions)
+    new_v_pool = _scatter_token(v_pool, v_new[:, 0], block_table,
+                                positions)
+
+    q_g = q[:, 0].reshape(B, KVH, rep, D)               # GQA grouping
+    if use_pallas:
+        acc, m, l = _pallas_partials(
+            None, q_g, c, s, new_k_pool, new_v_pool, block_table,
+            positions, num_splits, scale, interpret)
+    else:
+        q_rot = _rotate_half(q_g.astype(jnp.float32),
+                             c[:, None, None, :],
+                             s[:, None, None, :]) * scale
+        acc, m, l = _xla_partials(q_rot, new_k_pool, new_v_pool,
+                                  block_table, positions, num_splits)
+    out = _combine_splits(acc, m, l)                    # [B,KVH,rep,D]
+    return (out.reshape(B, 1, H, D).astype(q.dtype),
+            new_k_pool, new_v_pool)
+
+
+def paged_decode_reference(q, k_new, v_new, k_pool, v_pool, block_table,
+                           positions, cos, sin):
+    """The UNFUSED scatter/gather decode math of models/llama.py's
+    paged branch (rope gather path, full-buffer masked softmax) — the
+    parity oracle for both fused lowerings."""
+    B, T, H, D = q.shape
+    positions = jnp.asarray(positions, jnp.int32)
+    pos = positions[:, None] + jnp.arange(T)            # [B, 1]
+    c = cos[pos][:, :, None, :]
+    s = sin[pos][:, :, None, :]
+    q_r = _rotate_half(q.astype(jnp.float32), c, s).astype(q.dtype)
+    k_r = _rotate_half(k_new.astype(jnp.float32), c, s).astype(k_new.dtype)
+    kp = _scatter_token(k_pool, k_r[:, 0], block_table, positions)
+    vp = _scatter_token(v_pool, v_new[:, 0], block_table, positions)
+    kb = kp[block_table].reshape(B, -1, kp.shape[2], kp.shape[3])
+    vb = vp[block_table].reshape(B, -1, vp.shape[2], vp.shape[3])
+    rep = H // kb.shape[2]
+    if rep > 1:
+        kb = jnp.repeat(kb, rep, axis=2)
+        vb = jnp.repeat(vb, rep, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q_r, kb,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(D)
+    k_pos = jnp.arange(kb.shape[1])
+    valid = k_pos[None, None, :] <= pos[:, :, None]
+    scores = jnp.where(valid[:, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vb)
+    return out, kp, vp
+
+
+# ---------------------------------------------------------------------------
+# cost annotation (xray/shardplan price the pallas_call through this)
+# ---------------------------------------------------------------------------
+
+def _paged_decode_cost(in_avals, out_avals):
+    # operand order fixed by _pallas_partials:
+    # (block_table, positions, q, cos, sin, k_pool, v_pool)
+    bt_shape = in_avals[0][0]
+    q_shape, q_dtype = in_avals[2][0], in_avals[2][1]
+    pool_shape, pool_dtype = in_avals[5][0], in_avals[5][1]
+    B, nbs = int(bt_shape[0]), int(bt_shape[1])
+    KVH, rep, D = int(q_shape[1]), int(q_shape[2]), int(q_shape[3])
+    bs = int(pool_shape[1])
+    H, L = KVH * rep, nbs * bs
+    flops = 4.0 * B * H * D * L                         # qk^T + pv MACs
+    trans = float(B * H * L)                            # exp per score
+    esize = np.dtype(pool_dtype).itemsize
+    in_bytes = sum(
+        float(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        for shape, dt in in_avals[:5])                  # q/rope/tables
+    # the pools are read THROUGH the block table: B*L rows each, not
+    # the whole pool allocation
+    kv_bytes = 2.0 * B * L * KVH * D * esize
+    out_bytes = sum(
+        float(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        for shape, dt in out_avals)
+    return KernelCost(flops=flops, bytes_accessed=in_bytes + kv_bytes
+                      + out_bytes, transcendentals=trans,
+                      dtype=str(q_dtype))
+
+
+register_kernel_cost(
+    KERNEL_NAME, _paged_decode_cost,
+    sample_in=[((4, 8), "int32"), ((4,), "int32"),
+               ((4, 2, 2, 16), "float32"), ((4, 8), "float32"),
+               ((4, 8), "float32"), ((32, 8, 2, 16), "float32"),
+               ((32, 8, 2, 16), "float32")],
+    sample_out=[((4, 2, 2, 2, 16), "float32"),
+                ((4, 2, 2, 2, 128), "float32"),
+                ((4, 2, 2, 2, 128), "float32")])

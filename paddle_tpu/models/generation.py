@@ -314,17 +314,28 @@ def make_prefill_step(model):
     return step
 
 
-def make_paged_decode_step(model):
+def make_paged_decode_step(model, fused=None):
     """The continuous-batching decode step: one token for a BUCKET of
     sequences, each at its own position, over the shared block-pool
     cache (models/llama.py PagedKVCache).  step(tok[B,1] int32, pools
     [(k, v)] per layer, block_tables[B, max_blocks] int32, lengths[B]
     int32) -> (last_logits[B, V] f32, new_pools).  Every input shape is
     fixed by the engine config, so after the first call this NEVER
-    retraces — the property the serving engine asserts every step."""
-    step = getattr(model, "_paged_decode_step", None)
+    retraces — the property the serving engine asserts every step.
+
+    ``fused`` pins the serving-fusion mode (kernels/fusion) for the
+    whole traced program: True forces the fused paged-attention decode
+    kernel + RMSNorm epilogues (XLA fallback off-TPU), False forces the
+    unfused reference path, None resolves FLAGS_use_fused_serving once
+    at build time.  The mode is baked into the trace, so fused and
+    unfused steps are distinct cached executables."""
+    from ..kernels.fusion import resolve_serving_fusion, serving_fusion
+
+    fused = resolve_serving_fusion(fused)
+    attr = "_paged_decode_step_fused" if fused else "_paged_decode_step"
+    step = getattr(model, attr, None)
     if step is not None and _fingerprint_matches(
-            model, getattr(model, "_paged_decode_step_fp", None)):
+            model, getattr(model, attr + "_fp", None)):
         return step
     fp = _weights_fingerprint(model)
 
@@ -332,22 +343,26 @@ def make_paged_decode_step(model):
 
     from ..core.dispatch import no_grad_ctx
 
+    # resolved OUTSIDE the step: its source is AST-audited (H106) and a
+    # build-time ternary must not read as per-token Python branching
+    kind = "paged_decode_fused" if fused else "paged_decode"
+
     @jax.jit
-    @functools.partial(register_decode_step, kind="paged_decode")
+    @functools.partial(register_decode_step, kind=kind)
     def step(tok, pools, block_tables, lengths):
-        with no_grad_ctx():
+        with no_grad_ctx(), serving_fusion(fused):
             wrapped = [PagedKVCache(k, v, block_tables) for k, v in pools]
             logits, new_caches = model(Tensor(tok), caches=wrapped,
                                        position_offset=lengths)
             return (logits._value[:, -1].astype(jnp.float32),
                     [(c.k, c.v) for c in new_caches])
 
-    model._paged_decode_step = step
-    model._paged_decode_step_fp = fp
+    setattr(model, attr, step)
+    setattr(model, attr + "_fp", fp)
     return step
 
 
-def make_chunked_prefill_step(model):
+def make_chunked_prefill_step(model, fused=None):
     """Chunked prefill straight into the paged block pool: ONE fixed
     chunk shape serves every prompt length, so prefill compiles O(1)
     programs instead of one per length bucket (each bucket was a new
@@ -366,10 +381,20 @@ def make_chunked_prefill_step(model):
     prompt yields the first generated token.  Both ``start`` and
     ``last_index`` are traced, so every chunk of every prompt hits the
     SAME executable (the serving engine asserts this via
-    ``warn_on_retrace``)."""
-    step = getattr(model, "_chunked_prefill_step", None)
+    ``warn_on_retrace``).
+
+    ``fused`` (see make_paged_decode_step) pins the serving-fusion mode:
+    fused prefill folds each RMSNorm into the following projections
+    (kernels/fused_norm_linear); the chunk attention itself stays on the
+    gather path, which handles T > 1 and the padding write mask."""
+    from ..kernels.fusion import resolve_serving_fusion, serving_fusion
+
+    fused = resolve_serving_fusion(fused)
+    attr = "_chunked_prefill_step_fused" if fused \
+        else "_chunked_prefill_step"
+    step = getattr(model, attr, None)
     if step is not None and _fingerprint_matches(
-            model, getattr(model, "_chunked_prefill_step_fp", None)):
+            model, getattr(model, attr + "_fp", None)):
         return step
     fp = _weights_fingerprint(model)
 
@@ -377,10 +402,14 @@ def make_chunked_prefill_step(model):
 
     from ..core.dispatch import no_grad_ctx
 
+    # see make_paged_decode_step: keep the build-time ternary out of
+    # the H106-audited step source
+    kind = "chunked_prefill_fused" if fused else "chunked_prefill"
+
     @jax.jit
-    @functools.partial(register_decode_step, kind="chunked_prefill")
+    @functools.partial(register_decode_step, kind=kind)
     def step(ids, pools, block_table, start, last_index):
-        with no_grad_ctx():
+        with no_grad_ctx(), serving_fusion(fused):
             wrapped = [PagedKVCache(k, v, block_table) for k, v in pools]
             valid = (jnp.arange(ids.shape[1]) <= last_index)[None, :]
             logits, new_caches = model(Tensor(ids),
@@ -392,8 +421,8 @@ def make_chunked_prefill_step(model):
             return (last.astype(jnp.float32),
                     [(c.k, c.v) for c in new_caches])
 
-    model._chunked_prefill_step = step
-    model._chunked_prefill_step_fp = fp
+    setattr(model, attr, step)
+    setattr(model, attr + "_fp", fp)
     return step
 
 

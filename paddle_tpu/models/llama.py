@@ -237,14 +237,68 @@ class LlamaAttention(nn.Layer):
             input_is_parallel=True)
 
     def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
-                position_offset=0):
+                position_offset=0, norm_weight=None, norm_eps=None):
         B, T = hidden.shape[0], hidden.shape[1]
         # head count derived from the projection's ACTUAL width: under
         # manual TP (shard_map pipeline stages) q/k/v are mp-local shards
         # holding num_heads/mp heads; under GSPMD they are global
-        q = self.q_proj(hidden).reshape([B, T, -1, self.head_dim])
-        k = self.k_proj(hidden).reshape([B, T, -1, self.head_dim])
-        v = self.v_proj(hidden).reshape([B, T, -1, self.head_dim])
+        if norm_weight is not None:
+            # fused serving epilogue: the decoder layer skipped its
+            # input_layernorm and handed us the UNNORMALIZED hidden —
+            # the norm folds into each projection's matmul prologue, so
+            # the normalized activation never round-trips HBM.  The row
+            # scale is computed once and shared by q/k/v.
+            def _fused_qkv(hv, nw, wq, wk, wv):
+                from ..kernels.fused_norm_linear import (fused_norm_linear,
+                                                         rms_scale)
+
+                rs = rms_scale(hv, norm_eps)
+                return (fused_norm_linear(hv, rs, nw, wq),
+                        fused_norm_linear(hv, rs, nw, wk),
+                        fused_norm_linear(hv, rs, nw, wv))
+
+            q, k, v = apply("fused_rmsnorm_qkv", _fused_qkv, hidden,
+                            norm_weight, self.q_proj.weight,
+                            self.k_proj.weight, self.v_proj.weight)
+            q = q.reshape([B, T, -1, self.head_dim])
+            k = k.reshape([B, T, -1, self.head_dim])
+            v = v.reshape([B, T, -1, self.head_dim])
+        else:
+            q = self.q_proj(hidden).reshape([B, T, -1, self.head_dim])
+            k = self.k_proj(hidden).reshape([B, T, -1, self.head_dim])
+            v = self.v_proj(hidden).reshape([B, T, -1, self.head_dim])
+
+        if isinstance(cache, PagedKVCache) and T == 1 \
+                and jnp.ndim(position_offset) == 1 and attn_mask is None:
+            from ..distributed.mesh import get_mesh
+            from ..distributed.parallel_layers import manual_axis
+            from ..kernels.fusion import fusion_enabled
+
+            # the kernel consumes the whole pool through the block
+            # table; under a live mesh (GSPMD sharded pools / manual-mp
+            # shard_map) it has no partitioning rule, so serve those
+            # from the unfused gather path below
+            if fusion_enabled() and get_mesh() is None \
+                    and manual_axis("mp")[0] is None:
+                # fused decode hot path: RoPE + pool scatter + block
+                # gather + split-K attention in one kernel (XLA
+                # fallback off-TPU) — models/generation.py's paged
+                # decode step pins the mode via serving_fusion()
+                bt = cache.block_table
+                offs = jnp.asarray(position_offset)
+
+                def _fused_decode(qv, kv, vv, kp, vp):
+                    from ..kernels.paged_attention import fused_paged_decode
+
+                    return fused_paged_decode(qv, kv, vv, kp, vp, bt,
+                                              offs, cos, sin)
+
+                out, k_pool, v_pool = apply(
+                    "fused_paged_attention", _fused_decode, q, k, v,
+                    Tensor(cache.k), Tensor(cache.v))
+                new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
+                out = out.reshape([B, T, -1])
+                return self.o_proj(out), new_cache
 
         def _rope_fn(xv):
             from ..core.flags import flag
@@ -447,7 +501,23 @@ class LlamaMLP(nn.Layer):
         self.down_proj = RowParallelLinear(m, h, has_bias=False,
                                            input_is_parallel=True)
 
-    def forward(self, x):
+    def forward(self, x, norm_weight=None, norm_eps=None):
+        if norm_weight is not None:
+            # fused serving epilogue: the post-attention RMSNorm folds
+            # into gate/up's matmul prologue (row scale computed once),
+            # and silu rides as gate's epilogue
+            def _fused(xv, nw, wg, wu, wd):
+                from ..kernels.fused_norm_linear import (fused_norm_linear,
+                                                         rms_scale)
+
+                rs = rms_scale(xv, norm_eps)
+                g = fused_norm_linear(xv, rs, nw, wg, activation="silu")
+                u = fused_norm_linear(xv, rs, nw, wu)
+                return jnp.dot(g * u, wd.astype(g.dtype))
+
+            return apply("fused_rmsnorm_mlp", _fused, x,
+                         norm_weight, self.gate_proj.weight,
+                         self.up_proj.weight, self.down_proj.weight)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
@@ -535,19 +605,53 @@ class LlamaDecoderLayer(nn.Layer):
                     if getattr(config, "moe_num_experts", 0) > 0
                     else LlamaMLP(config))
 
+    def _fuse_epilogues(self, cache):
+        """Fold RMSNorms into the following projections?  Serving-only
+        (cache present), and only when the projections run as plain
+        local matmuls: fused_norm_linear consumes the raw weights, so
+        any mesh sharding annotation or manual-mp collective the
+        ColumnParallelLinear forward would have applied must be absent.
+        MoE routes through stacked expert weights — not this shape."""
+        if cache is None:
+            return False
+        from ..kernels.fusion import fusion_enabled
+
+        if not fusion_enabled():
+            return False
+        from ..distributed.mesh import get_mesh
+        from ..distributed.parallel_layers import manual_axis
+
+        if get_mesh() is not None or manual_axis("mp")[0] is not None:
+            return False
+        return isinstance(self.mlp, LlamaMLP)
+
     def forward(self, hidden, cos, sin, attn_mask=None, cache=None,
                 position_offset=0):
+        fuse_epi = self._fuse_epilogues(cache)
         residual = hidden
-        h = self.input_layernorm(hidden)
         if cache is not None:
-            h, new_cache = self.self_attn(h, cos, sin, attn_mask, cache,
-                                          position_offset)
+            if fuse_epi:
+                h, new_cache = self.self_attn(
+                    hidden, cos, sin, attn_mask, cache, position_offset,
+                    norm_weight=self.input_layernorm.weight,
+                    norm_eps=self.input_layernorm._epsilon)
+            else:
+                h, new_cache = self.self_attn(
+                    self.input_layernorm(hidden), cos, sin, attn_mask,
+                    cache, position_offset)
         else:
-            h = self.self_attn(h, cos, sin, attn_mask)
+            h = self.self_attn(self.input_layernorm(hidden), cos, sin,
+                               attn_mask)
             new_cache = None
         hidden = residual + h
         residual = hidden
-        h = self.mlp(self.post_attention_layernorm(hidden))
+        if fuse_epi:
+            h = self.mlp(
+                hidden,
+                norm_weight=self.post_attention_layernorm.weight,
+                norm_eps=self.post_attention_layernorm._epsilon)
+        else:
+            h = self.mlp(self.post_attention_layernorm(hidden))
         hidden = residual + h
         if cache is not None:
             return hidden, new_cache

@@ -134,6 +134,13 @@ class ServingConfig:
     step_retry_backoff_s: float = 0.05
     # consecutive in-budget steps before DEGRADED self-heals to SERVING
     health_recovery_steps: int = 3
+    # fused serving kernels (kernels/fusion): None resolves the
+    # FLAGS_use_fused_serving default (fused on TPU, unfused elsewhere);
+    # True forces the fused paged-attention decode + RMSNorm epilogues
+    # even on CPU (the XLA fallback — how CI covers the fused math);
+    # False pins the unfused reference path on any backend.  Pinned at
+    # step-build time, so it never flips inside a compiled program.
+    fused_kernels: Optional[bool] = None
 
 
 class Engine:
@@ -183,12 +190,12 @@ class Engine:
         # [1, chunk_tokens] shape for EVERY prompt length, where the old
         # bucketed prefill compiled one program per length bucket.
         self._decode_step = warn_on_retrace(
-            make_paged_decode_step(model), after=1,
-            label="serving::decode_step",
+            make_paged_decode_step(model, fused=cfg.fused_kernels),
+            after=1, label="serving::decode_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
         self._prefill_step = warn_on_retrace(
-            make_chunked_prefill_step(model), after=1,
-            label="serving::prefill_step",
+            make_chunked_prefill_step(model, fused=cfg.fused_kernels),
+            after=1, label="serving::prefill_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
         self._finished: Dict[str, Request] = {}
         self._ids = itertools.count()

@@ -97,6 +97,19 @@ echo "== overload chaos (shed + hung-step recovery) =="
 # step is detected and retried by the watchdog and the engine recovers
 # to SERVING — all with zero retraces (README: Overload control)
 python examples/serve_llama.py --overload-chaos
+
+echo "== fused serving kernels (forced on; XLA fallback on CPU) =="
+# the fused paged-decode + RMSNorm-epilogue path forced on via
+# ServingConfig(fused_kernels=True): token-for-token parity with the
+# unfused engine AND with generate(), zero retraces on the fused steps;
+# then the analysis gates over the fused programs — the x-ray must
+# price the pallas kernel (no unpriced pallas_call) and the shard plan
+# must land with zero S210 on the fused decode/prefill steps
+# (README: Fused serving kernels)
+python examples/serve_llama.py --fused
+python tools/lint_tpu.py --xray --fused
+python tools/lint_tpu.py --shardplan --steps fused_decode,fused_prefill \
+  --fail-on-unplanned
 python examples/export_and_serve.py
 python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py

@@ -77,7 +77,10 @@ def _shardplan_main(argv):
                         "the batch to exercise the S205/S208 gate)")
     parser.add_argument("--steps", default=None,
                         help="comma list of step kinds to audit "
-                        "(train,decode,prefill,moe,ring); default: all")
+                        "(train,decode,prefill,moe,ring, plus "
+                        "fused_decode,fused_prefill for the fused "
+                        "serving programs); default: "
+                        "train,decode,prefill,moe,ring")
     parser.add_argument("--fail-on-unplanned", action="store_true",
                         help="exit non-zero if any collective in the "
                         "plan is unplanned (spec conflict), even when "
@@ -228,6 +231,11 @@ def _xray_main(argv):
     parser.add_argument("--hbm-budget-gib", type=float, default=None,
                         help="peak-live-HBM budget; default: the chip "
                         "profile's HBM capacity")
+    parser.add_argument("--fused", action="store_true",
+                        help="also X-ray the FUSED serving steps "
+                        "(decode kernel + RMSNorm epilogues forced on; "
+                        "XLA fallback off-TPU) plus the fused "
+                        "paged-decode pallas kernel in interpret mode")
     args = parser.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(
@@ -238,7 +246,8 @@ def _xray_main(argv):
               if args.hbm_budget_gib is not None
               else xray.CHIPS[args.chip].hbm_bytes)
     reports = xray.audit_default_steps(chip=args.chip,
-                                       hbm_budget_bytes=budget)
+                                       hbm_budget_bytes=budget,
+                                       fused=args.fused)
     n_err = 0
     for r in reports:
         print(r.summary())

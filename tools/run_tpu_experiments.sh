@@ -95,14 +95,17 @@ sys.exit(0 if isinstance(d.get("value"), (int, float)) else 1)
 }
 
 snapshot_autotune_cache() {
+  # optional $1: snapshot tag, so a later queue entry that adds fresh
+  # winners (e.g. only_paged_attn's fused-kernel tiles) snapshots again
+  local tag="${1:-autotune_cache}"
   local stamp; stamp=$(date -u +%Y%m%dT%H%MZ)
   local cache="${PADDLE_TPU_CACHE_DIR:-$HOME/.cache/paddle_tpu}/autotune.json"
-  if [ -f "${cache}" ] && ! is_done autotune_cache; then
+  if [ -f "${cache}" ] && ! is_done "${tag}"; then
     cp "${cache}" "BENCH_LOCAL_${stamp}_autotune_cache.json"
     git add "BENCH_LOCAL_${stamp}_autotune_cache.json"
     git commit -q -m "bench: autotune cache snapshot (${stamp})" \
       -- "BENCH_LOCAL_${stamp}_autotune_cache.json" || true
-    mark_done autotune_cache
+    mark_done "${tag}"
   fi
 }
 
@@ -125,6 +128,8 @@ run_queue() {
   run only_unet      BENCH_ONLY=unet || return 1
   run only_serve     BENCH_ONLY=serve_llama || return 1
   run only_prefix    BENCH_ONLY=prefix_cache || return 1
+  run only_paged_attn BENCH_ONLY=paged_attn FLAGS_use_autotune=1 || return 1
+  snapshot_autotune_cache paged_attn_autotune_cache
   BENCH_TIMEOUT=2400 run baseline BENCH_EXTRAS_BUDGET=1500 || return 1
 }
 
@@ -132,7 +137,7 @@ all_done() {
   local n
   for n in batch16 autotune flash_q512k512 flash_q128k512 flash_q256k1024 \
            llama1b_s4096 only_resnet only_bert only_unet only_serve \
-           only_prefix baseline; do
+           only_prefix only_paged_attn baseline; do
     is_done "${n}" || return 1
   done
   return 0
