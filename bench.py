@@ -807,6 +807,92 @@ def _paged_attn_bench(on_tpu: bool):
         "tokens_per_sec_per_chip": round(B / t_fused / _n_chips(), 1)}
 
 
+def _fusion_miner_bench(on_tpu: bool):
+    """BENCH_ONLY=fusion_miner: predicted-vs-measured HBM-byte savings
+    of the mined chunked-prefill fusion — a standing test of the
+    miner's cost model.  Predicted = the fusion miner's bytes-saved for
+    the above-threshold candidates on the UNFUSED prefill trace;
+    measured = the xray-priced byte delta between the unfused and fused
+    prefill programs (fused traced under force_pallas_interpret so the
+    pallas kernels price through kernels/costs).  The ratio must stay
+    within 2x in either direction, or the byte model has drifted from
+    what fusing actually buys.  Wall-clock of the compiled fused vs
+    unfused prefill step rides along in the JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import fusionminer, xray
+    from paddle_tpu.kernels.fusion import force_pallas_interpret
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import make_chunked_prefill_step
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    _, prefill_args = xray._serving_abstract_args(
+        net, batch=4, num_blocks=32, block_size=8, max_blocks_per_seq=8,
+        chunk_tokens=32)
+
+    rep = fusionminer.mine(
+        make_chunked_prefill_step(net, fused=False), prefill_args,
+        name="serving::prefill_step", chip="v5e",
+        threshold_bytes=fusionminer.DEFAULT_THRESHOLD_BYTES)
+    predicted = sum(c.bytes_saved for c in rep.above_threshold())
+    unfused_rep = xray.analyze(
+        make_chunked_prefill_step(net, fused=False), prefill_args,
+        name="u", chip="v5e")
+    with force_pallas_interpret():
+        fused_rep = xray.analyze(
+            make_chunked_prefill_step(net, fused=True), prefill_args,
+            name="f", chip="v5e")
+    measured = unfused_rep.bytes - fused_rep.bytes
+    ratio = predicted / measured if measured else float("inf")
+    assert 0.5 <= ratio <= 2.0, (
+        f"miner predicted {predicted:.0f}B but fusing removed "
+        f"{measured:.0f}B of priced traffic (ratio {ratio:.2f})")
+
+    # compiled-step wall clock, fused vs unfused, same shapes/state
+    B, bs, nbs, C = 1, 8, 8, 32
+    nb = 1 + B * nbs
+    kvh = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    steps, warmup = (50, 8) if on_tpu else (10, 2)
+    pools = [(jnp.zeros((nb, bs, kvh, hd), jnp.float32),
+              jnp.zeros((nb, bs, kvh, hd), jnp.float32))
+             for _ in range(cfg.num_hidden_layers)]
+    bt = jnp.asarray(1 + np.arange(B * nbs).reshape(B, nbs), jnp.int32)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, cfg.vocab_size, (B, C)), jnp.int32)
+    start = jnp.zeros((B,), jnp.int32)
+    last = jnp.asarray(C - 1, jnp.int32)
+
+    def time_step(step):
+        jax.block_until_ready(step(ids, pools, bt, start, last)[0])
+        for _ in range(warmup):
+            jax.block_until_ready(step(ids, pools, bt, start, last)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            jax.block_until_ready(step(ids, pools, bt, start, last)[0])
+        return (time.perf_counter() - t0) / steps
+
+    t_unfused = time_step(make_chunked_prefill_step(net, fused=False))
+    t_fused = time_step(make_chunked_prefill_step(net, fused=True))
+    speedup = t_unfused / t_fused if t_fused > 0 else float("inf")
+    print(f"# fusion_miner: predicted={predicted / 1024.0:.1f}KiB "
+          f"measured={measured / 1024.0:.1f}KiB ratio={ratio:.2f} "
+          f"(top: {rep.candidates[0].code} rank 1), prefill chunk "
+          f"unfused={t_unfused * 1e3:.3f}ms fused={t_fused * 1e3:.3f}ms "
+          f"speedup={speedup:.2f}x", file=sys.stderr)
+    return round(float(ratio), 3), {
+        "predicted_kib": round(predicted / 1024.0, 1),
+        "measured_kib": round(measured / 1024.0, 1),
+        "unfused_prefill_ms": round(t_unfused * 1e3, 3),
+        "fused_prefill_ms": round(t_fused * 1e3, 3),
+        "fused_vs_unfused_speedup": round(speedup, 3)}
+
+
 def _moe_plan_bench(on_tpu):
     """BENCH_ONLY=moe_plan: static shard-plan metrics for the MoE block
     on the canonical expert mesh — no devices touched, the number is the
@@ -873,7 +959,8 @@ def _run_single(which: str, on_tpu: bool):
            "overload": _overload_bench,
            "moe_plan": _moe_plan_bench,
            "dcn_plan": _dcn_plan_bench,
-           "paged_attn": _paged_attn_bench}
+           "paged_attn": _paged_attn_bench,
+           "fusion_miner": _fusion_miner_bench}
     metric, unit = _ONLY_METRICS[which]
     value = fns[which](on_tpu)
     extras = {}
@@ -1162,6 +1249,7 @@ _ONLY_METRICS = {
     "moe_plan": ("moe_plan_comm_kib", "KiB"),
     "dcn_plan": ("dcn_plan_dcn_wire_kib", "KiB"),
     "paged_attn": ("paged_attn_fused_tpot_ms", "ms"),
+    "fusion_miner": ("fusion_miner_pred_vs_measured", "x"),
 }
 
 

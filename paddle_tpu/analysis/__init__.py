@@ -37,6 +37,9 @@ from . import shardplan
 from .shardplan import (Collective, PlanReport, PlanRequest,
                         audit_shardplan, plan_jaxpr, plan_step,
                         plan_train_step, recommend_layouts)
+from . import fusionminer
+from .fusionminer import (FusionCandidate, FusionReport, audit_fusion,
+                          mine, mine_jaxpr)
 
 __all__ = [
     "Diagnostic",
@@ -70,6 +73,12 @@ __all__ = [
     "RankedLayout",
     "Topology",
     "audit_shardplan",
+    "fusionminer",
+    "FusionCandidate",
+    "FusionReport",
+    "audit_fusion",
+    "mine",
+    "mine_jaxpr",
     "format_recommendations",
     "plan_jaxpr",
     "plan_step",

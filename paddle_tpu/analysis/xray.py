@@ -636,6 +636,35 @@ class ProgramReport:
                 f"{op.intensity:>9.2f}  {op.bound(self.chip)}")
         return "\n".join(rows)
 
+    def to_json(self) -> Dict[str, Any]:
+        """Machine-readable report (``lint_tpu --xray --json``) —
+        diagnostics use the same shape as shardplan's ``to_json``."""
+        return {
+            "name": self.name,
+            "chip": self.chip.name,
+            "flops": float(self.flops),
+            "bytes": float(self.bytes),
+            "arithmetic_intensity": float(self.arithmetic_intensity),
+            "compute_time_s": float(self.compute_time_s),
+            "peak_hbm_bytes": int(self.peak_hbm_bytes),
+            "peak_hbm_by_dtype": {k: int(v) for k, v in
+                                  self.peak_hbm_by_dtype.items()},
+            "hbm_budget_bytes": (int(self.hbm_budget_bytes)
+                                 if self.hbm_budget_bytes else None),
+            "n_eqns": int(self.n_eqns),
+            "donated": list(self.donated),
+            "ops": [
+                {"primitive": op.primitive, "count": int(op.count),
+                 "flops": float(op.flops), "bytes": float(op.bytes),
+                 "intensity": float(op.intensity),
+                 "bound": op.bound(self.chip)}
+                for op in self.ops],
+            "diagnostics": [
+                {"code": d.code, "severity": d.severity,
+                 "message": d.message, "where": d.where}
+                for d in self.hazards],
+        }
+
     def summary(self) -> str:
         budget = (f" / budget {self.hbm_budget_bytes / 2**30:.2f} GiB"
                   if self.hbm_budget_bytes else "")
@@ -987,6 +1016,21 @@ def audit_default_steps(*, chip: str = "cpu",
                               interpret=True),
             kernel_args, name="kernel::fused_paged_decode", chip=chip,
             hbm_budget_bytes=hbm_budget_bytes))
+
+        from ..kernels.chunked_prefill import fused_chunked_attention
+
+        prefill_kernel_args = (
+            sds32((4, 32, cfg.num_attention_heads, hd), f32),   # q chunk
+            sds32((32, 8, kvh, hd), f32),                       # k_pool
+            sds32((32, 8, kvh, hd), f32),                       # v_pool
+            sds32((4, 8), np.int32),                            # table
+            sds32((4,), np.int32),                              # pos
+        )
+        reports.append(analyze(
+            functools.partial(fused_chunked_attention, use_pallas=True,
+                              interpret=True),
+            prefill_kernel_args, name="kernel::fused_chunked_prefill",
+            chip=chip, hbm_budget_bytes=hbm_budget_bytes))
 
     from ..distributed.mesh import abstract_mesh
     from ..models.generation import make_moe_block_step, make_ring_sp_step

@@ -163,9 +163,12 @@ def fused_norm_linear(x, row_scale, norm_weight, w, activation="none",
     activation); norm_weight: [K]; w: [K, N].
     """
     from ..core.flags import flag
+    from .fusion import pallas_interpret_forced
 
     if activation not in _ACTS:
         raise ValueError(f"unsupported activation {activation!r}")
+    if use_pallas is None and pallas_interpret_forced() and _HAS_PLTPU:
+        use_pallas, interpret = True, True
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if use_pallas is None:

@@ -62,3 +62,28 @@ def serving_fusion(enabled: bool):
         yield
     finally:
         _tls.override = prev
+
+
+def pallas_interpret_forced() -> bool:
+    """True inside a ``force_pallas_interpret()`` context: the fused
+    kernels resolve ``use_pallas=True, interpret=True`` regardless of
+    backend, so the traced program carries the REAL pallas_call leaves.
+    Off-TPU the fused steps normally lower to the XLA fallback, which is
+    right for execution but blinds static analysis: the fusion miner's
+    F004 already-fused accounting and the priced-pallas CI gates need
+    the kernel to appear in the jaxpr on any backend."""
+    return bool(getattr(_tls, "force_interpret", False))
+
+
+@contextlib.contextmanager
+def force_pallas_interpret(enabled: bool = True):
+    """Trace-time context: fused kernels that would pick the XLA
+    fallback off-TPU take the Pallas path in interpret mode instead
+    (analysis-only — interpret execution is slow and never the serving
+    path)."""
+    prev = getattr(_tls, "force_interpret", None)
+    _tls.force_interpret = bool(enabled)
+    try:
+        yield
+    finally:
+        _tls.force_interpret = prev

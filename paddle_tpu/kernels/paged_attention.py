@@ -353,9 +353,14 @@ def fused_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
     positions = jnp.asarray(positions, jnp.int32)
     scale = 1.0 / math.sqrt(D)
 
+    from .fusion import pallas_interpret_forced
+
     if use_pallas is None:
-        use_pallas = bool(flag("use_pallas_kernels")) and \
-            jax.default_backend() == "tpu" and _HAS_PLTPU
+        if pallas_interpret_forced() and _HAS_PLTPU:
+            use_pallas, interpret = True, True
+        else:
+            use_pallas = bool(flag("use_pallas_kernels")) and \
+                jax.default_backend() == "tpu" and _HAS_PLTPU
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if num_splits is None:

@@ -385,8 +385,11 @@ def make_chunked_prefill_step(model, fused=None):
 
     ``fused`` (see make_paged_decode_step) pins the serving-fusion mode:
     fused prefill folds each RMSNorm into the following projections
-    (kernels/fused_norm_linear); the chunk attention itself stays on the
-    gather path, which handles T > 1 and the padding write mask."""
+    (kernels/fused_norm_linear) and runs the chunk attention through the
+    fused block-gather + online-softmax kernel
+    (kernels/chunked_prefill — mined by analysis/fusionminer as the #1
+    remaining candidate); padded positions still scatter to the garbage
+    block and mask off exactly as on the gather path."""
     from ..kernels.fusion import resolve_serving_fusion, serving_fusion
 
     fused = resolve_serving_fusion(fused)

@@ -357,6 +357,32 @@ class LlamaAttention(nn.Layer):
             v_pool = apply("paged_kv_update", _scatter, Tensor(cache.v), v)
             new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
 
+            if T > 1:
+                from ..distributed.mesh import get_mesh
+                from ..distributed.parallel_layers import manual_axis
+                from ..kernels.fusion import fusion_enabled
+
+                # same mesh caveat as the fused decode intercept: the
+                # kernel reads the whole pool through the block table
+                if fusion_enabled() and get_mesh() is None \
+                        and manual_axis("mp")[0] is None:
+                    # fused chunked-prefill hot path: block gather +
+                    # causal mask + online softmax + context in one
+                    # kernel (XLA fallback off-TPU) — the #1 candidate
+                    # mined by analysis/fusionminer on the fused
+                    # prefill trace
+                    def _fused_chunk(qv, kp, vp):
+                        from ..kernels.chunked_prefill import \
+                            fused_chunked_attention
+
+                        return fused_chunked_attention(qv, kp, vp, bt,
+                                                       offsets)
+
+                    out = apply("fused_chunked_attention", _fused_chunk,
+                                q, k_pool, v_pool)
+                    out = out.reshape([B, T, -1])
+                    return self.o_proj(out), new_cache
+
             def _paged_attn(qv, kp, vp):
                 # contiguous per-sequence views of the block pool: the
                 # same full-buffer masked attention as the static cache,

@@ -110,6 +110,21 @@ python examples/serve_llama.py --fused
 python tools/lint_tpu.py --xray --fused
 python tools/lint_tpu.py --shardplan --steps fused_decode,fused_prefill \
   --fail-on-unplanned
+
+echo "== fusion miner (ranked F-series candidates + fused coverage) =="
+# the fusion-candidate miner over the registered serving steps: the
+# unfused traces must rank the hand-fused chains as candidates, and the
+# FUSED steps (mined under force_pallas_interpret so the pallas leaves
+# show up as F004 coverage) must leave zero unsuppressed non-F004
+# candidates above the bytes-saved threshold — a mined chain that big
+# should have become a kernel (README: Fusion-candidate miner)
+python tools/lint_tpu.py --xray --fusion --fused --fail-on-candidates
+# the machine-readable report must stay parseable (same consumer as the
+# shardplan JSON); validate the fusion attachment shape end to end
+python tools/lint_tpu.py --xray --fusion --json \
+  | python -c "import json,sys; rs=json.load(sys.stdin); \
+f=[r['fusion'] for r in rs if r['name'] == 'serving::prefill_step'][0]; \
+assert f['n_above_threshold'] >= 1 and f['candidates'][0]['rank'] == 1, f"
 python examples/export_and_serve.py
 python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py

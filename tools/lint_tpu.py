@@ -7,6 +7,8 @@ Usage:
     python tools/lint_tpu.py paddle_tpu/
     python tools/lint_tpu.py --list-rules
     python tools/lint_tpu.py --xray [--hbm-budget-gib N] [--chip v5e]
+    python tools/lint_tpu.py --xray --fusion [--json] [--fused
+                             --fail-on-candidates]
     python tools/lint_tpu.py --shardplan [--mesh data=2,fsdp=2,tp=2]
     python tools/lint_tpu.py --shardplan --hosts 2 [--dcn-axes tp]
                              [--recommend] [--json]
@@ -24,6 +26,13 @@ itself is broken.  ``--xray`` is the opposite trade on purpose: it
 imports the package, traces the registered train/decode/prefill steps
 to jaxprs on the CPU (1,1) config, and fails on ERROR hazards (f64
 eqns, host callbacks H109) or a peak-live-HBM over the budget (H110).
+
+``--xray --fusion`` additionally runs the fusion-candidate miner
+(paddle_tpu/analysis/fusionminer.py) over the serving steps: ranked
+F-series diagnostics (F001 chain / F002 prologue / F003 epilogue /
+F004 already-fused), ``--json`` for the machine-readable reports, and
+``--fail-on-candidates`` to gate that the FUSED steps leave no
+unsuppressed candidate above the bytes-saved threshold.
 
 ``--shardplan`` goes one layer further: it propagates the canonical
 llama SpecLayout through the same jaxprs on a simulated mesh (default
@@ -235,12 +244,37 @@ def _xray_main(argv):
                         help="also X-ray the FUSED serving steps "
                         "(decode kernel + RMSNorm epilogues forced on; "
                         "XLA fallback off-TPU) plus the fused "
-                        "paged-decode pallas kernel in interpret mode")
+                        "paged-decode/chunked-prefill pallas kernels in "
+                        "interpret mode")
+    parser.add_argument("--fusion", action="store_true",
+                        help="also run the fusion-candidate miner over "
+                        "the serving steps (ranked F-series diagnostics; "
+                        "with --fused the fused steps are mined under "
+                        "force_pallas_interpret so the pallas leaves "
+                        "report as F004 coverage)")
+    parser.add_argument("--fusion-threshold-kib", type=float, default=None,
+                        help="bytes-saved gate for the miner in KiB "
+                        "(default: fusionminer.DEFAULT_THRESHOLD_BYTES); "
+                        "candidates at/above it are WARNING and count "
+                        "for --fail-on-candidates")
+    parser.add_argument("--fail-on-candidates", action="store_true",
+                        help="exit non-zero when any FUSED step reports "
+                        "an unsuppressed non-F004 candidate at/above the "
+                        "fusion threshold (requires --fusion; the CI "
+                        "fused-coverage gate)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the per-step reports as a JSON list "
+                        "on stdout instead of the human tables (same "
+                        "diagnostic shape as --shardplan --json; fusion "
+                        "reports attach under a 'fusion' key by step "
+                        "name)")
     args = parser.parse_args(argv)
+    if args.fail_on_candidates and not args.fusion:
+        parser.error("--fail-on-candidates requires --fusion")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
-    from paddle_tpu.analysis import xray
+    from paddle_tpu.analysis import fusionminer, xray
 
     budget = (int(args.hbm_budget_gib * 2**30)
               if args.hbm_budget_gib is not None
@@ -248,16 +282,54 @@ def _xray_main(argv):
     reports = xray.audit_default_steps(chip=args.chip,
                                        hbm_budget_bytes=budget,
                                        fused=args.fused)
+    fusion_reports = []
+    if args.fusion:
+        threshold = (args.fusion_threshold_kib * 1024
+                     if args.fusion_threshold_kib is not None
+                     else fusionminer.DEFAULT_THRESHOLD_BYTES)
+        fusion_reports = fusionminer.audit_fusion(
+            chip=args.chip, threshold_bytes=threshold, fused=args.fused)
+    by_name = {f.name: f for f in fusion_reports}
     n_err = 0
+    n_cand = 0
     for r in reports:
-        print(r.summary())
-        for d in r.hazards:
-            print(f"  {d}")
+        if not args.as_json:
+            print(r.summary())
+            for d in r.hazards:
+                print(f"  {d}")
         n_err += len(r.errors())
-    print(f"lint-tpu --xray: {len(reports)} step(s), "
-          f"{sum(len(r.hazards) for r in reports)} hazard(s), "
-          f"{n_err} error(s)")
-    return 1 if n_err else 0
+    for f in fusion_reports:
+        if not args.as_json:
+            print(f.summary())
+            for d in f.diagnostics:
+                print(f"  {d}")
+        n_err += len(f.errors())
+        # the coverage gate applies to the FUSED steps only: anything
+        # still above the threshold there should have been a kernel
+        if "[fused]" in f.name:
+            n_cand += len(f.above_threshold())
+    if args.as_json:
+        import json
+        out = [r.to_json() for r in reports]
+        leftover = dict(by_name)
+        for entry in out:
+            fr = leftover.pop(entry["name"], None)
+            if fr is not None:
+                entry["fusion"] = fr.to_json()
+        for name in sorted(leftover):
+            out.append({"name": name, "fusion": leftover[name].to_json()})
+        print(json.dumps(out, indent=2))
+    else:
+        gate = (f", {n_cand} unfused candidate(s) above threshold on "
+                f"fused steps" if args.fusion and args.fused else "")
+        print(f"lint-tpu --xray: {len(reports)} step(s), "
+              f"{sum(len(r.hazards) for r in reports)} hazard(s), "
+              f"{n_err} error(s){gate}")
+    if n_err:
+        return 1
+    if args.fail_on_candidates and n_cand:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
