@@ -743,6 +743,128 @@ def _overload_bench(on_tpu: bool):
             tok_on / dt_on / _n_chips(), 1)}
 
 
+def _router_replay_bench(on_tpu: bool):
+    """BENCH_ONLY=router_replay: the serving fleet router on a seeded
+    multi-tenant trace (serving/replay.py), prefix-affinity placement
+    vs round-robin on IDENTICAL fleets and the IDENTICAL trace (README:
+    Serving fleet & router).  The trace mixes a chatty tenant sharing a
+    long system prompt, a long-prompt tenant, and a burst tenant.
+    Reported value is the affinity fleet's realized cached-token ratio
+    (prompt tokens served from replica prefix caches); the round-robin
+    ratio, both p99 TTFTs, and per-tenant goodput ride in the JSON line
+    and print to stderr.  Affinity must beat round-robin on the ratio —
+    round-robin scatters a tenant's requests across replicas, so each
+    replica re-prefills the shared prefix — and not lose on p99 TTFT."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (Engine, Router, ServingConfig,
+                                    Tenant, build_trace, replay_trace)
+
+    if on_tpu:
+        cfg = LlamaConfig.tiny(max_position_embeddings=1024)
+        tenants = [
+            Tenant("chat", kind="chat", requests=16,
+                   shared_prefix_tokens=192, tail_tokens=(8, 32),
+                   max_new_tokens=8),
+            Tenant("long", kind="long", requests=6,
+                   shared_prefix_tokens=32, tail_tokens=(128, 256),
+                   max_new_tokens=6),
+            Tenant("burst", kind="burst", requests=12,
+                   shared_prefix_tokens=64, tail_tokens=(4, 16),
+                   max_new_tokens=4),
+        ]
+        blocks, bsz, chunk, horizon = 256, 16, 64, 24
+    else:
+        cfg = LlamaConfig.tiny()
+        # shared prefixes dominate each prompt, so consolidation (one
+        # prefix copy fleet-wide) vs duplication (one per replica) is
+        # the measured difference, well clear of timing noise
+        tenants = [
+            Tenant("chat", kind="chat", requests=12,
+                   shared_prefix_tokens=96, tail_tokens=(4, 12),
+                   max_new_tokens=6),
+            Tenant("long", kind="long", requests=4,
+                   shared_prefix_tokens=16, tail_tokens=(48, 80),
+                   max_new_tokens=4),
+            Tenant("burst", kind="burst", requests=10,
+                   shared_prefix_tokens=48, tail_tokens=(2, 8),
+                   max_new_tokens=4),
+        ]
+        blocks, bsz, chunk, horizon = 128, 4, 32, 16
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def fleet(policy):
+        def rcfg(name):
+            return ServingConfig(
+                name=name, max_batch_size=4, block_size=bsz,
+                num_blocks=blocks, chunk_tokens=chunk, max_queue_len=48)
+
+        # weight high enough that transient queue imbalance never
+        # unsticks a tenant from its prefix replica mid-trace
+        return Router([Engine(model, rcfg(f"{policy[:2]}-0")),
+                       Engine(model, rcfg(f"{policy[:2]}-1"))],
+                      policy=policy, seed=0, affinity_weight=8.0)
+
+    # warm ONCE: the compiled steps cache on the MODEL keyed by the
+    # weights fingerprint, so every replica below reuses them and the
+    # replayed TTFTs are compile-free
+    warm = Engine(model, ServingConfig(max_batch_size=4, block_size=bsz,
+                                       num_blocks=blocks,
+                                       chunk_tokens=chunk))
+    warm.generate([np.arange(1, chunk + 2, dtype=np.int32)],
+                  max_new_tokens=2)
+
+    trace = build_trace(tenants, seed=7, horizon=horizon,
+                        vocab=cfg.vocab_size)
+    # placement is deterministic per policy (identical logs every
+    # repeat) but the fleet p99 TTFT is a max over ~a dozen wall-clock
+    # samples — replay each fleet three times on FRESH replicas and
+    # take the median p99 so scheduler jitter can't flip the headline
+    # comparison either way
+    reps = {"affinity": [], "round_robin": []}
+    t0 = dt = None
+    for _ in range(3):
+        for policy in reps:
+            if policy == "affinity":
+                t0 = time.perf_counter()
+            reps[policy].append(replay_trace(fleet(policy), trace))
+            if policy == "affinity" and dt is None:
+                dt = time.perf_counter() - t0
+    aff, rr = reps["affinity"][0], reps["round_robin"][0]
+
+    def med(runs, key):
+        vals = sorted(r["fleet"][key] or 0 for r in runs)
+        return vals[len(vals) // 2]
+
+    # the ratio is NEARLY deterministic (cold placements are; once the
+    # EWMAs warm a rare load spill can move one request), so the median
+    # smooths both headline numbers the same way
+    a_ratio = med(reps["affinity"], "cached_token_ratio")
+    r_ratio = med(reps["round_robin"], "cached_token_ratio")
+    a_p99 = med(reps["affinity"], "p99_ttft_s")
+    r_p99 = med(reps["round_robin"], "p99_ttft_s")
+    goodput = sum(t["goodput_tokens"] for t in aff["tenants"].values())
+    assert a_ratio >= r_ratio, (a_ratio, r_ratio)
+    per_tenant = " ".join(
+        f"{name}:{t['goodput_tokens']}tok/p99="
+        f"{(t['p99_ttft_s'] or 0) * 1e3:.1f}ms"
+        for name, t in aff["tenants"].items())
+    print(f"# router_replay: cached_ratio affinity={a_ratio:.3f} "
+          f"round_robin={r_ratio:.3f}, p99 ttft affinity="
+          f"{(a_p99 or 0) * 1e3:.1f}ms round_robin="
+          f"{(r_p99 or 0) * 1e3:.1f}ms, placements="
+          f"{aff['fleet']['placements']}, {per_tenant}",
+          file=sys.stderr)
+    return round(float(a_ratio), 4), {
+        "round_robin_cached_token_ratio": round(float(r_ratio), 4),
+        "affinity_p99_ttft_ms": a_p99 and round(a_p99 * 1e3, 2),
+        "round_robin_p99_ttft_ms": r_p99 and round(r_p99 * 1e3, 2),
+        "goodput_tokens": goodput,
+        "tokens_per_sec_per_chip": round(goodput / dt / _n_chips(), 1)}
+
+
 def _paged_attn_bench(on_tpu: bool):
     """BENCH_ONLY=paged_attn: fused vs scatter/gather paged-attention
     decode (kernels/paged_attention).  Times the COMPILED paged decode
@@ -957,6 +1079,7 @@ def _run_single(which: str, on_tpu: bool):
            "observe_overhead": _observe_overhead_bench,
            "mesh_train": _mesh_train_bench,
            "overload": _overload_bench,
+           "router_replay": _router_replay_bench,
            "moe_plan": _moe_plan_bench,
            "dcn_plan": _dcn_plan_bench,
            "paged_attn": _paged_attn_bench,
@@ -1246,6 +1369,7 @@ _ONLY_METRICS = {
     "observe_overhead": ("observe_overhead_pct", "%"),
     "mesh_train": ("mesh_train_tokens_per_sec_per_chip", "tokens/s/chip"),
     "overload": ("overload_goodput_ratio", "x"),
+    "router_replay": ("router_replay_cached_token_ratio", "ratio"),
     "moe_plan": ("moe_plan_comm_kib", "KiB"),
     "dcn_plan": ("dcn_plan_dcn_wire_kib", "KiB"),
     "paged_attn": ("paged_attn_fused_tpot_ms", "ms"),

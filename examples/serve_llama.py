@@ -27,8 +27,16 @@ on (``ServingConfig(fused_kernels=True)``: fused paged-attention decode
 and asserts token-for-token identical outputs, agreement with plain
 ``generate()``, and zero retraces on the fused steps.
 
+With ``--router`` (the CI router-chaos stage) the demo fronts TWO named
+engine replicas with a ``serving.Router``: a shared-prefix burst shows
+prefix-affinity placement consolidating a prompt family on one replica,
+then a replica-scoped ``FaultPlan`` kills one replica mid-burst — the
+router quarantines it, drains its stranded requests, and resubmits them
+to the survivor with ZERO lost requests and token parity against a
+single-engine run.
+
 Run:  python examples/serve_llama.py
-          [--prefix-cache | --overload-chaos | --fused]
+          [--prefix-cache | --overload-chaos | --fused | --router]
 """
 import argparse
 
@@ -206,6 +214,71 @@ def fused_demo(model):
           "executable per engine")
 
 
+def router_demo(model):
+    from paddle_tpu.resilience.chaos import FaultPlan, burst_prompts
+    from paddle_tpu.serving import Router
+
+    def make(name):
+        return Engine(model, ServingConfig(
+            name=name, max_batch_size=4, block_size=4, num_blocks=64,
+            chunk_tokens=16, max_queue_len=32, step_max_retries=1,
+            step_retry_backoff_s=0.0))
+
+    # --- phase 1: prefix-affinity placement on a shared-prefix burst
+    router = Router([make("replica-0"), make("replica-1")], seed=0)
+    rng = np.random.RandomState(0)
+    system = rng.randint(1, 256, size=(32,)).astype(np.int32)
+    family = [np.concatenate([system, rng.randint(
+        1, 256, size=(L,)).astype(np.int32)]) for L in (5, 3, 7, 4)]
+    solo = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+            for L in (9, 6)]
+    reqs = [router.submit(p, max_new_tokens=6) for p in family + solo]
+    done = router.run_until_complete()
+    for line in router.placement_log:
+        print(f"  {line}")
+    st = router.stats()["router"]
+    print(f"placements: {st['placements']}, expected-cached ratio "
+          f"{st['affinity_token_ratio']:.2f}")
+    # the shared-prefix family consolidates on ONE replica (first
+    # placement is load-based; affinity pins the follow-ups to it)
+    family_rids = {r.request_id for r in reqs[:len(family)]}
+    homes = {line.split(" -> ")[1].split()[0]
+             for line in router.placement_log
+             if line.split(" -> ")[0] in family_rids}
+    assert len(homes) == 1, f"family scattered across {homes}"
+    assert len(done) == len(reqs)
+
+    # --- phase 2: replica-scoped chaos kill mid-burst -> quarantine,
+    # drain, resubmit; zero lost requests, token parity with 1 engine
+    e0, e1 = make("replica-0"), make("replica-1")
+    fleet = Router([e0, e1], seed=0)
+    prompts = burst_prompts(seed=3, n=6, min_len=6, max_len=14)
+    ref = Engine(model, ServingConfig(max_batch_size=4, block_size=4,
+                                      num_blocks=64, chunk_tokens=16)
+                 ).generate(list(prompts), max_new_tokens=5)
+    reqs = [fleet.submit(p, max_new_tokens=5) for p in prompts]
+    with FaultPlan(step_fault_scope="@replica-1", fail_step_at={1, 2}):
+        done = fleet.run_until_complete()
+    st = fleet.stats()["router"]
+    h = fleet.health()
+    print(f"chaos: {st['replica_quarantines']} replica quarantined, "
+          f"{st['requests_resubmitted']} resubmitted, "
+          f"{h['serving_replicas']}/{len(fleet.replicas)} serving")
+    assert st["replica_quarantines"] == 1
+    assert st["requests_resubmitted"] > 0
+    assert len(done) == len(reqs)           # zero lost requests
+    for rq, expect in zip(reqs, ref):
+        out = done[rq.request_id]
+        assert out.finish_reason == "length", out.finish_reason
+        assert np.array_equal(out.output_ids(), expect)
+    for e in (e0, e1):
+        assert e._decode_step.retraces == 0
+        assert e._prefill_step.retraces == 0
+        e.pool.check_leaks()
+    print("router chaos: replica killed mid-burst, zero lost requests, "
+          "token parity across failover, zero retraces")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prefix-cache", action="store_true",
@@ -218,6 +291,10 @@ def main():
                     help="fused serving kernels forced on vs off: "
                          "token parity, generate() agreement, zero "
                          "retraces")
+    ap.add_argument("--router", action="store_true",
+                    help="two-replica fleet router: prefix-affinity "
+                         "placement, then a chaos-killed replica with "
+                         "drain + resubmit and zero lost requests")
     args = ap.parse_args()
 
     paddle.seed(0)
@@ -229,6 +306,8 @@ def main():
         overload_chaos_demo(model)
     elif args.fused:
         fused_demo(model)
+    elif args.router:
+        router_demo(model)
     else:
         staggered_demo(model)
 

@@ -258,6 +258,9 @@ def create_serving_endpoint(model, config=None, **generate_defaults):
     Predictor above serves jit.save artifacts; this serves token
     streams with iteration-level batching — see paddle_tpu/serving/).
 
+    ``model`` may also be a prebuilt :class:`paddle_tpu.serving.Engine`
+    or a :class:`paddle_tpu.serving.Router` fleet (``config`` must then
+    be None — a prebuilt engine already carries its config).
     ``config`` is a :class:`paddle_tpu.serving.ServingConfig`;
     ``generate_defaults`` (eos_token_id, max_new_tokens, ...) apply to
     every request unless overridden per call."""

@@ -106,6 +106,14 @@ class FaultPlan:
         :class:`ChaosError` instead of running — the transient device
         failure the watchdog's bounded retry must absorb (consecutive
         ordinals exhaust the retries and quarantine the engine).
+    step_fault_scope: when set, ONLY serving-step attempts whose label
+        contains this substring are counted and faulted — the others
+        pass through untouched (their ordinals do not advance the
+        schedule).  A fleet of named replicas labels its steps
+        ``serving::decode_step@<name>`` (ServingConfig(name=...)), so
+        ``step_fault_scope="@replica-1"`` kills or stalls exactly one
+        replica of a router while its siblings keep serving —
+        deterministic replica-targeted chaos.
     """
 
     def __init__(self, seed: int = 0,
@@ -119,7 +127,8 @@ class FaultPlan:
                  fail_request_ids: Iterable[str] = (),
                  step_delay_s: Union[None, float,
                                      Dict[int, float]] = None,
-                 fail_step_at: Iterable[int] = ()):
+                 fail_step_at: Iterable[int] = (),
+                 step_fault_scope: Optional[str] = None):
         self.seed = seed
         self.nan_batch_steps = frozenset(nan_batch_steps)
         self.inf_batch_steps = frozenset(inf_batch_steps)
@@ -134,6 +143,7 @@ class FaultPlan:
         self.fail_request_ids = frozenset(fail_request_ids)
         self.step_delay_s = step_delay_s
         self.fail_step_at = frozenset(fail_step_at)
+        self.step_fault_scope = step_fault_scope
         # observability: what actually fired (tests assert on these)
         self.injected: list = []
         self._save_calls = 0
@@ -200,7 +210,12 @@ class FaultPlan:
         """One serving compiled-step ATTEMPT (prefill chunk or decode
         iteration, retries counted separately) — sleep and/or raise per
         the schedule.  Called inside the engine watchdog's monotonic
-        window, so injected delays are observed as stalls."""
+        window, so injected delays are observed as stalls.  With a
+        ``step_fault_scope``, attempts outside the scope pass through
+        without advancing the schedule (replica-targeted chaos)."""
+        if self.step_fault_scope is not None \
+                and self.step_fault_scope not in label:
+            return
         self._serving_step_calls += 1
         n = self._serving_step_calls
         delay = (self.step_delay_s if isinstance(

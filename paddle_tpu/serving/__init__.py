@@ -18,6 +18,8 @@ Layout:
 - :mod:`metrics`   — TTFT/TPOT/queue-time counters + engine gauges
 - :mod:`endpoint`  — Predictor-shaped :class:`Endpoint` front door
 - :mod:`overload`  — load shedding, degradation ladder, step watchdog
+- :mod:`router`    — :class:`Router`, prefix/load-aware fleet placement
+- :mod:`replay`    — multi-tenant trace replay bench for the router
 
 Quick start::
 
@@ -37,6 +39,9 @@ from .engine import Engine, ServingConfig
 from .metrics import RequestTimeline, ServingMetrics
 from .overload import (DEGRADED, FAILED, LADDER_LEVELS, SERVING,
                        EngineQuarantined, OverloadController)
+from .replay import (Arrival, Tenant, build_trace, default_tenants,
+                     replay_trace)
+from .router import ROUTER_POLICIES, Router, RouterMetrics
 from .scheduler import (FINISHED, PREEMPTED, PREFILLING, QUEUED, RUNNING,
                         AdmissionError, QueueFull, Request, Scheduler)
 
@@ -54,6 +59,14 @@ __all__ = [
     "RequestTimeline",
     "OverloadController",
     "EngineQuarantined",
+    "Router",
+    "RouterMetrics",
+    "ROUTER_POLICIES",
+    "Tenant",
+    "Arrival",
+    "default_tenants",
+    "build_trace",
+    "replay_trace",
     "LADDER_LEVELS",
     "SERVING",
     "DEGRADED",

@@ -86,6 +86,10 @@ class BlockKVPool:
         # refcount-0 blocks still holding indexed content, oldest first —
         # matchable for free, evictable when the free list runs dry
         self._cached_free: "OrderedDict[int, None]" = OrderedDict()
+        # chain ROOTS (depth-1 hashes), most recently registered last —
+        # the cheap recency signal prefix_summary() exposes to a fleet
+        # router (every cached prompt family is reachable through one)
+        self._roots: "OrderedDict[bytes, None]" = OrderedDict()
         self.evictions = 0
         self.cow_copies = 0
 
@@ -159,6 +163,7 @@ class BlockKVPool:
         h = self._block_hash.pop(b, None)
         if h is not None and self._hash_index.get(h) == b:
             del self._hash_index[h]
+            self._roots.pop(h, None)
         self.evictions += 1
         return b
 
@@ -300,7 +305,8 @@ class BlockKVPool:
         if not self.enable_prefix_cache:
             return 0
         added = 0
-        for h, b in zip(self.hash_chain(tokens), blocks):
+        chain = self.hash_chain(tokens)
+        for h, b in zip(chain, blocks):
             if h in self._hash_index or b in self._block_hash:
                 continue
             owners = self._owners.get(b)
@@ -309,6 +315,12 @@ class BlockKVPool:
             self._hash_index[h] = b
             self._block_hash[b] = h
             added += 1
+        # refresh root recency: depth-1 hash of an indexed chain is the
+        # entry point any prompt sharing this prefix family matches
+        # through (re-registering moves it to most-recent)
+        if chain and chain[0] in self._hash_index:
+            self._roots.pop(chain[0], None)
+            self._roots[chain[0]] = None
         return added
 
     def ensure_writable(self, request_id, block: int) -> int:
@@ -357,6 +369,26 @@ class BlockKVPool:
             "utilization": round(self.utilization(), 4),
             "prefix_evictions": self.evictions,
             "cow_copies": self.cow_copies,
+        }
+
+    def prefix_summary(self, max_roots: int = 8) -> dict:
+        """Host-side summary of the prefix index for a FLEET ROUTER
+        (serving/router.py): enough to score a candidate prompt's
+        expected cached-token count on this pool WITHOUT reaching into
+        pool internals.  ``hashes`` is every indexed chain hash (hex; at
+        most ``capacity_blocks`` 16-byte digests, so the summary stays
+        cheap); a router chains the prompt with :meth:`hash_chain` and
+        counts leading members — the same stop-at-first-miss walk
+        :meth:`match_prefix` performs.  ``roots`` are the most recently
+        registered depth-1 hashes (recent-first): the coarse "which
+        prompt families live here" signal for dashboards and logs."""
+        roots = [h.hex() for h in reversed(self._roots)]
+        return {
+            "block_size": self.block_size,
+            "cached_blocks": self.num_cached,
+            "indexed_blocks": len(self._hash_index),
+            "roots": roots[:max_roots],
+            "hashes": [h.hex() for h in self._hash_index],
         }
 
 

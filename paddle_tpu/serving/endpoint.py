@@ -22,9 +22,26 @@ from .scheduler import FINISHED, Request
 
 
 class Endpoint:
+    """``model`` may be a bare causal LM (an :class:`Engine` is built
+    from it with ``config``), an already-constructed :class:`Engine`, or
+    a :class:`~paddle_tpu.serving.router.Router` fleet — the router is
+    engine-shaped (same submit/step/run_until_complete/health surface),
+    so everything below works unchanged and ``health()`` reports
+    aggregate FLEET health."""
+
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  **generate_defaults):
-        self.engine = Engine(model, config)
+        from .router import Router
+
+        if isinstance(model, (Engine, Router)):
+            if config is not None:
+                raise ValueError(
+                    "pass ServingConfig when Endpoint builds the engine "
+                    "from a model; a prebuilt Engine/Router already "
+                    "carries its config")
+            self.engine = model
+        else:
+            self.engine = Engine(model, config)
         self._defaults = generate_defaults
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}

@@ -62,6 +62,11 @@ def _trace(name: str):
 class ServingConfig:
     """Engine tuning knobs (README "Serving" documents each)."""
 
+    # replica name (serving/router.py fleets): suffixes the watchdog /
+    # chaos step labels as "serving::decode_step@<name>" so per-replica
+    # fault injection and metrics can target ONE engine of a fleet.
+    # Empty (the default) keeps the bare single-engine labels.
+    name: str = ""
     max_batch_size: int = 8       # decode-bucket slots
     block_size: int = 16          # KV-cache tokens per block
     num_blocks: int = 128         # pool size incl. reserved block 0
@@ -742,9 +747,22 @@ class Engine:
         underlying fault is gone."""
         self.overload.health.revive()
 
+    def pending_prefill_tokens(self) -> int:
+        """Prompt tokens admitted-but-uncomputed plus everything still
+        waiting in the queue — the prefill backlog a new arrival queues
+        behind.  The router's load signal and the TTFT estimator's
+        numerator (serving/overload.py) read the same number."""
+        pending = sum(r.prompt_len - r.prefill_pos
+                      for r in self.scheduler.running
+                      if r.state == PREFILLING)
+        pending += sum(r.prompt_len for r in self.scheduler.waiting)
+        return pending
+
     def stats(self) -> dict:
         d = self.metrics.as_dict()
         d["pool"] = self.pool.stats()
         d["queue_depth"] = len(self.scheduler.waiting)
+        d["pending_prefill_tokens"] = self.pending_prefill_tokens()
+        d["prefix_index"] = self.pool.prefix_summary()
         d["health"] = self.health()
         return d

@@ -323,14 +323,18 @@ class OverloadController:
         self.ladder = DegradationLadder(
             metrics, high=config.kv_high_watermark,
             low=config.kv_low_watermark)
+        # a named replica (ServingConfig(name=...), fleet routing) tags
+        # its step labels so chaos plans and metrics can target ONE
+        # engine; the default stays the bare single-engine label
+        tag = f"@{config.name}" if getattr(config, "name", "") else ""
         self.prefill_watchdog = StepWatchdog(
-            "serving::prefill_step", self.chunk_ewma, self.health,
+            f"serving::prefill_step{tag}", self.chunk_ewma, self.health,
             metrics, budget_mult=config.watchdog_budget_mult,
             floor_s=config.watchdog_floor_s,
             max_retries=config.step_max_retries,
             backoff_s=config.step_retry_backoff_s)
         self.decode_watchdog = StepWatchdog(
-            "serving::decode_step", self.decode_ewma, self.health,
+            f"serving::decode_step{tag}", self.decode_ewma, self.health,
             metrics, budget_mult=config.watchdog_budget_mult,
             floor_s=config.watchdog_floor_s,
             max_retries=config.step_max_retries,
@@ -354,12 +358,7 @@ class OverloadController:
         C = engine.chunk_tokens
         chunk_s = self.chunk_ewma.value
         decode_s = self.decode_ewma.value or 0.0
-        from .scheduler import PREFILLING
-
-        pending = sum(r.prompt_len - r.prefill_pos
-                      for r in engine.scheduler.running
-                      if r.state == PREFILLING)
-        pending += sum(r.prompt_len for r in engine.scheduler.waiting)
+        pending = engine.pending_prefill_tokens()
         matched, _, _ = engine.pool.admission_plan(prompt, extra_tokens=0)
         own = max(1, len(prompt) - len(matched) * engine.pool.block_size)
         chunks = math.ceil(pending / C) + math.ceil(own / C)

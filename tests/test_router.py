@@ -1,0 +1,439 @@
+"""paddle_tpu.serving.router + replay — the fleet-router done bar.
+
+ISSUE 17 acceptance pinned here: two-replica router outputs are
+TOKEN-EXACT with a single engine and with sequential ``generate()``;
+placement is DETERMINISTIC (seeded tie-breaks only — byte-identical
+placement logs on fresh fleets); shared-prefix requests consolidate on
+one replica (affinity); hopeless-deadline requests shed at the FLEET
+boundary before any replica spends KV; a chaos-killed replica drains
+and resubmits with zero lost requests; and the router/replay sources
+stay H111-clean (monotonic clock only).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience.chaos import FaultPlan
+from paddle_tpu.serving import (FINISHED, ROUTER_POLICIES, AdmissionError,
+                                Endpoint, Engine, Router, ServingConfig,
+                                Tenant, build_trace, default_tenants,
+                                replay_trace)
+
+
+# One model for the whole module (test_serving.py pattern): compiled
+# steps are cached on it by weights fingerprint, so every fleet built
+# here shares executables instead of recompiling.
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompts(lengths, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(L,)).astype(np.int32)
+            for L in lengths]
+
+
+def _reference(model, prompt, **kw):
+    """Sequential greedy generate() — the parity oracle."""
+    out = model.generate(paddle.to_tensor(prompt[None, :]),
+                         temperature=0.0, use_static_cache=True, **kw)
+    return np.asarray(out.numpy())[0]
+
+
+def _engine(model, name="", **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_queue_len", 32)
+    kw.setdefault("chunk_tokens", 16)
+    return Engine(model, ServingConfig(name=name, **kw))
+
+
+def _fleet(model, n=2, engine_kw=None, **router_kw):
+    engines = [_engine(model, name=f"replica-{i}", **(engine_kw or {}))
+               for i in range(n)]
+    return Router(engines, **router_kw), engines
+
+
+# ---------------------------------------------------------------------------
+# construction contracts
+# ---------------------------------------------------------------------------
+
+class TestRouterValidation:
+    def test_needs_at_least_one_replica(self):
+        with pytest.raises(ValueError, match="at least one"):
+            Router([])
+
+    def test_unknown_policy(self, model):
+        with pytest.raises(ValueError, match="unknown policy"):
+            Router([_engine(model)], policy="sticky")
+        assert set(ROUTER_POLICIES) == {"affinity", "round_robin"}
+
+    def test_mixed_block_size_rejected(self, model):
+        with pytest.raises(ValueError, match="block_size"):
+            Router([_engine(model, block_size=4),
+                    _engine(model, block_size=8)])
+
+    def test_duplicate_names_rejected(self, model):
+        with pytest.raises(ValueError, match="duplicate"):
+            Router([_engine(model, name="a"), _engine(model, name="a")])
+
+    def test_unnamed_replicas_get_positional_names(self, model):
+        router, _ = _fleet(model, n=2)
+        assert [r.name for r in router.replicas] == \
+            ["replica-0", "replica-1"]
+
+
+# ---------------------------------------------------------------------------
+# parity: 2-replica router == single engine == generate()
+# ---------------------------------------------------------------------------
+
+class TestRouterParity:
+    def test_token_parity_with_engine_and_generate(self, model):
+        prompts = _prompts([5, 9, 3, 12, 7, 6], seed=1)
+        router, engines = _fleet(model, n=2)
+        fleet_out = router.generate(prompts, max_new_tokens=6)
+        single = _engine(model).generate(list(prompts), max_new_tokens=6)
+        for i, (a, b) in enumerate(zip(fleet_out, single)):
+            assert np.array_equal(a, b), f"request {i}: fleet != engine"
+        for i in (0, 3):
+            ref = _reference(model, prompts[i], max_new_tokens=6)
+            assert np.array_equal(fleet_out[i], ref), i
+        # the engines' no-retrace contract is untouched by routing
+        for eng in engines:
+            assert eng._decode_step.retraces == 0
+            assert eng._prefill_step.retraces == 0
+            eng.pool.check_leaks()
+
+
+# ---------------------------------------------------------------------------
+# deterministic placement (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestPlacementDeterminism:
+    def test_cold_fleet_placement_log_byte_identical(self, model):
+        """Two fresh fleets, same prompts + seed: cold EWMAs score by
+        token counts alone and ties break by the seeded rng, so the
+        placement logs are byte-identical."""
+        prompts = _prompts([8, 8, 5, 8, 11, 8, 6, 8], seed=2)
+        shared = _prompts([20], seed=3)[0]
+        prompts += [np.concatenate([shared, p]) for p in
+                    _prompts([3, 5, 2], seed=4)]
+        logs = []
+        for _ in range(2):
+            router, _ = _fleet(model, n=2, seed=7)
+            for p in prompts:
+                router.submit(p, max_new_tokens=4)
+            logs.append(router.placement_log_text())
+            done = router.run_until_complete()
+            assert len(done) == len(prompts)
+        assert logs[0] == logs[1]
+        assert len(logs[0].splitlines()) == len(prompts)
+
+    def test_round_robin_rotates(self, model):
+        router, _ = _fleet(model, n=2, policy="round_robin")
+        for p in _prompts([6, 6, 6, 6], seed=5):
+            router.submit(p, max_new_tokens=2)
+        assert router.metrics.placements == \
+            {"replica-0": 2, "replica-1": 2}
+        router.run_until_complete()
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity placement
+# ---------------------------------------------------------------------------
+
+class TestAffinity:
+    def test_shared_prefix_family_consolidates(self, model):
+        """A burst sharing one system prompt lands on ONE replica even
+        before the first prefill registers the prefix (the pending-hash
+        signal), while unrelated prompts spread by load."""
+        router, _ = _fleet(model, n=2)
+        system = _prompts([32], seed=6)[0]
+        family = [np.concatenate([system, t])
+                  for t in _prompts([5, 3, 7, 4], seed=7)]
+        solo = _prompts([9, 6], seed=8)
+        reqs = [router.submit(p, max_new_tokens=4)
+                for p in family + solo]
+        done = router.run_until_complete()
+        assert len(done) == len(reqs)
+        family_rids = {r.request_id for r in reqs[:len(family)]}
+        homes = {line.split(" -> ")[1].split()[0]
+                 for line in router.placement_log
+                 if line.split(" -> ")[0] in family_rids}
+        assert len(homes) == 1, f"family scattered across {homes}"
+        # follow-ups scored nonzero expected-cached tokens
+        affs = [int(line.split("aff=")[1].split()[0])
+                for line in router.placement_log
+                if line.split(" -> ")[0] in family_rids]
+        assert affs[0] == 0 and all(a > 0 for a in affs[1:]), affs
+
+    def test_registered_prefix_attracts_follow_up(self, model):
+        """After a request finishes (prefix registered in the pool), a
+        same-prefix follow-up scores affinity from the REGISTERED index
+        — no pending hashes involved."""
+        router, _ = _fleet(model, n=2)
+        shared = _prompts([24], seed=9)[0]
+        first = np.concatenate([shared, _prompts([4], seed=10)[0]])
+        router.generate([first], max_new_tokens=2)
+        home = router.placement_log[0].split(" -> ")[1].split()[0]
+        for rep in router.replicas:       # isolate the registered index
+            rep.pending_hashes.clear()
+        follow = np.concatenate([shared, _prompts([6], seed=11)[0]])
+        router.generate([follow], max_new_tokens=2)
+        line = router.placement_log[1]
+        assert line.split(" -> ")[1].split()[0] == home
+        assert int(line.split("aff=")[1].split()[0]) > 0
+
+
+# ---------------------------------------------------------------------------
+# global admission control (fleet-boundary shedding)
+# ---------------------------------------------------------------------------
+
+def _warm_estimators(router, chunk_s=0.5, decode_s=0.05):
+    """Make every replica's TTFT estimator 'warmed' without running
+    steps: first observation is recorded as compile, the second as the
+    steady-state value (overload.LatencyEWMA contract)."""
+    for rep in router.replicas:
+        ov = rep.engine.overload
+        for _ in range(2):
+            ov.chunk_ewma.observe(chunk_s)
+            ov.decode_ewma.observe(decode_s)
+        assert ov.can_estimate()
+
+
+class TestGlobalShedding:
+    def test_hopeless_deadline_sheds_at_fleet_boundary(self, model):
+        router, engines = _fleet(model, n=2)
+        _warm_estimators(router)          # every chunk "costs" 0.5s
+        req = router.submit(_prompts([20], seed=12)[0],
+                            max_new_tokens=4, deadline_s=1e-4)
+        assert req.state == FINISHED and req.finish_reason == "shed"
+        assert router.metrics.shed_global == 1
+        assert router.placement_log[-1].endswith("SHED policy=global")
+        # shed BEFORE any replica spent queue space or KV — the
+        # per-engine shed counters stay zero
+        for eng in engines:
+            assert eng.metrics.shed == 0
+            assert not eng.has_work()
+            eng.pool.check_leaks()
+        done = router.run_until_complete()
+        assert set(done) == {req.request_id}   # retired, never lost
+
+    def test_cold_fleet_admits_instead_of_shedding(self, model):
+        """A cold replica might serve the request fine — with no warmed
+        estimate anywhere, the router must admit, not guess."""
+        router, _ = _fleet(model, n=2)
+        req = router.submit(_prompts([8], seed=13)[0],
+                            max_new_tokens=2, deadline_s=1e-4)
+        assert req.finish_reason != "shed"
+        assert router.metrics.shed_global == 0
+        done = router.run_until_complete()
+        assert req.request_id in done
+
+    def test_global_shedding_can_be_disabled(self, model):
+        router, _ = _fleet(model, n=2, enable_global_shedding=False)
+        _warm_estimators(router)
+        req = router.submit(_prompts([20], seed=12)[0],
+                            max_new_tokens=2, deadline_s=1e-4)
+        assert router.metrics.shed_global == 0
+        # the per-engine estimator remains the backstop: the replica
+        # itself sheds (estimates are warmed there too)
+        assert req.finish_reason == "shed"
+        done = router.run_until_complete()
+        assert req.request_id in done
+
+
+# ---------------------------------------------------------------------------
+# replica failure: quarantine -> drain -> resubmit (satellite 4)
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_replica_kill_zero_lost_token_parity(self, model):
+        chaos_kw = dict(step_max_retries=1, step_retry_backoff_s=0.0)
+        router, engines = _fleet(model, n=2, engine_kw=chaos_kw)
+        prompts = _prompts([6, 10, 5, 8, 7], seed=14)
+        refs = [_reference(model, p, max_new_tokens=4) for p in prompts]
+        reqs = [router.submit(p, max_new_tokens=4) for p in prompts]
+        with FaultPlan(step_fault_scope="@replica-1",
+                       fail_step_at={1, 2}):
+            done = router.run_until_complete()
+        assert router.metrics.quarantines == 1
+        assert router.metrics.resubmits > 0
+        assert len(done) == len(reqs)              # zero lost requests
+        for rq, ref in zip(reqs, refs):
+            out = done[rq.request_id]
+            assert out.finish_reason == "length", out.finish_reason
+            assert np.array_equal(out.output_ids(), ref)
+        h = router.health()
+        assert h["state"] == "degraded"
+        assert h["failed_replicas"] == 1
+        assert h["serving_replicas"] == 1
+        for eng in engines:
+            assert eng._decode_step.retraces == 0
+            eng.pool.check_leaks()                 # drain freed the KV
+        router.revive("replica-1")
+        assert router.health()["state"] == "serving"
+
+    def test_no_healthy_replica_retires_explicitly(self, model):
+        """When the LAST replica dies, stranded requests finish with
+        ``finish_reason="error"`` — explicitly retired, never lost —
+        and submit() raises until an operator revives the fleet."""
+        chaos_kw = dict(step_max_retries=1, step_retry_backoff_s=0.0)
+        router, _ = _fleet(model, n=1, engine_kw=chaos_kw)
+        reqs = [router.submit(p, max_new_tokens=3)
+                for p in _prompts([5, 7, 4], seed=15)]
+        with FaultPlan(step_fault_scope="@replica-0",
+                       fail_step_at={1, 2}):
+            done = router.run_until_complete()
+        assert len(done) == len(reqs)
+        assert all(done[r.request_id].finish_reason == "error"
+                   for r in reqs)
+        assert router.health()["state"] == "failed"
+        with pytest.raises(AdmissionError, match="revive"):
+            router.submit(_prompts([4], seed=16)[0])
+        router.revive()
+        out = router.generate(_prompts([5], seed=17)[0:1],
+                              max_new_tokens=2)
+        assert len(out) == 1
+
+
+# ---------------------------------------------------------------------------
+# observation: health()/stats() aggregation + endpoint integration
+# ---------------------------------------------------------------------------
+
+class TestObservation:
+    def test_stats_and_health_schema(self, model):
+        router, _ = _fleet(model, n=2)
+        router.generate(_prompts([6, 9], seed=18), max_new_tokens=3)
+        st = router.stats()
+        r = st["router"]
+        assert r["policy"] == "affinity" and r["seed"] == 0
+        assert r["replicas"] == ["replica-0", "replica-1"]
+        assert r["requests_submitted"] == 2
+        assert sum(r["placements"].values()) == 2
+        assert 0.0 <= r["cached_token_ratio"] <= 1.0
+        assert 0.0 <= r["affinity_token_ratio"] <= 1.0
+        for name in ("replica-0", "replica-1"):
+            rep = st["replicas"][name]
+            assert "pending_prefill_tokens" in rep
+            assert "prefix_index" in rep
+        h = router.health()
+        assert h["state"] == "serving"
+        assert h["serving_replicas"] == 2 and h["failed_replicas"] == 0
+        assert h["queue_depth"] == 0
+        assert h["pending_prefill_tokens"] == 0
+        assert set(h["replicas"]) == {"replica-0", "replica-1"}
+
+    def test_endpoint_accepts_router(self, model):
+        from paddle_tpu.inference import create_serving_endpoint
+
+        router, _ = _fleet(model, n=2)
+        ep = Endpoint(router)
+        prompts = _prompts([5, 8, 6], seed=19)
+        outs = ep.run(prompts, max_new_tokens=4)
+        single = _engine(model).generate(list(prompts), max_new_tokens=4)
+        for a, b in zip(outs, single):
+            assert np.array_equal(a, b)
+        assert ep.health()["serving_replicas"] == 2     # fleet health
+        ep2 = create_serving_endpoint(_fleet(model, n=2)[0],
+                                      max_new_tokens=2)
+        assert len(ep2.run(prompts[:1])) == 1
+
+    def test_endpoint_rejects_config_with_prebuilt(self, model):
+        router, _ = _fleet(model, n=1)
+        with pytest.raises(ValueError, match="carries its config"):
+            Endpoint(router, ServingConfig())
+        with pytest.raises(ValueError, match="carries its config"):
+            Endpoint(_engine(model), ServingConfig())
+
+
+# ---------------------------------------------------------------------------
+# trace replay (the bench harness is itself under test)
+# ---------------------------------------------------------------------------
+
+class TestReplay:
+    def test_trace_is_seed_deterministic(self):
+        a = build_trace(seed=21, horizon=12)
+        b = build_trace(seed=21, horizon=12)
+        assert len(a) == len(b) == \
+            sum(t.requests for t in default_tenants())
+        for x, y in zip(a, b):
+            assert (x.step, x.tenant, x.request_id) == \
+                (y.step, y.tenant, y.request_id)
+            assert np.array_equal(x.prompt, y.prompt)
+        c = build_trace(seed=22, horizon=12)
+        assert any(not np.array_equal(x.prompt, y.prompt)
+                   for x, y in zip(a, c))
+
+    def test_burst_tenant_clumps_and_prefixes_shared(self):
+        trace = build_trace(seed=0, horizon=16)
+        burst = [a for a in trace if a.tenant == "burst"]
+        steps = {a.step for a in burst}
+        assert max(steps) - min(steps) <= 1    # two-iteration window
+        chat = [a for a in trace if a.tenant == "chat"]
+        shared = chat[0].prompt[:48]
+        assert all(np.array_equal(a.prompt[:48], shared) for a in chat)
+        assert all(a.prompt.min() >= 1 for a in trace)  # no pad ids
+
+    def test_replay_accounts_every_request(self, model):
+        tenants = [Tenant("chat", requests=5, shared_prefix_tokens=24,
+                          tail_tokens=(2, 6), max_new_tokens=3),
+                   Tenant("burst", kind="burst", requests=4,
+                          shared_prefix_tokens=12, tail_tokens=(2, 4),
+                          max_new_tokens=2)]
+        router, _ = _fleet(model, n=2)
+        report = replay_trace(
+            router, build_trace(tenants, seed=23, horizon=8))
+        assert set(report["tenants"]) == {"chat", "burst"}
+        for name, t in report["tenants"].items():
+            n = {"chat": 5, "burst": 4}[name]
+            assert t["submitted"] == n
+            assert sum(t["finished"].values()) == n    # all accounted
+            assert t["finished"].get("length", 0) == n
+            assert t["goodput_tokens"] > 0
+        fl = report["fleet"]
+        assert fl["requests"] == 9
+        assert fl["policy"] == "affinity"
+        assert fl["quarantines"] == 0 and fl["resubmits"] == 0
+
+    def test_affinity_beats_round_robin_on_cached_tokens(self, model):
+        """The bench's headline claim, in miniature: one trace, two
+        fleets — affinity must reuse at least as many prompt tokens
+        from the prefix caches as round-robin duplicates."""
+        tenants = [Tenant("chat", requests=6, shared_prefix_tokens=48,
+                          tail_tokens=(2, 6), max_new_tokens=2)]
+        trace = build_trace(tenants, seed=24, horizon=6)
+        ratios = {}
+        for policy in ("affinity", "round_robin"):
+            router, _ = _fleet(model, n=2, policy=policy,
+                               affinity_weight=8.0)
+            ratios[policy] = replay_trace(
+                router, trace)["fleet"]["cached_token_ratio"]
+        assert ratios["affinity"] >= ratios["round_robin"], ratios
+        assert ratios["affinity"] > 0
+
+
+# ---------------------------------------------------------------------------
+# hazards: the router layer inherits the serving clock discipline
+# ---------------------------------------------------------------------------
+
+class TestRouterHazards:
+    def test_h111_clean(self):
+        """Deadline math in the router/replay layer must be monotonic-
+        clock only (H111) — not even timestamp warnings."""
+        import paddle_tpu.serving as serving
+        from paddle_tpu.analysis import scan_wall_clock_deadlines
+
+        root = os.path.dirname(serving.__file__)
+        diags = scan_wall_clock_deadlines(
+            [os.path.join(root, "router.py"),
+             os.path.join(root, "replay.py")])
+        assert diags == [], diags

@@ -270,6 +270,32 @@ class TestMetrics:
         assert 0 < g["batch_occupancy_avg"] <= 1
         assert 0 <= g["cache_utilization_avg"] <= 1
 
+    def test_stats_contract_for_router(self, model):
+        """The load/affinity signals the fleet router places by are part
+        of the ``stats()`` contract: ``pending_prefill_tokens`` (exact
+        backlog token count) and ``prefix_index`` (the pool's prefix-
+        cache summary in hex)."""
+        eng = Engine(model, _config())
+        eng.submit(_prompts([6, 9], seed=3)[0], max_new_tokens=2)
+        eng.submit(_prompts([6, 9], seed=3)[1], max_new_tokens=2)
+        st = eng.stats()
+        assert st["queue_depth"] == 2
+        assert st["pending_prefill_tokens"] == 15       # 6 + 9, untouched
+        assert st["pending_prefill_tokens"] == eng.pending_prefill_tokens()
+        eng.run_until_complete()
+        st = eng.stats()
+        assert st["pending_prefill_tokens"] == 0
+        idx = st["prefix_index"]
+        assert idx["block_size"] == eng.config.block_size
+        assert idx["indexed_blocks"] >= 1               # prompts registered
+        assert idx["cached_blocks"] >= 0
+        hashes = idx["hashes"]
+        assert hashes and len(hashes) == idx["indexed_blocks"]
+        for h in hashes + idx["roots"]:
+            int(h, 16)                                  # hex digests
+            assert len(h) == 32                         # blake2b-128
+        assert set(idx["roots"]) <= set(hashes)
+
     def test_chrome_export(self, model, tmp_path):
         import json
 

@@ -131,6 +131,15 @@ python examples/hybrid_parallel_llama.py
 python examples/resilient_train.py --steps 8 --kill-at 5
 python examples/observe_train.py --steps 20
 
+echo "== serving fleet router (affinity placement + replica chaos) =="
+# two named replicas behind serving.Router: a shared-prefix burst must
+# consolidate on one replica (prefix-affinity placement), then a
+# replica-scoped FaultPlan kills replica-1 mid-burst — the router
+# quarantines it, drains the stranded requests and resubmits them to
+# the survivor with zero lost requests and token parity against a
+# single-engine run (README: Serving fleet & router)
+python examples/serve_llama.py --router
+
 echo "== multichip dryrun =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
