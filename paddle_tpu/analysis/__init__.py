@@ -25,8 +25,9 @@ from .verifier import (ERROR, INFO, WARNING, Diagnostic,
                        ProgramVerificationError, verify_program)
 from .hazards import (scan, scan_checkpoint_writes, scan_decode_step,
                       scan_decode_steps, scan_device_count_assumptions,
-                      scan_function, scan_program, scan_static_function,
-                      scan_wall_clock_deadlines, sort_diagnostics)
+                      scan_function, scan_process_write_races, scan_program,
+                      scan_static_function, scan_wall_clock_deadlines,
+                      sort_diagnostics)
 from . import astlint
 from . import topology
 from . import xray
@@ -54,6 +55,7 @@ __all__ = [
     "scan_checkpoint_writes",
     "scan_wall_clock_deadlines",
     "scan_device_count_assumptions",
+    "scan_process_write_races",
     "sort_diagnostics",
     "set_pass_verification",
     "pass_verification",

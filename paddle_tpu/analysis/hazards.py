@@ -45,6 +45,21 @@ exactly that class of defect:
   (timestamps for logs/filenames are legitimate wall-clock uses, but
   deserve a look when they sit in serving/resilience paths).
 
+- **H113 multi-process checkpoint write race**: a filesystem write
+  (``open(..., 'w')``, ``np.save``, ``os.rename``/``os.replace``
+  commit) on a checkpoint-hinted path (``ckpt``/``checkpoint``/
+  ``manifest``/``staging``/``shard``) that is neither gated on the
+  coordinator (``process_index() == 0`` / ``is_coordinator`` /
+  rank test) nor made per-process-unique (``getpid``/``uuid``/
+  ``process``/``rank`` in the name).  Under ``jax.distributed`` every
+  host runs the same Python, so an ungated write means N processes
+  racing one path over shared storage — the classic torn-manifest
+  corruption the sharded checkpoint protocol exists to prevent.
+  ``scan_process_write_races()`` audits source trees; the sanctioned
+  atomic-writer modules (which implement the gating) are excluded,
+  and a deliberate single-process write is suppressed with
+  ``# lint-tpu: disable=H113`` on the flagged line.
+
 - **H112 single-process device-count assumption**:
   ``jax.device_count()`` / ``len(jax.devices())`` return the GLOBAL
   device count — under ``jax.distributed`` a process can only address
@@ -79,6 +94,7 @@ __all__ = [
     "scan_checkpoint_writes",
     "scan_wall_clock_deadlines",
     "scan_device_count_assumptions",
+    "scan_process_write_races",
     "scan",
     "sort_diagnostics",
 ]
@@ -760,6 +776,208 @@ def scan_device_count_assumptions(paths) -> List[Diagnostic]:
         if "lint-tpu: disable-file=H112" in src:
             continue
         scanner = _DeviceCountScanner(f, src.splitlines())
+        scanner.visit(tree)
+        diags.extend(scanner.diags)
+    return sort_diagnostics(diags)
+
+
+# ---------------------------------------------------------------------------
+# multi-process checkpoint write-race scan (H113)
+# ---------------------------------------------------------------------------
+
+#: path vocabulary that marks a write target as checkpoint machinery —
+#: the paths where an N-way clobber race corrupts recovery state
+_H113_PATH_HINTS = ("ckpt", "checkpoint", "manifest", "staging", "shard")
+#: identifier vocabulary that marks an ``if`` test as a process gate
+_H113_GATE_HINTS = ("process_index", "process_id", "is_coordinator",
+                    "process_count", "rank", "trainer_id", "coordinator")
+#: path vocabulary that makes a write per-process-unique (no race even
+#: when every host writes: each writes its OWN file)
+_H113_UNIQUE_HINTS = ("getpid", "pid", "uuid", "process", "rank",
+                      "trainer", "host_id", "local_", "worker")
+
+
+def _h113_expr_mentions(node, vocab, taint=None, flag=None) -> bool:
+    """Any identifier/attribute/string/f-string piece inside ``node``
+    matches ``vocab``; a ``Name`` also matches when the per-function
+    ``taint`` map carries ``flag`` for it (one-hop dataflow through
+    simple assignments like ``path = os.path.join(d, 'manifest')``)."""
+    for n in ast.walk(node):
+        text = None
+        if isinstance(n, ast.Name):
+            text = n.id
+            if taint is not None and flag in taint.get(n.id, ()):
+                return True
+        elif isinstance(n, ast.Attribute):
+            text = n.attr
+        elif isinstance(n, ast.arg):
+            text = n.arg
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            text = n.value
+        if text is not None and any(h in text.lower() for h in vocab):
+            return True
+    return False
+
+
+class _ProcessWriteScanner(ast.NodeVisitor):
+    """H113: checkpoint-path filesystem writes every process executes.
+
+    A write is GATED (not flagged) when any lexically-enclosing ``if``
+    tests process identity, or an earlier guard-return in the same
+    function (``if process_index() != 0: return``) fences it.  A write
+    is SAFE when its target path is per-process-unique.  Everything
+    else on a checkpoint-hinted path is the race."""
+
+    def __init__(self, filename: str, lines: List[str]):
+        self.filename = filename
+        self.lines = lines
+        self.diags: List[Diagnostic] = []
+        self._gate_depth = 0
+        # lineno of each guard-return per enclosing function (stack)
+        self._guard_lines: List[List[int]] = []
+        self._taint: List[dict] = []
+
+    # -- bookkeeping -----------------------------------------------------
+    def _suppressed(self, lineno: int) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            return "lint-tpu: disable=H113" in self.lines[lineno - 1]
+        return False
+
+    def visit_FunctionDef(self, node):
+        self._guard_lines.append([])
+        self._taint.append({})
+        self.generic_visit(node)
+        self._taint.pop()
+        self._guard_lines.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        # one-hop taint: name = <expr mentioning hints/unique tokens>
+        if self._taint and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            flags = set()
+            if _h113_expr_mentions(node.value, _H113_PATH_HINTS,
+                                   self._taint[-1], "hinted"):
+                flags.add("hinted")
+            if _h113_expr_mentions(node.value, _H113_UNIQUE_HINTS,
+                                   self._taint[-1], "unique"):
+                flags.add("unique")
+            self._taint[-1][node.targets[0].id] = flags
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        gated = _h113_expr_mentions(node.test, _H113_GATE_HINTS)
+        if gated:
+            # `if rank != 0: return` fences everything after it too
+            if self._guard_lines and any(
+                    isinstance(s, (ast.Return, ast.Raise, ast.Continue))
+                    for s in node.body):
+                self._guard_lines[-1].append(node.lineno)
+            self._gate_depth += 1
+        self.generic_visit(node)
+        if gated:
+            self._gate_depth -= 1
+
+    def _is_gated(self, lineno: int) -> bool:
+        if self._gate_depth > 0:
+            return True
+        return bool(self._guard_lines
+                    and any(g < lineno for g in self._guard_lines[-1]))
+
+    # -- write sites -----------------------------------------------------
+    def _check_path(self, path_node, what, node):
+        taint = self._taint[-1] if self._taint else {}
+        if not _h113_expr_mentions(path_node, _H113_PATH_HINTS,
+                                   taint, "hinted"):
+            return
+        if _h113_expr_mentions(path_node, _H113_UNIQUE_HINTS,
+                               taint, "unique"):
+            return
+        if self._is_gated(node.lineno) or self._suppressed(node.lineno):
+            return
+        self.diags.append(Diagnostic(
+            "H113", ERROR,
+            f"{what} a checkpoint path with no process gate — under "
+            "jax.distributed EVERY host runs this line, so N processes "
+            "race one file over shared storage (torn manifest / clobbered "
+            "shard).  Gate on bootstrap.is_coordinator() / "
+            "process_index() == 0, or make the path per-process-unique",
+            f"{self.filename}:{node.lineno}"))
+
+    def visit_Call(self, node):
+        fn = node.func
+        # open(path, 'w'/'a'/...)
+        if isinstance(fn, ast.Name) and fn.id == "open" and node.args:
+            mode = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if "w" in mode or "a" in mode or "x" in mode:
+                self._check_path(node.args[0],
+                                 f"open(..., {mode!r}) writes", node)
+        # os.rename / os.replace — the COMMIT half of tmp+rename; racing
+        # commits are exactly the torn-manifest failure
+        elif isinstance(fn, ast.Attribute) \
+                and fn.attr in ("rename", "replace", "renames") \
+                and isinstance(fn.value, ast.Name) and fn.value.id == "os" \
+                and len(node.args) >= 2:
+            self._check_path(node.args[1], f"os.{fn.attr}(...) commits to",
+                             node)
+        # np.save / np.savez*(path, ...)
+        elif isinstance(fn, ast.Attribute) \
+                and fn.attr in ("save", "savez", "savez_compressed") \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("np", "numpy") and node.args:
+            self._check_path(node.args[0], f"{fn.value.id}.{fn.attr}(...) "
+                             "writes", node)
+        # shutil.copy*/move(..., dst)
+        elif isinstance(fn, ast.Attribute) \
+                and fn.attr in ("copy", "copy2", "copyfile", "move") \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "shutil" and len(node.args) >= 2:
+            self._check_path(node.args[1], f"shutil.{fn.attr}(...) "
+                             "writes", node)
+        self.generic_visit(node)
+
+
+def scan_process_write_races(paths, exclude=_CKPT_SANCTIONED
+                             ) -> List[Diagnostic]:
+    """H113-audit python sources for checkpoint-path writes that every
+    process would execute.  ``paths`` is a file, a directory (walked for
+    ``.py``), or a list of either — typically ``paddle_tpu/`` and
+    ``examples/``.  ``exclude`` suffixes name the sanctioned atomic-
+    writer modules, which implement the per-process gating themselves;
+    suppress a deliberate single-process write with
+    ``# lint-tpu: disable=H113`` on the flagged line."""
+    import os
+
+    if isinstance(paths, (str, bytes)):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    diags: List[Diagnostic] = []
+    for f in sorted(files):
+        norm = f.replace("\\", "/")
+        if any(norm.endswith(sfx) for sfx in exclude):
+            continue
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        if "lint-tpu: disable-file=H113" in src:
+            continue
+        scanner = _ProcessWriteScanner(f, src.splitlines())
         scanner.visit(tree)
         diags.extend(scanner.diags)
     return sort_diagnostics(diags)

@@ -18,6 +18,10 @@ from .collective import (  # noqa: F401
     wait, Group, ParallelMode, ReduceOp,
 )
 from .parallel import init_parallel_env  # noqa: F401
+from . import bootstrap  # noqa: F401
+from .bootstrap import (ClusterInfo, ProcessContext,  # noqa: F401
+                        emulated_process_context, initialize_cluster,
+                        spawn_local)
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .parallel_layers import (  # noqa: F401

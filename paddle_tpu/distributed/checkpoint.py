@@ -37,7 +37,14 @@ def save_state_dict(state_dict, path, async_save=False):
     crash mid-save must never destroy the previous checkpoint at
     ``path`` — the torn-save half of the resilience fault model
     (README "Resilience"; orbax gets the same property from its own
-    commit-marker protocol)."""
+    commit-marker protocol).
+
+    Multi-process discipline: orbax already writes each shard from the
+    host that owns it and commits from one host.  The pickle fallback
+    writes the FULL state, so under ``process_count() > 1`` only
+    process 0 commits it (every host clobbering the same ``path`` over
+    shared storage is the classic manifest-corruption race — hazard
+    H113); the other processes barrier until the commit lands."""
     try:
         import orbax.checkpoint as ocp
 
@@ -48,15 +55,19 @@ def save_state_dict(state_dict, path, async_save=False):
         return
     except ImportError:
         from ..framework.io import save as fsave
+        from . import bootstrap
 
-        tmp = f"{path}.tmp-{os.getpid()}"
-        try:
-            fsave(state_dict, tmp)
-            os.replace(tmp, path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-            raise
+        ctx = bootstrap.cluster_context()
+        if ctx.is_coordinator:
+            tmp = f"{path}.tmp-p{ctx.index}-{os.getpid()}"
+            try:
+                fsave(state_dict, tmp)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+        ctx.barrier(f"save_state_dict:{os.path.basename(str(path))}")
 
 
 def load_state_dict(path, target_state_dict=None):
@@ -84,7 +95,15 @@ def load_state_dict(path, target_state_dict=None):
 
 class AsyncCheckpointer:
     """Background checkpoint writer (the reference has no async save; hapi
-    callbacks block).  Keeps at most `max_to_keep` checkpoints."""
+    callbacks block).  Keeps at most `max_to_keep` checkpoints.
+
+    Multi-process discipline is orbax's: CheckpointManager must be
+    constructed on EVERY process of the fleet (it coordinates its own
+    per-process writes + barriers internally) — do not wrap calls in an
+    ``is_coordinator`` gate.  For the in-tree equivalent without the
+    orbax dependency, use ``resilience.ResilientCheckpointer`` — it
+    auto-switches to the sharded elastic protocol under
+    ``jax.distributed`` (README: Elastic multi-host checkpointing)."""
 
     def __init__(self, directory, max_to_keep=3):
         import orbax.checkpoint as ocp

@@ -153,6 +153,12 @@ class MeshExecutor:
         self.mesh = Mesh(
             np.asarray(devs[:need]).reshape(sizes), tuple(names))
         self.axes: Dict[str, int] = dict(zip(names, sizes))
+        # true when the mesh's devices span >1 process (the bootstrap's
+        # multi-controller runtime): host values then commit via
+        # make_array_from_callback and S209 audits aggregate per-process
+        self.multiprocess = len(
+            {getattr(d, "process_index", 0) for d in self.mesh.devices.flat}
+        ) > 1
         self.layout = layout if layout is not None else SpecLayout()
         # analysis.Topology: makes every shard plan this executor
         # requests price host-spanning collectives at DCN rates; the
@@ -215,14 +221,36 @@ class MeshExecutor:
 
     def put(self, value, spec=None, shape=None):
         """Commit an array (or Tensor ``_value``) onto the mesh.  Under
-        tracing, apply a sharding constraint instead."""
+        tracing, apply a sharding constraint instead.  When the mesh
+        spans processes, every process passes the same GLOBAL host value
+        and receives its addressable slice of the distributed array."""
         if shape is None:
             shape = tuple(np.shape(value))
         sh = self.sharding(spec, shape)
         if hasattr(value, "aval") and not hasattr(value,
                                                   "addressable_shards"):
             return jax.lax.with_sharding_constraint(value, sh)
+        if self.multiprocess and not hasattr(value, "addressable_shards"):
+            host = np.asarray(value)
+            return jax.make_array_from_callback(
+                tuple(shape), sh, lambda idx: host[idx])
         return jax.device_put(value, sh)
+
+    def fetch(self, value) -> np.ndarray:
+        """Host numpy view of a step output under any topology:
+        fully-addressable (single-process) arrays read directly; a
+        multi-process array reads via its local shards when replicated,
+        else through an allgather — so callers never trip the
+        'non-addressable array' fetch guard."""
+        if isinstance(value, Tensor):
+            value = value._value
+        if getattr(value, "is_fully_addressable", True) or \
+                getattr(value, "is_fully_replicated", False):
+            return np.asarray(value)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(
+            value, tiled=True))
 
     # ----- state layout ------------------------------------------------
     def shard_params(self, layer) -> int:
@@ -425,6 +453,10 @@ class MeshExecutor:
                       "per-axis size of the executor's mesh")
         for ax, sz in self.axes.items():
             g.set(int(sz), axis=ax)
+        reg.gauge("mesh_process_span",
+                  "distinct processes owning this mesh's devices").set(
+            len({getattr(d, "process_index", 0)
+                 for d in self.mesh.devices.flat}))
 
     # ----- S209 reconciliation -----------------------------------------
     def _plan_request(self):
@@ -571,8 +603,47 @@ class MeshExecutor:
         diags = self._reconcile_compiled(
             plan, entry._compiled, name="hapi::train_step",
             trailing_out_expect=expect)
+        diags = self._aggregate_process_diags(
+            "hapi::train_step", entry._compiled, diags)
         self.reports["hapi::train_step"] = (plan, diags)
         return plan, diags
+
+    def _aggregate_process_diags(self, name, compiled, diags):
+        """S209 across the process boundary: every process audits its
+        OWN compiled program; process 0's aggregation is an allgather of
+        each process's (diag count, collective-footprint fingerprint).
+        In a healthy SPMD fleet the rows are identical — a divergent row
+        means some host compiled different collectives than its peers
+        (skew in code, flags, or device slices), which no single-process
+        audit can see."""
+        if not self.multiprocess or jax.process_count() <= 1:
+            return diags
+        import json as _json
+        import zlib
+
+        from jax.experimental import multihost_utils
+
+        from ..analysis.verifier import Diagnostic, ERROR
+
+        hlo = ""
+        try:
+            hlo = compiled.as_text()
+        except Exception:  # noqa: BLE001
+            pass
+        counts = _hlo_collective_counts(hlo) if hlo else {}
+        fp = zlib.crc32(_json.dumps(sorted(counts.items())).encode()
+                        ) & 0x7FFFFFFF
+        row = np.array([len(diags), fp], dtype=np.int32)
+        rows = np.asarray(multihost_utils.process_allgather(row))
+        if not bool((rows == rows[0]).all()):
+            # identical on every process (allgather), so the fleet
+            # agrees on the verdict even though process 0 reports it
+            diags.append(Diagnostic(
+                S209, ERROR,
+                f"processes disagree on the compiled step: per-process "
+                f"(n_diags, collective_fingerprint) rows {rows.tolist()} "
+                "— some host is running a divergent program", name))
+        return diags
 
     def _serving_sds(self, arg, spec):
         """Mirror shardplan's spec broadcasting over container args and

@@ -45,9 +45,14 @@ def init_parallel_env():
         else:
             coord = store.get("jax_coordinator").decode()
         try:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=world,
+            from . import bootstrap
+
+            # bootstrap selects gloo TCP collectives before the CPU
+            # backend exists (without it every cross-process computation
+            # dies with "Multiprocess computations aren't implemented on
+            # the CPU backend") and guards re-entry.
+            bootstrap.initialize_cluster(
+                coordinator=coord, num_processes=world,
                 process_id=env.rank)
         except (RuntimeError, ValueError) as e:
             if "already" not in str(e).lower():

@@ -223,6 +223,12 @@ class Model:
         else:
             eval_loader = eval_data
 
+        from ..distributed import bootstrap
+        if not bootstrap.is_coordinator():
+            # one progress bar per fleet, not one per process — every
+            # host still runs the full loop (SPMD), only logging is
+            # coordinator-scoped
+            verbose = 0
         cbs = [ProgBarLogger(log_freq, verbose=verbose)]
         if self._optimizer is not None and \
                 self._optimizer._lr_scheduler is not None:

@@ -35,7 +35,8 @@ from . import chaos
 from .callback import ResilienceCallback
 from .chaos import ChaosError, FaultPlan, SimulatedPreemption
 from .checkpoint import (CheckpointCorruption, ResilientCheckpointer,
-                         apply_state, collect_state, host_snapshot)
+                         ShardedHostLeaf, apply_state, collect_state,
+                         host_snapshot)
 from .sentry import OK, REWIND, SKIP, Sentry
 
 __all__ = [
@@ -44,6 +45,7 @@ __all__ = [
     "SimulatedPreemption",
     "chaos",
     "ResilientCheckpointer",
+    "ShardedHostLeaf",
     "CheckpointCorruption",
     "collect_state",
     "apply_state",

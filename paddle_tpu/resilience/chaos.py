@@ -41,6 +41,7 @@ __all__ = [
     "FaultPlan",
     "ChaosError",
     "SimulatedPreemption",
+    "PROCESS_KILL_EXIT_CODE",
     "active_plan",
     "on_step",
     "on_save",
@@ -65,9 +66,24 @@ class SimulatedPreemption(ChaosError):
 
 _ACTIVE: Optional["FaultPlan"] = None
 
+#: exit code used by hard process kills (``kill_hard=True``) so launchers
+#: and tests can tell an injected death from a genuine crash.
+PROCESS_KILL_EXIT_CODE = 43
+
 
 def active_plan() -> Optional["FaultPlan"]:
     return _ACTIVE
+
+
+def _process_index() -> int:
+    """This process's cluster index (0 when not in a cluster) — lazy so
+    single-process chaos never pulls in the distributed stack."""
+    try:
+        from ..distributed import bootstrap
+
+        return bootstrap.process_index()
+    except Exception:
+        return 0
 
 
 class FaultPlan:
@@ -106,6 +122,29 @@ class FaultPlan:
         :class:`ChaosError` instead of running — the transient device
         failure the watchdog's bounded retry must absorb (consecutive
         ordinals exhaust the retries and quarantine the engine).
+    kill_process_at: ``{step: process_index}`` — process-scoped kill:
+        at ``on_step(step)``, ONLY the process whose cluster index
+        (``distributed.bootstrap.process_index()``) matches dies; its
+        peers keep running until the fleet supervisor notices.  With
+        ``kill_hard=False`` (default) the death is a raised
+        :class:`SimulatedPreemption`; with ``kill_hard=True`` it is
+        ``os._exit`` — no cleanup, no atexit, the honest SIGKILL stand-in
+        for multi-process crash tests.
+    kill_save_site: substring matched against checkpoint ``on_save``
+        sites; the first in-scope matching call (see
+        ``kill_save_site_ordinal``) dies mid-save.  The sharded
+        checkpointer's sites make every protocol window targetable:
+        ``"resilience::shard:"`` (mid-shard-write, torn shard file),
+        ``"resilience::shards_done"`` (between shards and manifest),
+        ``"resilience::manifest"`` (before the manifest lands),
+        ``"resilience::commit"`` (manifest written, rename pending).
+    save_fault_process: scope ``kill_save_site`` to one cluster process
+        index (``None`` = any process).
+    kill_save_site_ordinal: 1-based ordinal among in-scope matching
+        ``on_save`` calls that actually dies (default: the first).
+    kill_hard: make ``kill_process_at`` / ``kill_save_site`` deaths
+        ``os._exit(PROCESS_KILL_EXIT_CODE)`` instead of raised
+        exceptions.
     step_fault_scope: when set, ONLY serving-step attempts whose label
         contains this substring are counted and faulted — the others
         pass through untouched (their ordinals do not advance the
@@ -128,7 +167,12 @@ class FaultPlan:
                  step_delay_s: Union[None, float,
                                      Dict[int, float]] = None,
                  fail_step_at: Iterable[int] = (),
-                 step_fault_scope: Optional[str] = None):
+                 step_fault_scope: Optional[str] = None,
+                 kill_process_at: Optional[Dict[int, int]] = None,
+                 kill_save_site: Optional[str] = None,
+                 save_fault_process: Optional[int] = None,
+                 kill_save_site_ordinal: int = 1,
+                 kill_hard: bool = False):
         self.seed = seed
         self.nan_batch_steps = frozenset(nan_batch_steps)
         self.inf_batch_steps = frozenset(inf_batch_steps)
@@ -144,9 +188,15 @@ class FaultPlan:
         self.step_delay_s = step_delay_s
         self.fail_step_at = frozenset(fail_step_at)
         self.step_fault_scope = step_fault_scope
+        self.kill_process_at = dict(kill_process_at or {})
+        self.kill_save_site = kill_save_site
+        self.save_fault_process = save_fault_process
+        self.kill_save_site_ordinal = kill_save_site_ordinal
+        self.kill_hard = kill_hard
         # observability: what actually fired (tests assert on these)
         self.injected: list = []
         self._save_calls = 0
+        self._save_site_hits = 0
         self._serving_step_calls = 0
 
     # ------------------------------------------------------------ scope
@@ -179,6 +229,23 @@ class FaultPlan:
         if self.kill_at_step == step:
             self.injected.append(("kill", step))
             raise SimulatedPreemption(f"injected kill at step {step}")
+        victim = self.kill_process_at.get(step)
+        if victim is not None and victim == _process_index():
+            self.injected.append(("kill_process", step, victim))
+            self._die(f"injected process kill: step {step} "
+                      f"process {victim}")
+
+    def _die(self, reason: str):
+        """A process-scoped death: hard (``os._exit``, the SIGKILL
+        stand-in — no cleanup, no flushed buffers) or soft (raised
+        :class:`SimulatedPreemption`)."""
+        if self.kill_hard:
+            import sys as _sys
+
+            print(f"[chaos] {reason} (os._exit)", file=_sys.stderr,
+                  flush=True)
+            os._exit(PROCESS_KILL_EXIT_CODE)
+        raise SimulatedPreemption(reason)
 
     def on_save(self, site: str):
         self._save_calls += 1
@@ -187,6 +254,13 @@ class FaultPlan:
             raise ChaosError(
                 f"injected crash during checkpoint save #{self._save_calls} "
                 f"({site})")
+        if self.kill_save_site is not None and self.kill_save_site in site:
+            if self.save_fault_process is None \
+                    or self.save_fault_process == _process_index():
+                self._save_site_hits += 1
+                if self._save_site_hits == self.kill_save_site_ordinal:
+                    self.injected.append(("kill_save", site))
+                    self._die(f"injected death mid-save at {site}")
 
     def after_save(self, path: str):
         kind = self.corrupt_after_save.get(self._save_calls)

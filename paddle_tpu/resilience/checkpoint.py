@@ -52,6 +52,7 @@ from ..observability import registry as _obsreg
 __all__ = [
     "CheckpointCorruption",
     "ResilientCheckpointer",
+    "ShardedHostLeaf",
     "collect_state",
     "apply_state",
     "host_snapshot",
@@ -59,6 +60,8 @@ __all__ = [
 
 _MANIFEST = "manifest.json"
 _FORMAT = 1
+_FORMAT_SHARDED = 2
+_META_FILE = "_meta.pkl"
 
 
 class CheckpointCorruption(RuntimeError):
@@ -70,12 +73,75 @@ class CheckpointCorruption(RuntimeError):
 # host-side state trees
 # ---------------------------------------------------------------------------
 
+class ShardedHostLeaf:
+    """Host snapshot of a multi-process sharded ``jax.Array``: only this
+    process's addressable shards plus the global metadata needed to
+    reassemble (on disk, from every process's shards) or re-install (in
+    memory, via ``make_array_from_single_device_arrays``).
+
+    Under a real multi-controller runtime ``jax.device_get`` on a
+    non-fully-addressable array RAISES — no process can see the remote
+    shards — so the old gather-to-one-host snapshot is impossible by
+    construction.  This leaf is what replaces it.
+    """
+
+    __slots__ = ("global_shape", "dtype", "shards", "sharding")
+
+    def __init__(self, global_shape, dtype, shards, sharding=None):
+        self.global_shape = tuple(global_shape)
+        self.dtype = str(dtype)
+        # [(index_bounds, np_data, replica_id, device)] where index_bounds
+        # is ((start, stop), ...) per dim resolved against global_shape
+        self.shards = shards
+        self.sharding = sharding
+
+    @classmethod
+    def from_jax(cls, arr) -> "ShardedHostLeaf":
+        shards = []
+        for s in arr.addressable_shards:
+            bounds = tuple(
+                (sl.start if sl.start is not None else 0,
+                 sl.stop if sl.stop is not None else dim)
+                for sl, dim in zip(s.index, arr.shape))
+            shards.append((bounds, np.asarray(s.data).copy(),
+                           int(s.replica_id), s.device))
+        return cls(arr.shape, arr.dtype, shards, arr.sharding)
+
+    def to_jax(self):
+        """Re-install onto the live devices this snapshot came from (the
+        in-memory rollback path — no cross-process data needed)."""
+        import jax
+
+        arrs = [jax.device_put(data, dev)
+                for (_b, data, _r, dev) in self.shards]
+        return jax.make_array_from_single_device_arrays(
+            self.global_shape, self.sharding, arrs)
+
+    def owned_shards(self):
+        """Shards THIS process must write: one writer per distinct index
+        region globally (``replica_id == 0``)."""
+        return [(bounds, data) for (bounds, data, rid, _d) in self.shards
+                if rid == 0]
+
+    def __repr__(self):
+        return (f"ShardedHostLeaf(shape={self.global_shape}, "
+                f"dtype={self.dtype}, local_shards={len(self.shards)})")
+
+    def __reduce__(self):
+        raise TypeError(
+            "ShardedHostLeaf holds process-local device shards and is "
+            "not picklable — multi-process state must go through the "
+            "sharded checkpoint protocol (ResilientCheckpointer with "
+            "sharded=True / a multi-process context), not a single-file "
+            "pickle")
+
+
 def host_snapshot(tree: Any) -> Any:
     """Deep-copy a state tree to host numpy.  Live ``Tensor`` values sit
     on buffers the next compiled step may DONATE; snapshotting now is
     what makes async save and in-memory rollback sound."""
     if hasattr(tree, "numpy") and hasattr(tree, "_value"):   # Tensor
-        return np.array(tree.numpy(), copy=True)
+        return host_snapshot(tree._value)
     if isinstance(tree, dict):
         return {k: host_snapshot(v) for k, v in tree.items()}
     if isinstance(tree, (list, tuple)):
@@ -86,8 +152,14 @@ def host_snapshot(tree: Any) -> Any:
     if hasattr(tree, "shape") and hasattr(tree, "dtype"):    # jax array
         if getattr(tree, "sharding", None) is not None and \
                 not getattr(tree, "is_fully_replicated", True):
-            # mesh-sharded (distributed.MeshExecutor): gather the device
-            # shards into one host array so the checkpoint is
+            if not getattr(tree, "is_fully_addressable", True):
+                # multi-process sharded: remote shards are unreachable
+                # (device_get raises); snapshot the local shards — the
+                # sharded save path writes them, every peer writes its
+                # own, and restore reassembles the global array
+                return ShardedHostLeaf.from_jax(tree)
+            # mesh-sharded within one process: gather the device shards
+            # into one host array so the checkpoint is
             # layout-independent — restore re-shards onto whatever mesh
             # is active then
             import jax
@@ -117,13 +189,27 @@ def apply_state(state: Dict[str, Any], network=None, optimizer=None):
     arrays are re-sharded back onto the mesh — the gathered save plus
     this re-shard is what keeps kill/resume bit-identical under SPMD."""
     if network is not None and "model" in state:
-        network.set_state_dict(state["model"])
+        network.set_state_dict(_materialize(state["model"]))
     if optimizer is not None and "optimizer" in state:
-        optimizer.set_state_dict(state["optimizer"])
+        optimizer.set_state_dict(_materialize(state["optimizer"]))
     executor = getattr(network, "_mesh_executor", None) \
         if network is not None else None
     if executor is not None:
         executor.reshard(network, optimizer)
+
+
+def _materialize(tree: Any) -> Any:
+    """Turn :class:`ShardedHostLeaf` snapshots back into live jax arrays
+    (in-memory rollback under a multi-process mesh); other leaves pass
+    through untouched."""
+    if isinstance(tree, ShardedHostLeaf):
+        return tree.to_jax()
+    if isinstance(tree, dict):
+        return {k: _materialize(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        t = [_materialize(v) for v in tree]
+        return t if isinstance(tree, list) else tuple(t)
+    return tree
 
 
 # ---------------------------------------------------------------------------
@@ -138,23 +224,157 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
+def _flatten_state(tree: Dict[str, Any], prefix: str = ""
+                   ) -> Dict[str, Any]:
+    """Flatten nested dicts to ``a/b/c`` paths; non-dict containers are
+    leaves (they ride in the coordinator's meta pickle)."""
+    flat: Dict[str, Any] = {}
+    for k, v in tree.items():
+        path = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten_state(v, path))
+        else:
+            flat[path] = v
+    return flat
+
+
+def _unflatten_state(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        parts = path.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _safe_key(path: str) -> str:
+    return path.replace("/", "__")
+
+
+def _shard_fname(path: str, bounds) -> str:
+    idx = "_".join(f"{a}-{b}" for a, b in bounds) if bounds else "full"
+    return f"{_safe_key(path)}.shard_{idx}.pkl"
+
+
+def _is_shardable_array(v: Any) -> bool:
+    return isinstance(v, ShardedHostLeaf) or (
+        isinstance(v, np.ndarray) and v.ndim >= 1 and v.size > 0)
+
+
+def _owned_shards(path: str, leaf: Any, ctx) -> List[Tuple[tuple,
+                                                           np.ndarray]]:
+    """The (index_bounds, data) shards THIS process writes for a leaf.
+
+    :class:`ShardedHostLeaf`: the local device shards with
+    ``replica_id == 0`` — exactly one writer per index region globally.
+    Replicated host arrays (identical on every process by construction):
+    deterministically partitioned on axis 0 across the cluster so the
+    write bandwidth scales with hosts; arrays shorter than the cluster
+    get a single writer picked by a stable hash of the param path.
+    """
+    if isinstance(leaf, ShardedHostLeaf):
+        return [(b, d) for b, d in leaf.owned_shards()]
+    arr = leaf
+    full = tuple((0, d) for d in arr.shape)
+    if ctx.count == 1:
+        return [(full, arr)]
+    if arr.shape[0] >= ctx.count:
+        splits = np.array_split(np.arange(arr.shape[0]), ctx.count)
+        rows = splits[ctx.index]
+        lo, hi = int(rows[0]), int(rows[-1]) + 1
+        bounds = ((lo, hi),) + tuple((0, d) for d in arr.shape[1:])
+        return [(bounds, arr[lo:hi])]
+    owner = int.from_bytes(
+        hashlib.sha256(path.encode()).digest()[:4], "big") % ctx.count
+    return [(full, arr)] if ctx.index == owner else []
+
+
+def _mesh_metadata(process_count: int) -> Dict[str, Any]:
+    """What the manifest records about the SAVING topology: axis sizes
+    and ``SpecLayout`` of the live executor (when one is installed) plus
+    the process count — restore-with-reshard provenance."""
+    meta: Dict[str, Any] = {"process_count": int(process_count)}
+    try:
+        from ..distributed import executor as _exec
+
+        ex = _exec.current_executor()
+        if ex is not None:
+            meta["axis_sizes"] = {str(k): int(v)
+                                  for k, v in ex.mesh.shape.items()}
+            layout = getattr(ex, "layout", None)
+            if layout is not None:
+                import dataclasses as _dc
+
+                meta["layout"] = {k: v for k, v in
+                                  _dc.asdict(layout).items()
+                                  if isinstance(v, (str, int, float,
+                                                    bool, type(None)))}
+    except Exception:
+        pass
+    return meta
+
+
+def _write_fsync(path: str, payload: bytes,
+                 site: Optional[str] = None) -> str:
+    """Write-to-unique-tmp + fsync + rename WITHIN the target dir (the
+    torn-write guard for every sharded-protocol file); returns sha256.
+
+    The chaos ``site`` fires between fsync and rename — a kill there
+    leaves a fsynced ``.wip`` orphan and NO published file, the exact
+    mid-write window the crash matrix targets."""
+    tmp = f"{path}.wip-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    if site is not None:
+        chaos.on_save(site)
+    os.rename(tmp, path)
+    return hashlib.sha256(payload).hexdigest()
+
+
 class ResilientCheckpointer:
     """Atomic, integrity-checked, preemption-aware checkpoint store.
 
-    Layout: ``directory/step_00000012/{<key>.pkl..., manifest.json}``
-    — one pickle per top-level state key, digests in the manifest, the
-    whole directory committed by a single rename.
+    Single-process layout (format 1):
+    ``directory/step_00000012/{<key>.pkl..., manifest.json}`` — one
+    pickle per top-level state key, digests in the manifest, the whole
+    directory committed by a single rename.
+
+    Sharded elastic layout (format 2, automatic when the process context
+    spans >1 process, forceable with ``sharded=True``): every process
+    writes ONLY the shards it owns into a shared staging directory
+    (per-leaf shard pickles keyed by flattened param path + shard index
+    bounds, sha256 per file, computed by the writing process); after a
+    barrier confirms every host's shard set is fsynced, process 0 ALONE
+    merges the per-process file lists into ``manifest.json`` — which
+    also records the saving mesh's axis sizes, ``SpecLayout`` and
+    process count — and commits with the same single-rename protocol.
+    A process killed at ANY point leaves either a complete committed
+    step or an ignorable partial.  ``restore_latest`` reassembles the
+    global arrays from every process's shards regardless of the
+    restoring cluster's shape — restore-with-reshard is just this
+    assembly plus ``apply_state``'s re-``device_put`` onto whatever
+    mesh is live (elastic restart: save on N hosts, resume on N-1).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 max_pending: int = 2):
+                 max_pending: int = 2, sharded: Optional[bool] = None,
+                 reap_age_s: float = 3600.0, process_context=None):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self.max_pending = max_pending
+        self.sharded = sharded
+        self.reap_age_s = reap_age_s
+        self._process_context = process_context
         os.makedirs(self.directory, exist_ok=True)
         # counters (tests and stats() read these)
         self.saves = 0
         self.corrupt_skipped = 0
+        self.shard_files_written = 0
+        self.reshard_restores = 0
         # async machinery, started lazily
         self._queue: Optional[queue.Queue] = None
         self._worker: Optional[threading.Thread] = None
@@ -163,6 +383,25 @@ class ResilientCheckpointer:
         self._preempted = False
         self._prev_handlers: Dict[int, Any] = {}
         self._reap_stale_tmp()
+
+    def _ctx(self):
+        """The live process context (index/count/barrier) — resolved per
+        call so ``emulated_process_context`` tests can flip identities
+        between save calls on one checkpointer."""
+        if self._process_context is not None:
+            return self._process_context
+        try:
+            from ..distributed import bootstrap
+
+            return bootstrap.cluster_context()
+        except Exception:
+            class _Solo:
+                index, count, is_coordinator = 0, 1, True
+
+                def barrier(self, name, timeout_s=0):
+                    pass
+
+            return _Solo()
 
     # ------------------------------------------------------------ paths
     def _step_dir(self, step: int) -> str:
@@ -180,25 +419,77 @@ class ResilientCheckpointer:
         return sorted(out)
 
     def _reap_stale_tmp(self):
+        """Reclaim dead staging dirs without racing live peers.
+
+        Concurrent processes share the checkpoint directory, so "reap
+        every ``.tmp-*``" would let process 0 delete process 1's
+        in-flight staging mid-write.  Tmp dirs are therefore named with
+        the owner's process index + pid, and a process reaps only (a)
+        its OWN index-prefix (a previous incarnation of this rank died;
+        its replacement holds the slot) or (b) anything older than
+        ``reap_age_s`` (orphaned by a rank that never came back).
+        Shared sharded staging (``.staging-*``) is cleaned by the
+        coordinator alone — at the start of the next save for the same
+        step, or here once age-expired."""
+        ctx = self._ctx()
+        now = self._fs_now()
+        own_prefix = f".tmp-p{ctx.index}-"
         for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
             if name.startswith(".tmp-"):
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
+                legacy = not name.startswith(".tmp-p")  # pre-sharded
+                # naming (no owner encoded): cannot belong to a live
+                # peer of this version, safe to reclaim eagerly
+                if legacy or name.startswith(own_prefix) or \
+                        self._age_expired(path, now):
+                    shutil.rmtree(path, ignore_errors=True)
+            elif name.startswith(".staging-"):
+                if ctx.index == 0 and self._age_expired(path, now):
+                    shutil.rmtree(path, ignore_errors=True)
+
+    def _fs_now(self) -> float:
+        """Filesystem "now": the mtime of a freshly-touched probe in
+        the checkpoint dir.  Ages are differences between FILESYSTEM
+        timestamps, so on shared storage (NFS) whose server clock
+        drifts from this host's the comparison stays coherent where
+        the local wall clock would mis-age a peer's staging."""
+        probe = os.path.join(self.directory, ".reap-probe")
+        try:
+            with open(probe, "w"):
+                pass
+            return os.path.getmtime(probe)
+        except OSError:
+            return float("-inf")   # can't tell the time: reap nothing
+
+    def _age_expired(self, path: str, now: float) -> bool:
+        try:
+            return now - os.path.getmtime(path) > self.reap_age_s
+        except OSError:
+            return False
 
     # ------------------------------------------------------------- save
     def save(self, step: int, state: Dict[str, Any]) -> str:
         """Synchronous atomic save; returns the committed directory.
 
-        Stage everything under ``.tmp-*``, fsync the payloads, write the
-        manifest LAST, then commit with one rename — at no point does a
-        partially-written checkpoint exist under a ``step_*`` name."""
+        Stage everything under a process-owned tmp dir, fsync the
+        payloads, write the manifest LAST, then commit with one rename —
+        at no point does a partially-written checkpoint exist under a
+        ``step_*`` name.  When the process context spans more than one
+        process (or ``sharded=True``), the sharded elastic protocol is
+        used instead (see the class docstring)."""
         if not isinstance(state, dict) or not state:
             raise ValueError("state must be a non-empty dict of "
                              "{name: subtree}")
+        ctx = self._ctx()
+        use_sharded = (self.sharded if self.sharded is not None
+                       else ctx.count > 1)
+        if use_sharded:
+            return self._save_sharded(step, state, ctx)
         t0 = time.perf_counter()
         self._reap_stale_tmp()
-        tmp = os.path.join(self.directory,
-                           f".tmp-{step}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        tmp = os.path.join(
+            self.directory,
+            f".tmp-p{ctx.index}-{os.getpid()}-{step}-{uuid.uuid4().hex[:8]}")
         os.makedirs(tmp)
         try:
             files = {}
@@ -236,6 +527,127 @@ class ResilientCheckpointer:
         chaos.after_save(final)
         self._gc()
         return final
+
+    # ---------------------------------------------------- sharded save
+    def _staging_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f".staging-step_{step:08d}")
+
+    def _save_sharded(self, step: int, state: Dict[str, Any], ctx) -> str:
+        """The elastic protocol: shards from every process, manifest and
+        commit from process 0 alone, barriers at the two hand-offs.
+
+        Every published file (shard pickles, per-process file lists, the
+        manifest) goes through tmp+fsync+rename, so a death at any
+        instant leaves either nothing or a complete file; the step
+        itself becomes visible only at the coordinator's final rename.
+        A partially-staged ``.staging-*`` dir is invisible to restore
+        and overwritten file-by-file on the next attempt for the step.
+        """
+        t0 = time.perf_counter()
+        self._reap_stale_tmp()
+        staging = self._staging_dir(step)
+        os.makedirs(staging, exist_ok=True)
+        snap = host_snapshot(state)
+        flat = _flatten_state(snap)
+        arrays = {p: v for p, v in flat.items() if _is_shardable_array(v)}
+        meta = {p: v for p, v in flat.items() if p not in arrays}
+
+        files: Dict[str, str] = {}
+        leaves: Dict[str, Dict[str, Any]] = {}
+        for path in sorted(arrays):
+            leaf = arrays[path]
+            entry = leaves.setdefault(path, {
+                "global_shape": list(leaf.global_shape
+                                     if isinstance(leaf, ShardedHostLeaf)
+                                     else leaf.shape),
+                "dtype": str(leaf.dtype),
+                "shards": [],
+            })
+            for i, (bounds, data) in enumerate(_owned_shards(path, leaf,
+                                                             ctx)):
+                fname = _shard_fname(path, bounds)
+                files[fname] = _write_fsync(
+                    os.path.join(staging, fname),
+                    pickle.dumps(np.asarray(data), protocol=4),
+                    site=f"resilience::shard:{path}:{i}")
+                entry["shards"].append({"file": fname,
+                                        "index": [list(b) for b in bounds],
+                                        "process": ctx.index})
+                self.shard_files_written += 1
+        if ctx.index == 0 and meta:
+            files[_META_FILE] = _write_fsync(
+                os.path.join(staging, _META_FILE),
+                pickle.dumps(meta, protocol=4),
+                site="resilience::write:_meta")
+        proc_list = f"process_{ctx.index:04d}.files.json"
+        _write_fsync(
+            os.path.join(staging, proc_list),
+            json.dumps({"files": files, "leaves": leaves},
+                       indent=1).encode())
+        chaos.on_save("resilience::shards_done")
+        ctx.barrier(f"ckpt-{step}-{self.saves}-shards")
+
+        final = self._step_dir(step)
+        if ctx.index == 0:
+            self._commit_sharded(step, staging, final, ctx)
+        ctx.barrier(f"ckpt-{step}-{self.saves}-committed")
+        self.saves += 1
+        if _obsreg.enabled():
+            reg = _obsreg.get_registry()
+            reg.counter("checkpoint_saves_total",
+                        "checkpoints committed (atomic renames)").inc()
+            reg.counter("checkpoint_shard_files_total",
+                        "sharded checkpoint files written by this process"
+                        ).inc(len(files))
+            reg.histogram("checkpoint_save_seconds",
+                          "stage+fsync+commit wall time per checkpoint"
+                          ).observe(time.perf_counter() - t0)
+        if ctx.index == 0:
+            chaos.after_save(final)
+            self._gc()
+        return final
+
+    def _commit_sharded(self, step: int, staging: str, final: str, ctx):
+        """Process 0 only: merge every host's file list (all confirmed
+        fsynced by the barrier) into one manifest, then rename."""
+        merged_files: Dict[str, str] = {}
+        merged_leaves: Dict[str, Dict[str, Any]] = {}
+        for idx in range(ctx.count):
+            ppath = os.path.join(staging, f"process_{idx:04d}.files.json")
+            try:
+                with open(ppath) as f:
+                    plist = json.load(f)
+            except (OSError, ValueError) as e:
+                raise RuntimeError(
+                    f"sharded save {step}: missing/unreadable shard list "
+                    f"for process {idx} after barrier ({e})")
+            merged_files.update(plist["files"])
+            for path, entry in plist["leaves"].items():
+                tgt = merged_leaves.setdefault(
+                    path, {"global_shape": entry["global_shape"],
+                           "dtype": entry["dtype"], "shards": []})
+                if tgt["global_shape"] != entry["global_shape"]:
+                    raise RuntimeError(
+                        f"sharded save {step}: processes disagree on "
+                        f"{path} global shape ({tgt['global_shape']} vs "
+                        f"{entry['global_shape']})")
+                tgt["shards"].extend(entry["shards"])
+        manifest = {
+            "format": _FORMAT_SHARDED,
+            "step": step,
+            "sharded": True,
+            "mesh": _mesh_metadata(ctx.count),
+            "files": merged_files,
+            "leaves": merged_leaves,
+            "meta_file": _META_FILE if _META_FILE in merged_files else None,
+        }
+        chaos.on_save("resilience::manifest")
+        _write_fsync(os.path.join(staging, _MANIFEST),
+                     json.dumps(manifest, indent=1).encode(),
+                     site="resilience::commit")
+        if os.path.exists(final):      # re-save of the same step
+            shutil.rmtree(final)
+        os.rename(staging, final)      # THE commit point (atomic)
 
     def save_async(self, step: int, state: Dict[str, Any]):
         """Snapshot ``state`` to host now, write it from the worker
@@ -301,25 +713,85 @@ class ResilientCheckpointer:
                 manifest = json.load(f)
         except (OSError, ValueError) as e:
             raise CheckpointCorruption(f"{d}: unreadable manifest ({e})")
-        if manifest.get("format") != _FORMAT:
+        fmt = manifest.get("format")
+        if fmt == _FORMAT_SHARDED:
+            return self._load_sharded(d, manifest)
+        if fmt != _FORMAT:
             raise CheckpointCorruption(
                 f"{d}: unknown manifest format {manifest.get('format')!r}")
         state = {}
         for fname, digest in manifest.get("files", {}).items():
             fpath = os.path.join(d, fname)
-            if not os.path.exists(fpath):
-                raise CheckpointCorruption(f"{d}: missing file {fname}")
-            actual = _sha256(fpath)
-            if actual != digest:
-                raise CheckpointCorruption(
-                    f"{d}: sha256 mismatch for {fname} "
-                    f"(manifest {digest[:12]}…, file {actual[:12]}…)")
+            self._verify_file(d, fname, digest)
             try:
                 with open(fpath, "rb") as f:
                     state[fname[:-4]] = pickle.load(f)
             except Exception as e:  # noqa: BLE001 — any unpickle failure
                 raise CheckpointCorruption(f"{d}: unreadable {fname} ({e})")
         return state
+
+    def _verify_file(self, d: str, fname: str, digest: str):
+        fpath = os.path.join(d, fname)
+        if not os.path.exists(fpath):
+            raise CheckpointCorruption(f"{d}: missing file {fname}")
+        actual = _sha256(fpath)
+        if actual != digest:
+            raise CheckpointCorruption(
+                f"{d}: sha256 mismatch for {fname} "
+                f"(manifest {digest[:12]}…, file {actual[:12]}…)")
+
+    def _load_sharded(self, d: str, manifest: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+        """Verify every shard file, then reassemble the GLOBAL arrays —
+        independent of how many processes are restoring (the
+        restore-with-reshard half: ``apply_state`` + the live executor
+        re-``device_put`` the result onto whatever mesh exists now)."""
+        for fname, digest in manifest.get("files", {}).items():
+            self._verify_file(d, fname, digest)
+        flat: Dict[str, Any] = {}
+        meta_file = manifest.get("meta_file")
+        if meta_file:
+            try:
+                with open(os.path.join(d, meta_file), "rb") as f:
+                    flat.update(pickle.load(f))
+            except Exception as e:  # noqa: BLE001
+                raise CheckpointCorruption(
+                    f"{d}: unreadable {meta_file} ({e})")
+        for path, entry in manifest.get("leaves", {}).items():
+            shape = tuple(entry["global_shape"])
+            parts = []
+            for sh in entry["shards"]:
+                try:
+                    with open(os.path.join(d, sh["file"]), "rb") as f:
+                        parts.append((sh["index"], pickle.load(f)))
+                except Exception as e:  # noqa: BLE001
+                    raise CheckpointCorruption(
+                        f"{d}: unreadable shard {sh['file']} ({e})")
+            if not parts:
+                raise CheckpointCorruption(f"{d}: no shards for {path}")
+            arr = np.empty(shape, dtype=parts[0][1].dtype)
+            covered = 0
+            for bounds, data in parts:
+                sl = tuple(slice(a, b) for a, b in bounds)
+                arr[sl] = data
+                covered += int(np.prod([b - a for a, b in bounds],
+                                       dtype=np.int64)) if bounds else 1
+            want = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if covered != want:
+                raise CheckpointCorruption(
+                    f"{d}: shards for {path} cover {covered} of {want} "
+                    f"elements (incomplete shard set committed?)")
+            flat[path] = arr
+        saved_procs = manifest.get("mesh", {}).get("process_count")
+        ctx = self._ctx()
+        if saved_procs is not None and saved_procs != ctx.count:
+            self.reshard_restores += 1
+            if _obsreg.enabled():
+                _obsreg.get_registry().counter(
+                    "checkpoint_reshard_restores_total",
+                    "restores onto a different process topology than "
+                    "the save").inc()
+        return _unflatten_state(flat)
 
     def restore(self, step: int) -> Dict[str, Any]:
         """Load and VERIFY one checkpoint; raises
@@ -371,6 +843,8 @@ class ResilientCheckpointer:
             "steps": self.steps(),
             "saves": self.saves,
             "corrupt_skipped": self.corrupt_skipped,
+            "shard_files_written": self.shard_files_written,
+            "reshard_restores": self.reshard_restores,
             "pending_async": self._queue.qsize() if self._queue else 0,
             "preemption_requested": self._preempted,
         }

@@ -1,0 +1,536 @@
+"""Elastic sharded checkpointing + multi-process bootstrap + process
+chaos + H113 — the tier-1 coverage for the multi-process mesh runtime.
+
+Everything here is IN-PROCESS: ``emulated_process_context`` plays each
+side of an N-process protocol sequentially (non-coordinators first,
+coordinator last — the ordering the real barrier enforces), so the
+sharded save/commit/restore state machine and the crash matrix run in
+milliseconds with no subprocesses.  The real spawned-cluster runs
+(gloo rendezvous, jax.distributed, kill-mid-save with os._exit) live in
+tests/test_multiprocess_dist.py (slow) and examples/elastic_train.py
+(tools/ci.sh elastic stage).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import bootstrap
+from paddle_tpu.distributed.bootstrap import emulated_process_context
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.checkpoint import (CheckpointCorruption,
+                                              ResilientCheckpointer)
+from paddle_tpu.resilience.chaos import FaultPlan, SimulatedPreemption
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# bootstrap: env autodiscovery, idempotent re-entry, emulated contexts
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def clean_cluster(monkeypatch):
+    """Isolate the module-global cluster record and the discovery env."""
+    for var in (bootstrap._ENV_COORD + bootstrap._ENV_NPROC
+                + bootstrap._ENV_PID):
+        monkeypatch.delenv(var, raising=False)
+    prev = bootstrap._CLUSTER
+    bootstrap._CLUSTER = None
+    yield
+    bootstrap._CLUSTER = prev
+
+
+class TestBootstrap:
+    def test_single_process_noop(self, clean_cluster):
+        info = bootstrap.initialize_cluster()
+        assert info.num_processes == 1
+        assert info.process_id == 0
+        assert info.coordinator is None
+        assert not info.multiprocess
+        assert info.local_device_count >= 1
+
+    def test_reentry_idempotent_and_conflicting(self, clean_cluster):
+        info = bootstrap.initialize_cluster()
+        again = bootstrap.initialize_cluster()
+        assert again is info
+        with pytest.raises(RuntimeError, match="conflicting topology"):
+            bootstrap.initialize_cluster(coordinator="127.0.0.1:1",
+                                         num_processes=4, process_id=2)
+
+    def test_env_autodiscovery_precedence(self, clean_cluster, monkeypatch):
+        # the PADDLE_TPU_* triple wins over the reference's
+        # PADDLE_TRAINER_* fallbacks
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+        monkeypatch.setenv("PADDLE_TPU_NUM_PROCESSES", "2")
+        assert bootstrap._env_first(bootstrap._ENV_NPROC) == "2"
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "7")
+        assert bootstrap._env_first(bootstrap._ENV_PID) == "7"
+        monkeypatch.setenv("PADDLE_TPU_PROCESS_ID", "1")
+        assert bootstrap._env_first(bootstrap._ENV_PID) == "1"
+
+    def test_multiprocess_requires_full_triple(self, clean_cluster):
+        with pytest.raises(ValueError, match="PADDLE_TPU_COORDINATOR"):
+            bootstrap.initialize_cluster(num_processes=2)
+
+    def test_trainers_num_env_drives_multiprocess(self, clean_cluster,
+                                                  monkeypatch):
+        # num_processes resolved from env but no coordinator -> the
+        # multi-process path must demand the full triple, not silently
+        # fall back to single-process
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        with pytest.raises(ValueError):
+            bootstrap.initialize_cluster()
+
+    def test_emulated_context_identity(self):
+        assert bootstrap.process_count() >= 1
+        with emulated_process_context(1, 3) as ctx:
+            assert bootstrap.process_index() == 1
+            assert bootstrap.process_count() == 3
+            assert not bootstrap.is_coordinator()
+            assert not ctx.is_coordinator
+            ctx.barrier("noop")          # no-op, must not hang
+            with emulated_process_context(0, 2):
+                assert bootstrap.process_index() == 0   # innermost wins
+                assert bootstrap.is_coordinator()
+            assert bootstrap.process_count() == 3
+        assert bootstrap.process_index() == 0
+
+    def test_emulated_context_validates(self):
+        with pytest.raises(ValueError):
+            emulated_process_context(2, 2)
+        with pytest.raises(ValueError):
+            emulated_process_context(-1, 1)
+
+    def test_spawn_local_validates(self):
+        with pytest.raises(ValueError):
+            bootstrap.spawn_local(0, ["true"])
+
+    def test_context_barrier_single_process_is_noop(self):
+        bootstrap.barrier("tier1-noop")  # count==1: returns immediately
+
+
+# ---------------------------------------------------------------------------
+# process-scoped chaos
+# ---------------------------------------------------------------------------
+
+class TestProcessChaos:
+    def test_kill_process_at_scopes_to_victim(self):
+        plan = FaultPlan(kill_process_at={3: 1})
+        with plan:
+            with emulated_process_context(0, 2):
+                chaos.on_step(3)         # not the victim: survives
+            with emulated_process_context(1, 2):
+                chaos.on_step(2)         # victim, wrong step: survives
+                with pytest.raises(SimulatedPreemption):
+                    chaos.on_step(3)
+        assert ("kill_process", 3, 1) in plan.injected
+
+    def test_kill_save_site_scope_and_ordinal(self):
+        plan = FaultPlan(kill_save_site="resilience::shard:",
+                         save_fault_process=1, kill_save_site_ordinal=2)
+        with plan:
+            with emulated_process_context(0, 2):
+                chaos.on_save("resilience::shard:model/w:0")  # wrong proc
+            with emulated_process_context(1, 2):
+                chaos.on_save("resilience::shard:model/w:0")  # ordinal 1
+                with pytest.raises(SimulatedPreemption):
+                    chaos.on_save("resilience::shard:model/b:0")
+        assert ("kill_save", "resilience::shard:model/b:0") in plan.injected
+
+    def test_exit_code_constant_exported(self):
+        from paddle_tpu.resilience.chaos import PROCESS_KILL_EXIT_CODE
+
+        assert PROCESS_KILL_EXIT_CODE == 43
+
+
+# ---------------------------------------------------------------------------
+# sharded elastic checkpointing (emulated protocol)
+# ---------------------------------------------------------------------------
+
+def _state(scale=1.0):
+    return {
+        "model": {
+            "w": (np.arange(24, dtype=np.float32) * scale).reshape(6, 4),
+            "b": np.array([1.0, 2.0, 3.0], dtype=np.float32) * scale,
+        },
+        "meta": {"global_step": int(10 * scale)},
+    }
+
+
+def _mp_save(directory, step, state, count, plan_for=None, **kw):
+    """Drive one N-process sharded save sequentially (coordinator LAST,
+    the order the shards barrier enforces).  ``plan_for[idx]`` is an
+    active-plan factory for that process's save call; returns
+    {idx: exception or None}."""
+    outcomes = {}
+    for idx in list(range(1, count)) + [0]:
+        with emulated_process_context(idx, count):
+            ck = ResilientCheckpointer(directory, **kw)
+            try:
+                if plan_for and idx in plan_for:
+                    with plan_for[idx]:
+                        ck.save(step, state)
+                else:
+                    ck.save(step, state)
+                outcomes[idx] = None
+            except BaseException as e:  # noqa: BLE001 — chaos surfaces here
+                outcomes[idx] = e
+    return outcomes
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(a["model"]["w"], b["model"]["w"])
+    np.testing.assert_array_equal(a["model"]["b"], b["model"]["b"])
+    assert a["meta"]["global_step"] == b["meta"]["global_step"]
+
+
+class TestShardedProtocol:
+    def test_layout_and_manifest(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        outcomes = _mp_save(d, 5, _state(), count=2)
+        assert all(e is None for e in outcomes.values())
+        step_dir = os.path.join(d, "step_00000005")
+        names = sorted(os.listdir(step_dir))
+        assert "manifest.json" in names
+        assert "_meta.pkl" in names
+        assert "process_0000.files.json" in names
+        assert "process_0001.files.json" in names
+        assert any(".shard_" in n for n in names)
+        import json
+
+        with open(os.path.join(step_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["format"] == 2
+        assert manifest["sharded"] is True
+        assert manifest["mesh"]["process_count"] == 2
+        # every payload file is digested (the per-process file lists are
+        # protocol scaffolding, not restore inputs)
+        assert set(manifest["files"]) == {
+            n for n in names
+            if n != "manifest.json" and not n.startswith("process_")}
+        # no torn .wip orphans after a clean commit
+        assert not [n for n in names if ".wip-" in n]
+
+    @pytest.mark.parametrize("restore_count", [1, 2, 3])
+    def test_restore_reshards_bit_identical(self, tmp_path, restore_count):
+        d = str(tmp_path / "ckpt")
+        _mp_save(d, 7, _state(), count=2)
+        with emulated_process_context(0, restore_count):
+            ck = ResilientCheckpointer(d)
+            step, got = ck.restore_latest()
+        assert step == 7
+        _assert_state_equal(got, _state())
+        assert ck.corrupt_skipped == 0
+        assert ck.reshard_restores == (1 if restore_count != 2 else 0)
+
+    def test_each_process_writes_only_owned_shards(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _mp_save(d, 1, _state(), count=2)
+        import json
+
+        step_dir = os.path.join(d, "step_00000001")
+        writers = {}
+        for idx in (0, 1):
+            with open(os.path.join(step_dir,
+                                   f"process_{idx:04d}.files.json")) as f:
+                plist = json.load(f)
+            for path, entry in plist["leaves"].items():
+                for sh in entry["shards"]:
+                    assert sh["process"] == idx
+                    assert sh["file"] not in writers, \
+                        f"{sh['file']} written by {writers[sh['file']]} " \
+                        f"AND {idx}"
+                    writers[sh["file"]] = idx
+        # w (6 rows) splits across both hosts; both actually wrote
+        assert set(writers.values()) == {0, 1}
+
+    def test_single_process_forced_sharded(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        ck = ResilientCheckpointer(d, sharded=True)
+        ck.save(3, _state())
+        step, got = ck.restore_latest()
+        assert step == 3
+        _assert_state_equal(got, _state())
+        assert ck.shard_files_written > 0
+
+    def test_resave_same_step_overwrites(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _mp_save(d, 2, _state(1.0), count=2)
+        _mp_save(d, 2, _state(2.0), count=2)
+        with emulated_process_context(0, 2):
+            step, got = ResilientCheckpointer(d).restore_latest()
+        assert step == 2
+        _assert_state_equal(got, _state(2.0))
+
+
+# ---------------------------------------------------------------------------
+# crash matrix: kill points x restore mesh shapes
+# ---------------------------------------------------------------------------
+
+# (site substring, victim process) — manifest/commit only ever run on
+# the coordinator, shard writes die on either side
+_KILL_POINTS = [
+    ("resilience::shard:", 0),
+    ("resilience::shard:", 1),
+    ("resilience::shards_done", 1),
+    ("resilience::manifest", 0),
+    ("resilience::commit", 0),
+]
+
+
+class TestCrashMatrix:
+    @pytest.mark.parametrize("site,victim", _KILL_POINTS)
+    @pytest.mark.parametrize("restore_count", [1, 2])
+    def test_death_at_any_point_restores_last_commit(self, tmp_path, site,
+                                                     victim, restore_count):
+        d = str(tmp_path / "ckpt")
+        # step 1 commits cleanly; the step-2 save dies at `site`
+        _mp_save(d, 1, _state(1.0), count=2)
+        outcomes = _mp_save(
+            d, 2, _state(2.0), count=2,
+            plan_for={victim: FaultPlan(kill_save_site=site,
+                                        save_fault_process=victim)})
+        assert isinstance(outcomes[victim], SimulatedPreemption)
+        # THE invariant: death at any point leaves either a COMPLETE
+        # committed step or an ignorable partial — never a half-commit.
+        # (At `shards_done` the victim has fully staged and listed its
+        # shards, so the coordinator may legitimately still commit a
+        # complete step 2; everywhere earlier the commit must not land.)
+        committed2 = os.path.exists(os.path.join(d, "step_00000002"))
+        if site != "resilience::shards_done":
+            assert not committed2, \
+                f"step 2 committed despite death at {site} on p{victim}"
+        with emulated_process_context(0, restore_count):
+            ck = ResilientCheckpointer(d)
+            step, got = ck.restore_latest()
+        if committed2:
+            assert step == 2
+            _assert_state_equal(got, _state(2.0))
+        else:
+            assert step == 1
+            _assert_state_equal(got, _state(1.0))
+        # the partial is INVISIBLE, not merely tolerated: nothing was
+        # skipped as corrupt
+        assert ck.corrupt_skipped == 0
+
+    def test_partial_then_retry_commits(self, tmp_path):
+        """The next save attempt for the same step overwrites the torn
+        staging file-by-file and commits — no manual cleanup needed."""
+        d = str(tmp_path / "ckpt")
+        outcomes = _mp_save(
+            d, 4, _state(3.0), count=2,
+            plan_for={1: FaultPlan(kill_save_site="resilience::shard:",
+                                   save_fault_process=1)})
+        assert isinstance(outcomes[1], SimulatedPreemption)
+        staging = os.path.join(d, ".staging-step_00000004")
+        assert os.path.isdir(staging)      # torn partial left behind
+        outcomes = _mp_save(d, 4, _state(3.0), count=2)
+        assert all(e is None for e in outcomes.values())
+        assert not os.path.exists(staging)  # renamed into the commit
+        with emulated_process_context(0, 1):
+            ck = ResilientCheckpointer(d)
+            step, got = ck.restore_latest()
+        assert step == 4
+        _assert_state_equal(got, _state(3.0))
+        assert ck.corrupt_skipped == 0
+
+    def test_torn_committed_shard_is_skipped_exactly_once(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _mp_save(d, 1, _state(1.0), count=2)
+        _mp_save(d, 2, _state(2.0), count=2)
+        step2 = os.path.join(d, "step_00000002")
+        shard = next(n for n in sorted(os.listdir(step2))
+                     if ".shard_" in n)
+        chaos.truncate_file(os.path.join(step2, shard))
+        with emulated_process_context(0, 2):
+            ck = ResilientCheckpointer(d)
+            step, got = ck.restore_latest()
+        assert step == 1                   # fell back past the rot
+        _assert_state_equal(got, _state(1.0))
+        assert ck.corrupt_skipped == 1     # exact accounting
+
+    def test_missing_shard_set_is_corruption(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _mp_save(d, 1, _state(), count=2)
+        step1 = os.path.join(d, "step_00000001")
+        shard = next(n for n in sorted(os.listdir(step1))
+                     if ".shard_" in n)
+        os.remove(os.path.join(step1, shard))
+        with emulated_process_context(0, 1):
+            ck = ResilientCheckpointer(d)
+            with pytest.raises(CheckpointCorruption):
+                ck.restore(1)
+            assert ck.restore_latest() == (None, None)
+            assert ck.corrupt_skipped == 1
+
+
+# ---------------------------------------------------------------------------
+# stale-tmp reaping: own-prefix / age only — never a live peer's staging
+# ---------------------------------------------------------------------------
+
+class TestReapStaleTmp:
+    def _mk(self, tmp_path, name, age_s=0.0):
+        p = tmp_path / name
+        p.mkdir()
+        if age_s:
+            old = os.stat(p).st_mtime - age_s
+            os.utime(p, (old, old))
+        return p
+
+    def test_never_reaps_live_peer_tmp(self, tmp_path):
+        mine = self._mk(tmp_path, ".tmp-p0-111-5-abc")
+        peer = self._mk(tmp_path, ".tmp-p1-222-5-def")
+        legacy = self._mk(tmp_path, ".tmp-333-5")
+        with emulated_process_context(0, 2):
+            ResilientCheckpointer(str(tmp_path))
+        assert not mine.exists()       # own rank slot: reclaimed
+        assert peer.exists()           # live peer mid-write: untouched
+        assert not legacy.exists()     # pre-sharded naming: reclaimed
+
+    def test_age_expired_peer_tmp_is_reaped(self, tmp_path):
+        peer = self._mk(tmp_path, ".tmp-p1-222-5-def", age_s=999.0)
+        with emulated_process_context(0, 2):
+            ResilientCheckpointer(str(tmp_path), reap_age_s=10.0)
+        assert not peer.exists()
+
+    def test_staging_reaped_by_coordinator_only_when_aged(self, tmp_path):
+        fresh = self._mk(tmp_path, ".staging-step_00000009")
+        aged = self._mk(tmp_path, ".staging-step_00000003", age_s=999.0)
+        with emulated_process_context(1, 2):
+            ResilientCheckpointer(str(tmp_path), reap_age_s=10.0)
+        assert fresh.exists() and aged.exists()   # non-coordinator: never
+        with emulated_process_context(0, 2):
+            ResilientCheckpointer(str(tmp_path), reap_age_s=10.0)
+        assert fresh.exists()          # in-flight save: untouched
+        assert not aged.exists()       # orphan: reclaimed
+
+
+# ---------------------------------------------------------------------------
+# H113: multi-process checkpoint write-race scanner
+# ---------------------------------------------------------------------------
+
+class TestH113Scanner:
+    def _scan(self, tmp_path, src):
+        import textwrap
+
+        from paddle_tpu.analysis.hazards import scan_process_write_races
+
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent(src))
+        return scan_process_write_races(str(f))
+
+    def test_ungated_manifest_write_is_error(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            import os
+            def commit(ckpt_dir, data):
+                path = os.path.join(ckpt_dir, "manifest.json")
+                with open(path, "w") as f:
+                    f.write(data)
+        """)
+        assert [d.code for d in diags] == ["H113"]
+        assert "process gate" in diags[0].message
+
+    def test_ungated_rename_commit_is_error(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            import os
+            def commit(staging, final_checkpoint):
+                os.rename(staging, final_checkpoint)
+        """)
+        assert [d.code for d in diags] == ["H113"]
+
+    def test_coordinator_gate_is_clean(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            import os
+            def commit(ckpt_dir, data, ctx):
+                if ctx.is_coordinator:
+                    with open(ckpt_dir + "/manifest.json", "w") as f:
+                        f.write(data)
+        """)
+        assert diags == []
+
+    def test_guard_return_is_clean(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            import os
+            def commit(ckpt_dir, data, rank):
+                if rank != 0:
+                    return
+                with open(ckpt_dir + "/manifest.json", "w") as f:
+                    f.write(data)
+        """)
+        assert diags == []
+
+    def test_per_process_unique_path_is_clean(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            import os
+            def write_shard(ckpt_dir, data):
+                p = ckpt_dir + "/shard-" + str(os.getpid()) + ".bin"
+                with open(p, "wb") as f:
+                    f.write(data)
+        """)
+        assert diags == []
+
+    def test_non_checkpoint_path_is_clean(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            def log(log_dir, data):
+                with open(log_dir + "/metrics.json", "w") as f:
+                    f.write(data)
+        """)
+        assert diags == []
+
+    def test_line_suppression(self, tmp_path):
+        diags = self._scan(tmp_path, """\
+            def commit(ckpt_dir, data):
+                with open(ckpt_dir + "/manifest", "w") as f:  # lint-tpu: disable=H113
+                    f.write(data)
+        """)
+        assert diags == []
+
+    def test_repo_is_clean(self):
+        from paddle_tpu.analysis.hazards import scan_process_write_races
+
+        diags = scan_process_write_races(
+            [os.path.join(REPO, "paddle_tpu"),
+             os.path.join(REPO, "examples")])
+        assert diags == [], [str(d) for d in diags]
+
+    def test_exported_from_analysis(self):
+        import paddle_tpu.analysis as analysis
+
+        assert callable(analysis.scan_process_write_races)
+
+
+# ---------------------------------------------------------------------------
+# distributed/checkpoint.py pickle-fallback discipline
+# ---------------------------------------------------------------------------
+
+class TestSaveStateDictDiscipline:
+    def test_non_coordinator_does_not_write(self, tmp_path, monkeypatch):
+        import paddle_tpu.distributed.checkpoint as dckpt
+
+        # force the pickle fallback regardless of installed orbax
+        import builtins
+
+        real_import = builtins.__import__
+
+        def no_orbax(name, *a, **kw):
+            if name.startswith("orbax"):
+                raise ImportError(name)
+            return real_import(name, *a, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", no_orbax)
+        path = str(tmp_path / "sd.pdparams")
+        state = {"w": np.ones(3, dtype=np.float32)}
+        with emulated_process_context(1, 2):
+            dckpt.save_state_dict(state, path)
+        assert not os.path.exists(path)
+        with emulated_process_context(0, 2):
+            dckpt.save_state_dict(state, path)
+        assert os.path.exists(path)
+        got = dckpt.load_state_dict(path)
+        np.testing.assert_array_equal(np.asarray(got["w"].numpy()
+                                                 if hasattr(got["w"],
+                                                            "numpy")
+                                                 else got["w"]),
+                                      state["w"])

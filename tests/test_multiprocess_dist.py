@@ -85,6 +85,7 @@ def _single_process_reference(world):
     return losses, np.asarray(net[0].weight.numpy())
 
 
+@pytest.mark.slow
 class TestMultiProcessDistributed:
     def test_two_process_allreduce_and_dp_parity(self, tmp_path):
         world = 2
@@ -106,6 +107,7 @@ class TestMultiProcessDistributed:
                                    atol=1e-5)
 
 
+@pytest.mark.slow
 class TestCompiledSPMDMultiProcess:
     """VERDICT r2 #5: the real multi-host code path — two OS processes
     joined into ONE multi-controller runtime by init_parallel_env ->

@@ -66,10 +66,12 @@ python tools/lint_tpu.py --shardplan --hosts 2 --steps train --json \
   | python -c "import json,sys; r=json.load(sys.stdin)[0]; \
 assert r['hosts'] == 2 and 'dcn' in r['wire_bytes'], r"
 
-echo "== hazard scan (H112 single-process device-count assumptions) =="
-# jax.device_count()/len(jax.devices()) in per-process code paths and
-# hardcoded chip counts in mesh constructors break under multi-host
-# launch; ERROR findings fail CI (README: Hazards)
+echo "== hazard scan (H112 device-count + H113 process-write races) =="
+# H112: jax.device_count()/len(jax.devices()) in per-process code paths
+# and hardcoded chip counts in mesh constructors break under multi-host
+# launch.  H113: ungated checkpoint-path writes — under jax.distributed
+# EVERY host runs the line, so N processes race on the same file.
+# ERROR findings fail CI (README: Hazards)
 python tools/lint_tpu.py --hazards
 
 echo "== mesh execution (2x2x2 SPMD on forced host devices) =="
@@ -130,6 +132,17 @@ python examples/compat_journeys.py
 python examples/hybrid_parallel_llama.py
 python examples/resilient_train.py --steps 8 --kill-at 5
 python examples/observe_train.py --steps 20
+
+echo "== elastic multi-process (sharded ckpt + process-death chaos) =="
+# four REAL spawned jax clusters (bootstrap.spawn_local: gloo
+# collectives, genuine multi-controller runtime): uninterrupted
+# reference run; 2-process run whose process 1 is hard-killed mid-save
+# (partial step left uncommitted); 1-process restart from the same dir
+# reassembling both hosts' shards (restore-with-reshard) — post-resume
+# losses and final weights must be BIT-IDENTICAL to the reference; and
+# S209 plan-vs-runtime reconciliation across a real 2-process mesh with
+# Topology(hosts=2) (README: Elastic multi-host checkpointing)
+timeout -k 10 600 python examples/elastic_train.py
 
 echo "== serving fleet router (affinity placement + replica chaos) =="
 # two named replicas behind serving.Router: a shared-prefix burst must

@@ -210,7 +210,9 @@ def _hazards_main(argv):
         description="source-level hazard scan: H112 single-process "
         "device-count assumptions (jax.device_count() / len(jax."
         "devices()) in per-process code paths, hardcoded chip counts "
-        "in mesh constructors)")
+        "in mesh constructors) and H113 multi-process checkpoint "
+        "write races (ungated writes on checkpoint-hinted paths that "
+        "every jax.distributed process would execute)")
     parser.add_argument("paths", nargs="*",
                         default=["paddle_tpu", "examples"],
                         help="files or directories to scan "
@@ -219,9 +221,13 @@ def _hazards_main(argv):
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
     from paddle_tpu.analysis.hazards import (ERROR,
-                                             scan_device_count_assumptions)
+                                             scan_device_count_assumptions,
+                                             scan_process_write_races,
+                                             sort_diagnostics)
 
-    findings = scan_device_count_assumptions(args.paths)
+    findings = sort_diagnostics(
+        scan_device_count_assumptions(args.paths)
+        + scan_process_write_races(args.paths))
     for d in findings:
         print(f"  {d}")
     n_err = sum(1 for d in findings if d.severity == ERROR)
