@@ -813,6 +813,81 @@ def _overload_bench(on_tpu: bool):
             tok_on / dt_on / _n_chips(), 1)}
 
 
+def _spec_decode_bench(on_tpu: bool):
+    """BENCH_ONLY=spec_decode: goodput under deadline pressure with
+    speculative decoding on vs off (README: Sampling, speculative
+    decoding & streaming).  The same requests run twice under an
+    injected per-step slowdown (FaultPlan step_delay_s, so the outcome
+    is machine-independent): the plain engine pays one delayed decode
+    step per token, while the speculative engine pays two delayed steps
+    (draft scan + verify) per K+1 committed tokens — with K=3 and a
+    weight-identical draft (accept rate 1.0, the CEILING a real distilled
+    draft approaches; reported as such) that is 2 steps per 4 tokens,
+    a 2x wall-clock win the deadline is tuned to detect.  Deadline-bound
+    requests finish inside their SLO only with speculation on, so PR
+    10's goodput counter moves; a deadline-free request keeps the OFF
+    goodput nonzero so the ratio stays finite.  Reported value is the
+    on/off goodput ratio (> 1 means speculation converts busted
+    deadlines into met ones); accept rate, TPOT speedup and
+    tokens/sec/chip ride in the JSON line."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.resilience.chaos import FaultPlan, burst_prompts
+    from paddle_tpu.serving import (Engine, ServingConfig,
+                                    SpeculativeConfig)
+
+    k_draft, delay_s, deadline_s = 4, 0.03, 0.9
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model.eval()
+
+    def run(spec_on):
+        eng = Engine(model, ServingConfig(
+            max_batch_size=4, block_size=4, num_blocks=96,
+            chunk_tokens=16, max_queue_len=32,
+            speculative=(SpeculativeConfig(draft_model=model,
+                                           num_draft_tokens=k_draft)
+                         if spec_on else None)))
+        # warm OUTSIDE the fault plan: compile time must not eat into
+        # the deadline comparison
+        eng.generate(burst_prompts(seed=1, n=1, min_len=8, max_len=8),
+                     max_new_tokens=k_draft + 2)
+        reqs = []
+        with FaultPlan(seed=11, step_delay_s=delay_s):
+            t0 = time.perf_counter()
+            # 41 tokens of injected sleep: ~42 delayed steps (1.26s)
+            # off; on, ~ceil(40/5)=8 verify iterations at TWO delayed
+            # steps each (draft scan + verify) plus two delayed prefill
+            # pairs — ~0.6s, comfortably inside the 0.9s deadline
+            reqs.append(eng.submit(
+                burst_prompts(seed=5, n=1, min_len=8, max_len=8)[0],
+                max_new_tokens=41, deadline_s=deadline_s))
+            reqs.append(eng.submit(
+                burst_prompts(seed=6, n=1, min_len=8, max_len=8)[0],
+                max_new_tokens=5))
+            eng.run_until_complete()
+            dt = time.perf_counter() - t0
+        eng.pool.check_leaks()
+        c = eng.stats()["counters"]
+        tok = sum(len(r.generated) for r in reqs)
+        return (c["goodput_tokens"], tok, dt,
+                eng.metrics.spec_accept_rate())
+
+    g_off, tok_off, dt_off, _ = run(False)
+    g_on, tok_on, dt_on, accept = run(True)
+    ratio = g_on / g_off if g_off > 0 else float("inf")
+    tpot_speedup = (tok_on / dt_on) / (tok_off / dt_off)
+    print(f"# spec_decode: goodput off={g_off} on={g_on} tokens "
+          f"(ratio {ratio:.2f}x), accept_rate={accept:.3f} "
+          f"(weight-identical draft ceiling), K={k_draft}, "
+          f"tpot speedup {tpot_speedup:.2f}x", file=sys.stderr)
+    return round(float(ratio), 3), {
+        "spec_accept_rate": round(float(accept), 4),
+        "spec_tpot_speedup": round(float(tpot_speedup), 3),
+        "tokens_per_sec_per_chip": round(
+            tok_on / dt_on / _n_chips(), 1)}
+
+
 def _router_replay_bench(on_tpu: bool):
     """BENCH_ONLY=router_replay: the serving fleet router on a seeded
     multi-tenant trace (serving/replay.py), prefix-affinity placement
@@ -1150,6 +1225,7 @@ def _run_single(which: str, on_tpu: bool):
            "observe_overhead": _observe_overhead_bench,
            "mesh_train": _mesh_train_bench,
            "overload": _overload_bench,
+           "spec_decode": _spec_decode_bench,
            "router_replay": _router_replay_bench,
            "moe_plan": _moe_plan_bench,
            "dcn_plan": _dcn_plan_bench,
@@ -1441,6 +1517,7 @@ _ONLY_METRICS = {
     "observe_overhead": ("observe_overhead_pct", "%"),
     "mesh_train": ("mesh_train_tokens_per_sec_per_chip", "tokens/s/chip"),
     "overload": ("overload_goodput_ratio", "x"),
+    "spec_decode": ("spec_decode_goodput_ratio", "x"),
     "router_replay": ("router_replay_cached_token_ratio", "ratio"),
     "moe_plan": ("moe_plan_comm_kib", "KiB"),
     "dcn_plan": ("dcn_plan_dcn_wire_kib", "KiB"),

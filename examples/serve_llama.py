@@ -35,8 +35,22 @@ router quarantines it, drains its stranded requests, and resubmits them
 to the survivor with ZERO lost requests and token parity against a
 single-engine run.
 
+With ``--speculative`` (the CI spec-decode stage) a small random draft
+model proposes K tokens per target step and the target verifies all K+1
+positions in one chunked-shaped program: greedy outputs stay token-for-
+token identical to ``generate()`` AND to the non-speculative engine
+across accept/reject boundaries, a weight-identical draft hits the 1.0
+accept-rate ceiling, rejected drafts roll their KV blocks back leak-
+free, and both new steps compile exactly once.
+
+With ``--stream`` the demo drains one SSE response from the
+``Endpoint`` front door — ``data: <json>`` frames in token order,
+terminated by ``data: [DONE]`` — and asserts the streamed tokens match
+the request's final generated list, greedy and sampled.
+
 Run:  python examples/serve_llama.py
-          [--prefix-cache | --overload-chaos | --fused | --router]
+          [--prefix-cache | --overload-chaos | --fused | --router |
+           --speculative | --stream]
 """
 import argparse
 
@@ -279,6 +293,113 @@ def router_demo(model):
           "token parity across failover, zero retraces")
 
 
+def speculative_demo(model):
+    import dataclasses
+
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import SpeculativeConfig
+
+    # a real (weight-divergent) draft: same cache geometry and vocab,
+    # one layer, different seed — proposals get REJECTED, exercising
+    # the rollback path
+    paddle.seed(123)
+    draft = LlamaForCausalLM(dataclasses.replace(
+        LlamaConfig.tiny(), num_hidden_layers=1))
+    draft.eval()
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+               for L in (3, 8, 5, 12, 4, 9)]
+    max_new = 12
+
+    ref = [np.asarray(generate(model, paddle.to_tensor(p[None, :]),
+                               max_new_tokens=max_new).numpy())[0]
+           for p in prompts]
+    plain = Engine(model, ServingConfig(max_batch_size=4, block_size=8,
+                                        num_blocks=96))
+    plain_outs = plain.generate(list(prompts), max_new_tokens=max_new)
+
+    eng = Engine(model, ServingConfig(
+        max_batch_size=4, block_size=8, num_blocks=96,
+        speculative=SpeculativeConfig(draft_model=draft,
+                                      num_draft_tokens=3)))
+    outs = eng.generate(list(prompts), max_new_tokens=max_new)
+    for i, (o, r, p) in enumerate(zip(outs, ref, plain_outs)):
+        assert np.array_equal(o, r), f"request {i}: spec != generate"
+        assert np.array_equal(o, p), f"request {i}: spec != plain engine"
+    m = eng.stats()["counters"]
+    print(f"token parity: {len(prompts)} requests, speculative == "
+          f"generate() == non-speculative engine")
+    print(f"random draft: {m['spec_tokens_drafted']} drafted, "
+          f"{m['spec_tokens_accepted']} accepted "
+          f"(rate {eng.metrics.spec_accept_rate():.2f})")
+    eng.pool.check_leaks()     # rejected drafts leaked nothing
+
+    # weight-identical draft: every greedy proposal matches the target
+    # argmax — the accept-rate ceiling a distilled draft approaches
+    ceil = Engine(model, ServingConfig(
+        max_batch_size=4, block_size=8, num_blocks=96,
+        speculative=SpeculativeConfig(draft_model=model,
+                                      num_draft_tokens=3)))
+    couts = ceil.generate(list(prompts), max_new_tokens=max_new)
+    assert all(np.array_equal(o, r) for o, r in zip(couts, ref))
+    assert ceil.metrics.spec_accept_rate() == 1.0
+    print(f"self-draft ceiling: accept rate "
+          f"{ceil.metrics.spec_accept_rate():.2f}")
+
+    for e in (eng, ceil):
+        caches = e.spec_cache_sizes()
+        assert all(v == 1 for v in caches.values()), caches
+        assert e._draft_propose_step.retraces == 0
+        assert e._spec_verify_step.retraces == 0
+        assert e._draft_prefill_step.retraces == 0
+        e.pool.check_leaks()
+    print("speculative decoding: zero retraces, one executable per "
+          "step kind, zero KV leaks after rejected drafts")
+
+
+def stream_demo(model):
+    import json
+
+    from paddle_tpu.serving import Endpoint
+
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 256, size=(6,)).astype(np.int32)
+    ep = Endpoint(model, ServingConfig(max_batch_size=4, block_size=8,
+                                       num_blocks=64))
+
+    frames = list(ep.stream(prompt, max_new_tokens=8))
+    assert frames[-1] == "data: [DONE]\n\n"
+    events = []
+    for f in frames[:-1]:
+        assert f.startswith("data: ") and f.endswith("\n\n"), repr(f)
+        events.append(json.loads(f[len("data: "):]))
+    toks = [e["token"] for e in events[:-1]]
+    summary = events[-1]
+    print(f"streamed {len(toks)} tokens: {toks}")
+    print(f"summary: {summary}")
+    assert summary["finish_reason"] == "length"
+    assert summary["num_tokens"] == len(toks) == 8
+    assert [e["index"] for e in events[:-1]] == list(range(8))
+
+    # the streamed tokens ARE the request's generated list — and they
+    # match a plain (non-streaming) run of the same prompt
+    ref = ep.run([prompt], max_new_tokens=8)[0][len(prompt):].tolist()
+    assert toks == ref, (toks, ref)
+
+    # one sampled stream: same seed twice -> identical streamed tokens
+    def stream_tokens(**kw):
+        fs = list(ep.stream(prompt, max_new_tokens=8, **kw))
+        return [json.loads(f[len("data: "):])["token"] for f in fs[:-2]]
+
+    sampled = dict(do_sample=True, temperature=0.8, top_k=16, seed=7)
+    a, b = stream_tokens(**sampled), stream_tokens(**sampled)
+    assert a == b, (a, b)
+    print(f"sampled stream (seed 7, replayed identically): {b}")
+    print("SSE round-trip OK: framed, ordered, [DONE]-terminated, "
+          "token parity with the non-streaming path")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prefix-cache", action="store_true",
@@ -295,6 +416,15 @@ def main():
                     help="two-replica fleet router: prefix-affinity "
                          "placement, then a chaos-killed replica with "
                          "drain + resubmit and zero lost requests")
+    ap.add_argument("--speculative", action="store_true",
+                    help="draft-propose/target-verify speculative "
+                         "decoding: greedy token parity with generate() "
+                         "and the plain engine, leak-free rollback, "
+                         "self-draft accept-rate ceiling")
+    ap.add_argument("--stream", action="store_true",
+                    help="SSE streaming front door: per-token data: "
+                         "frames in order, summary event, [DONE] "
+                         "terminator, parity with the batch path")
     args = ap.parse_args()
 
     paddle.seed(0)
@@ -308,6 +438,10 @@ def main():
         fused_demo(model)
     elif args.router:
         router_demo(model)
+    elif args.speculative:
+        speculative_demo(model)
+    elif args.stream:
+        stream_demo(model)
     else:
         staggered_demo(model)
 

@@ -79,7 +79,8 @@ __all__ = [
 #: path — any DCN-crossing collective inside one is an S213 ERROR
 LATENCY_CRITICAL_STEP_KINDS = frozenset(
     {"decode", "beam_decode", "paged_decode", "prefill",
-     "chunked_prefill"})
+     "chunked_prefill", "sampled_decode", "draft_propose",
+     "spec_verify"})
 
 #: S213 noise floor: a DCN edge must move at least this many wire
 #: bytes per step to be flagged — scalar-sized control reduces (the
@@ -1569,7 +1570,8 @@ def _serving_arg_specs(model, layout, decode_args, prefill_args):
 
 #: audit_shardplan's default step set and the canonical mesh each step
 #: falls back to when the caller's mesh lacks its required axis
-DEFAULT_AUDIT_STEPS = ("train", "decode", "prefill", "moe", "ring")
+DEFAULT_AUDIT_STEPS = ("train", "decode", "prefill", "sampled_decode",
+                       "spec_verify", "moe", "ring")
 _MOE_AUDIT_MESH = {"data": 2, "fsdp": 2, "expert": 2}
 _RING_AUDIT_MESH = {"data": 2, "sp": 2, "tp": 2}
 
@@ -1631,7 +1633,8 @@ def audit_shardplan(*, chip: str = "cpu",
     from .xray import _serving_abstract_args
 
     net.eval()
-    serving_kinds = {"decode", "prefill", "fused_decode", "fused_prefill"}
+    serving_kinds = {"decode", "prefill", "fused_decode", "fused_prefill",
+                     "sampled_decode", "spec_verify"}
     if serving_kinds & set(steps):
         decode_args, prefill_args = _serving_abstract_args(
             net, batch=4, num_blocks=32, block_size=8,
@@ -1656,6 +1659,57 @@ def audit_shardplan(*, chip: str = "cpu",
         # off-TPU): same shapes and latency-critical step kinds as the
         # unfused plans — the CI gate that the fused programs plan
         # without S210 unknown-collective blind spots
+        # sampled decode + speculative verify (ISSUE 19): the decode/
+        # chunked-prefill shapes plus per-slot sampling state.  All the
+        # sampling-state arrays are slot-indexed, so they shard exactly
+        # like the batch inputs; draft proposal distributions [S, K, V]
+        # likewise shard on the slot dim only.
+        if {"sampled_decode", "spec_verify"} & set(steps):
+            from ..serving.sampling import make_sampled_decode_step
+            from ..serving.speculative import make_spec_verify_step
+
+            sds_ = jax.ShapeDtypeStruct
+            s_batch, num_draft = 4, 4
+            b_spec = lay.batch_spec()
+            sampling_args = (sds_((s_batch,), np.float32),
+                             sds_((s_batch,), np.int32),
+                             sds_((s_batch,), np.float32),
+                             sds_((s_batch, 2), np.uint32),
+                             sds_((s_batch,), np.int32))
+            sampling_specs = (b_spec,) * 5
+            if "sampled_decode" in steps:
+                reports.append(plan_step(
+                    make_sampled_decode_step(net),
+                    decode_args + sampling_args, model=net,
+                    arg_specs=decode_specs + sampling_specs,
+                    request=req, name="serving::sampled_decode_step",
+                    data_input_leaves=(("tokens", 0),),
+                    step_kind="sampled_decode"))
+            if "spec_verify" in steps:
+                pool_spec = decode_specs[1]
+                verify_args = (
+                    sds_((s_batch,), np.int32),
+                    sds_((s_batch, num_draft), np.int32),
+                    sds_((s_batch, num_draft, cfg.vocab_size),
+                         np.float32),
+                    decode_args[1], decode_args[2], decode_args[3]
+                ) + sampling_args
+                # slot-indexed verify args stay REPLICATED: the
+                # acceptance math reshapes [S, K+1] into [S*(K+1)],
+                # and a batch-sharded slot dim would turn that reshape
+                # into data-axis collectives on the decode critical
+                # path (S213).  The pool still shards on tp like the
+                # plain decode step.
+                from jax.sharding import PartitionSpec
+                rep = PartitionSpec()
+                verify_specs = (rep, rep, rep, pool_spec,
+                                rep, rep) + (rep,) * 5
+                reports.append(plan_step(
+                    make_spec_verify_step(net, num_draft), verify_args,
+                    model=net, arg_specs=verify_specs, request=req,
+                    name="serving::spec_verify_step",
+                    data_input_leaves=(("pending", 0),),
+                    step_kind="spec_verify"))
         if "fused_decode" in steps:
             reports.append(plan_step(
                 make_paged_decode_step(net, fused=True), decode_args,

@@ -981,6 +981,35 @@ def audit_default_steps(*, chip: str = "cpu",
         make_chunked_prefill_step(net), prefill_args,
         name="serving::prefill_step", chip=chip,
         hbm_budget_bytes=hbm_budget_bytes))
+
+    # sampled + speculative serving steps (ISSUE 19): same pool/table
+    # geometry as the plain decode step, plus the per-slot sampling
+    # state (temps/top_ks/top_ps/keys/counters) and, for verify, the
+    # K-token draft proposals with their filtered distributions
+    from ..serving.sampling import make_sampled_decode_step
+    from ..serving.speculative import make_spec_verify_step
+
+    sds = jax.ShapeDtypeStruct
+    batch, num_draft = 4, 4
+    sampling_state = (sds((batch,), np.float32),          # temps
+                     sds((batch,), np.int32),             # top_ks
+                     sds((batch,), np.float32),           # top_ps
+                     sds((batch, 2), np.uint32),          # keys
+                     sds((batch,), np.int32))             # counters
+    reports.append(analyze(
+        make_sampled_decode_step(net), decode_args + sampling_state,
+        name="serving::sampled_decode_step", chip=chip,
+        hbm_budget_bytes=hbm_budget_bytes))
+    pool_arg, table_arg, lengths_arg = decode_args[1:4]
+    verify_args = (sds((batch,), np.int32),               # pending
+                   sds((batch, num_draft), np.int32),     # proposals
+                   sds((batch, num_draft, cfg.vocab_size),
+                       np.float32),                       # draft_probs
+                   pool_arg, table_arg, lengths_arg) + sampling_state
+    reports.append(analyze(
+        make_spec_verify_step(net, num_draft), verify_args,
+        name="serving::spec_verify_step", chip=chip,
+        hbm_budget_bytes=hbm_budget_bytes))
     if fused:
         reports.append(analyze(
             make_paged_decode_step(net, fused=True), decode_args,

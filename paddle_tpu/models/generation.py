@@ -494,13 +494,23 @@ def make_ring_sp_step(model, mesh=None):
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, num_beams=1,
              eos_token_id=None, seed=None, use_static_cache=False,
-             stop_sequences=None, tokenizer=None):
+             stop_sequences=None, tokenizer=None, sampling=None):
     """Decode continuations for a batch of prompts.
 
     Returns [B, T_prompt + T_new] token ids (beam search returns the best
     beam per batch element).  Greedy by default; ``do_sample`` enables
-    temperature/top-k/top-p sampling; ``num_beams > 1`` switches to beam
-    search with length-agnostic log-prob scores.
+    temperature/top-k/top-p sampling (``sampling=SamplingParams(...)``
+    is the equivalent explicit spelling, shared with ``Engine.submit``);
+    ``num_beams > 1`` switches to beam search with length-agnostic
+    log-prob scores.
+
+    Sampled decoding uses the serving engine's key schedule — the seed's
+    base key folded with each TOKEN INDEX (serving/sampling.py) — so the
+    same prompt + seed is token-exact here and under the engine, which
+    is what extends the engine-vs-generate parity oracle to sampled
+    outputs.  All rows of a batch share the base key: identical prompts
+    sample identical continuations (seed identity is per REQUEST, not
+    per row — submit separate engine requests for diverse samples).
 
     Termination: a sequence finishes when it emits ``eos_token_id``, when
     its generated suffix matches any of ``stop_sequences`` (token-id
@@ -511,6 +521,16 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     from ..core.dispatch import no_grad_ctx
     from ..ops import random as rnd
 
+    if sampling is not None:
+        # lazy: serving imports this module at load time
+        from ..serving.sampling import resolve_sampling
+
+        params = resolve_sampling(sampling)
+        do_sample = params is not None
+        if params is not None:
+            temperature, top_k, top_p, seed = (params.temperature,
+                                               params.top_k,
+                                               params.top_p, params.seed)
     ids = np.asarray(input_ids.numpy() if hasattr(input_ids, "numpy")
                      else input_ids)
     if ids.ndim == 1:
@@ -534,6 +554,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                                   use_static_cache=use_static_cache)
         # seed=None draws from the framework RNG stream (paddle.seed)
         key = rnd.next_key() if seed is None else jax.random.PRNGKey(seed)
+        if do_sample:
+            # serving/sampling key schedule: token i samples with
+            # fold_in(base, i) on device — slot- and batch-independent,
+            # so the engine reproduces these exact streams per seed
+            from ..serving.sampling import sample_at
+
+            base_keys = np.broadcast_to(
+                np.asarray(key, np.uint32).reshape(-1)[:2], (B, 2))
+            s_temps = np.full((B,), float(temperature or 0.0), np.float32)
+            s_tks = np.full((B,), int(top_k or 0), np.int32)
+            s_tps = np.full((B,), float(top_p if top_p else 1.0),
+                            np.float32)
         caches = _static_caches(model, B, T0 + max_new_tokens) \
             if use_static_cache else _empty_caches(model, B)
         logits, caches = model(to_tensor(ids.astype(np.int32)),
@@ -552,10 +584,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         suffixes = [[] for _ in range(B)]   # per-row stop-match windows
         last = logits._value[:, -1].astype(jnp.float32)
         for step in range(max_new_tokens):
-            key, sub = jax.random.split(key)
-            tok = _select_token(last, do_sample=do_sample,
-                                temperature=temperature, top_k=top_k,
-                                top_p=top_p, key=sub)
+            if do_sample:
+                tok = sample_at(last, s_temps, s_tks, s_tps, base_keys,
+                                np.full((B,), step, np.int32))
+            else:
+                tok = jnp.argmax(last, axis=-1)
             tok_np = np.asarray(tok)
             if terminal:
                 tok_np = np.where(finished, pad_id, tok_np)

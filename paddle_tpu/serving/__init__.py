@@ -20,6 +20,9 @@ Layout:
 - :mod:`overload`  — load shedding, degradation ladder, step watchdog
 - :mod:`router`    — :class:`Router`, prefix/load-aware fleet placement
 - :mod:`replay`    — multi-tenant trace replay bench for the router
+- :mod:`sampling`  — seeded temperature/top-k/top-p (:class:`SamplingParams`)
+- :mod:`speculative` — draft-propose/target-verify decoding
+- :mod:`stream`    — SSE framing over ``submit(on_token=...)``
 
 Quick start::
 
@@ -42,8 +45,11 @@ from .overload import (DEGRADED, FAILED, LADDER_LEVELS, SERVING,
 from .replay import (Arrival, Tenant, build_trace, default_tenants,
                      replay_trace)
 from .router import ROUTER_POLICIES, Router, RouterMetrics
+from .sampling import SamplingParams
 from .scheduler import (FINISHED, PREEMPTED, PREFILLING, QUEUED, RUNNING,
                         AdmissionError, QueueFull, Request, Scheduler)
+from .speculative import SpeculativeConfig
+from .stream import sse_event, sse_stream, stream_events
 
 __all__ = [
     "Engine",
@@ -67,6 +73,11 @@ __all__ = [
     "default_tenants",
     "build_trace",
     "replay_trace",
+    "SamplingParams",
+    "SpeculativeConfig",
+    "sse_event",
+    "sse_stream",
+    "stream_events",
     "LADDER_LEVELS",
     "SERVING",
     "DEGRADED",

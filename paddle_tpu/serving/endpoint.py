@@ -92,6 +92,14 @@ class Endpoint:
     def drain(self) -> Dict[str, Request]:
         return self.engine.run_until_complete()
 
+    def stream(self, prompt, **kwargs):
+        """SSE response for ``prompt``: an iterator of ``data: <json>``
+        frames (one per token, then a summary event and ``[DONE]``) —
+        see :mod:`paddle_tpu.serving.stream`.  The engine keeps serving
+        other in-flight requests while the caller drains."""
+        from .stream import sse_stream
+        return sse_stream(self, prompt, **{**self._defaults, **kwargs})
+
     def result(self, req: Request) -> Optional[np.ndarray]:
         return req.output_ids() if req.state == FINISHED else None
 

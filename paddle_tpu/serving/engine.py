@@ -30,6 +30,7 @@ bits a fresh prefill would produce; copy-on-write keeps them immutable).
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -45,8 +46,11 @@ from .. import profiler
 from .cache import BlockKVPool, PoolExhausted
 from .metrics import ServingMetrics
 from .overload import EngineQuarantined, OverloadController
+from .sampling import make_sampled_decode_step, resolve_sampling, sample_at
 from .scheduler import (FINISHED, PREFILLING, RUNNING, AdmissionError,
                         QueueFull, Request, Scheduler)
+from .speculative import (SpeculativeConfig, make_draft_propose_step,
+                          make_spec_verify_step)
 
 
 def _trace(name: str):
@@ -146,6 +150,14 @@ class ServingConfig:
     # False pins the unfused reference path on any backend.  Pinned at
     # step-build time, so it never flips inside a compiled program.
     fused_kernels: Optional[bool] = None
+    # speculative decoding (serving/speculative.py): a SpeculativeConfig
+    # (or a bare draft model, wrapped with the default K).  The draft's
+    # KV layers live in the SAME BlockKVPool as the target's — one
+    # block table per sequence, so the prefix cache serves both models
+    # — and every decode iteration becomes draft-propose (K tokens, one
+    # scanned program) + target-verify ([S, K+1], one chunked-shaped
+    # program) with on-device acceptance and block-granular KV rollback.
+    speculative: Any = None
 
 
 class Engine:
@@ -163,8 +175,30 @@ class Engine:
         self.max_blocks_per_seq = -(-self.max_model_len // cfg.block_size)
         self.chunk_tokens = max(1, min(cfg.chunk_tokens,
                                        self.max_model_len))
+        # speculative decoding: one pool holds the target's layers
+        # followed by the draft's, addressed by the same block tables
+        spec = cfg.speculative
+        if spec is not None and not isinstance(spec, SpeculativeConfig):
+            spec = SpeculativeConfig(draft_model=spec)
+        self.spec = spec
+        self._n_target_layers = model.config.num_hidden_layers
+        num_layers = self._n_target_layers
+        if spec is not None:
+            spec.validate_against(model)
+            if cfg.mesh is not None:
+                raise ValueError(
+                    "speculative decoding under a runtime mesh is not "
+                    "supported yet (the draft's weights would stay "
+                    "unsharded)")
+            draft_max = getattr(spec.draft_model.config,
+                                "max_position_embeddings", None)
+            if draft_max is not None and draft_max < self.max_model_len:
+                raise ValueError(
+                    f"draft max_position_embeddings ({draft_max}) < "
+                    f"max_model_len ({self.max_model_len})")
+            num_layers += spec.draft_model.config.num_hidden_layers
         self.pool = BlockKVPool(
-            model.config.num_hidden_layers, cfg.num_blocks, cfg.block_size,
+            num_layers, cfg.num_blocks, cfg.block_size,
             kv_heads, head_dim, dtype,
             enable_prefix_cache=cfg.enable_prefix_cache)
         self.scheduler = Scheduler(self.pool,
@@ -177,6 +211,14 @@ class Engine:
                                       np.int32)
         self._lengths = np.zeros((S,), np.int32)
         self._pending = np.zeros((S,), np.int32)  # next token to decode
+        # per-slot sampling state, all fixed-shape device-step inputs:
+        # greedy slots keep temperature 0 (the argmax lane inside the
+        # sampled/verify steps) so a mixed bucket is still ONE program
+        self._temps = np.zeros((S,), np.float32)
+        self._top_ks = np.zeros((S,), np.int32)
+        self._top_ps = np.ones((S,), np.float32)
+        self._keys = np.zeros((S, 2), np.uint32)      # per-request base keys
+        self._counters = np.zeros((S,), np.int32)     # next token index
         # runtime SPMD: shard weights + KV pool BEFORE the step makers
         # below — the steps capture the weights as jit constants, so the
         # rebind here is what makes the compiled programs multi-device
@@ -202,6 +244,39 @@ class Engine:
             make_chunked_prefill_step(model, fused=cfg.fused_kernels),
             after=1, label="serving::prefill_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
+        self._sampled_decode_step = warn_on_retrace(
+            make_sampled_decode_step(model, fused=cfg.fused_kernels),
+            after=1, label="serving::sampled_decode_step",
+            on_retrace="raise" if cfg.strict_no_retrace else "count")
+        # every ADDITIONAL compiled step gets its own watchdog: the
+        # per-EWMA compile_s carve-out only exempts ONE first call, so
+        # sharing the decode/prefill watchdogs would record the second
+        # program's compile as a real latency sample and poison the
+        # budget + TTFT estimate (over-shedding) for good
+        self._sampled_wd = self.overload.extra_watchdog(
+            "sampled_decode_step")
+        if spec is not None:
+            draft = spec.draft_model
+            self._draft_prefill_step = warn_on_retrace(
+                make_chunked_prefill_step(draft, fused=cfg.fused_kernels),
+                after=1, label="serving::draft_prefill_step",
+                on_retrace="raise" if cfg.strict_no_retrace else "count")
+            self._draft_propose_step = warn_on_retrace(
+                make_draft_propose_step(draft, spec.num_draft_tokens,
+                                        fused=cfg.fused_kernels),
+                after=1, label="serving::draft_propose_step",
+                on_retrace="raise" if cfg.strict_no_retrace else "count")
+            self._spec_verify_step = warn_on_retrace(
+                make_spec_verify_step(model, spec.num_draft_tokens,
+                                      fused=cfg.fused_kernels),
+                after=1, label="serving::spec_verify_step",
+                on_retrace="raise" if cfg.strict_no_retrace else "count")
+            self._draft_prefill_wd = self.overload.extra_watchdog(
+                "draft_prefill_step")
+            self._draft_propose_wd = self.overload.extra_watchdog(
+                "draft_propose_step")
+            self._spec_verify_wd = self.overload.extra_watchdog(
+                "spec_verify_step")
         self._finished: Dict[str, Request] = {}
         self._ids = itertools.count()
         self._evictions_seen = 0    # pool counter already mirrored
@@ -295,11 +370,38 @@ class Engine:
                 "\n  ".join(str(d) for d in errors))
         return reports
 
+    # ------------------------------------------------- pool layer slices
+    # the combined pool lists the target's layers first, then the
+    # draft's; every step consumes only its model's slice, and each
+    # rebind reassembles the full list (non-speculative engines pass
+    # through untouched)
+    def _target_pools(self):
+        if self.spec is None:
+            return self.pool.layers
+        return self.pool.layers[:self._n_target_layers]
+
+    def _draft_pools(self):
+        return self.pool.layers[self._n_target_layers:]
+
+    def _rebind_target(self, new_pools):
+        new = [(k, v) for k, v in new_pools]
+        if self.spec is None:
+            self.pool.layers = new
+        else:
+            self.pool.layers = new + self._draft_pools()
+
+    def _rebind_draft(self, new_pools):
+        self.pool.layers = self.pool.layers[:self._n_target_layers] \
+            + [(k, v) for k, v in new_pools]
+
     # ----------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, stop_sequences=None,
                tokenizer=None, request_id: Optional[str] = None,
                temperature: float = 0.0, do_sample: bool = False,
+               top_k: int = 0, top_p: float = 1.0,
+               seed: Optional[int] = None, sampling=None,
+               on_token=None, token_deadline_s: Optional[float] = None,
                deadline_s: Optional[float] = None, priority: int = 0
                ) -> Request:
         """Queue one request; returns its :class:`Request` handle.
@@ -324,23 +426,31 @@ class Engine:
         higher-priority arrival hitting a FULL queue sheds the
         lowest-priority waiting request instead of being rejected.
 
-        ``temperature``/``do_sample`` exist for ``generate()`` call-site
-        parity only: the engine decodes greedily (one shared compiled
-        step for the whole bucket), so greedy settings are accepted and
-        a sampling request is a loud :class:`ValueError` rather than a
-        silently different decode."""
+        Sampling: ``sampling=SamplingParams(...)`` (or a dict of its
+        fields), or the ``generate()``-style spelling —
+        ``temperature``/``do_sample``/``top_k``/``top_p``/``seed``.
+        ``temperature=0`` stays the greedy special case and runs the
+        unchanged greedy decode step; a sampled request carries a
+        per-request PRNG key derived from its seed, folded with the
+        token index ON DEVICE, so outputs are token-exact with
+        ``generate()`` under the same seed regardless of batching or
+        preemption (serving/sampling.py).
+
+        Streaming: ``on_token`` fires once per ACCEPTED token (several
+        per iteration under speculative decoding), in commit order.
+        ``token_deadline_s`` is a rolling inter-token SLO: it resets on
+        every emitted token and retires a stalled stream with
+        ``finish_reason="timeout"``; the load shedder treats it as an
+        effective TTFT bound."""
         if self.overload.health.failed:
             self.metrics.on_reject()
             raise AdmissionError(
                 "engine quarantined FAILED "
                 f"({self.overload.health.last_error}); revive() after "
                 "operator intervention")
-        if do_sample or (temperature is not None
-                         and float(temperature) != 0.0):
-            raise ValueError(
-                "the serving engine decodes greedily; sampling "
-                "(do_sample=True or temperature>0) is not supported — "
-                "use temperature=0.0, generate()'s greedy contract")
+        params = resolve_sampling(sampling, temperature=temperature,
+                                  do_sample=do_sample, top_k=top_k,
+                                  top_p=top_p, seed=seed)
         prompt = np.asarray(
             prompt.numpy() if hasattr(prompt, "numpy") else prompt,
             np.int32).reshape(-1)
@@ -350,18 +460,33 @@ class Engine:
             stop_sequences=normalize_stop_sequences(stop_sequences,
                                                     tokenizer),
             request_id=request_id or f"req-{next(self._ids)}",
-            deadline_s=deadline_s, priority=priority)
-        if req.prompt_len + req.max_new_tokens > self.max_model_len:
+            deadline_s=deadline_s, priority=priority,
+            sampling=params,
+            sampling_key=params.base_key() if params is not None else None,
+            on_token=on_token, token_deadline_s=token_deadline_s)
+        # speculation writes K draft positions past the frontier each
+        # iteration; the admission bound keeps even the deepest
+        # (immediately rolled back) write inside max_model_len
+        limit = self.max_model_len - (
+            self.spec.num_draft_tokens if self.spec is not None else 0)
+        if req.prompt_len + req.max_new_tokens > limit:
             self.metrics.on_reject()
             raise AdmissionError(
                 f"{req.request_id}: prompt ({req.prompt_len}) + "
                 f"max_new_tokens ({req.max_new_tokens}) exceeds "
-                f"max_model_len ({self.max_model_len})")
+                f"max_model_len ({limit})")
         # deadline-aware load shedding (serving/overload.py): when even
         # an optimistic TTFT estimate busts the SLO, retire now — the
         # caller gets the handle back with finish_reason="shed"
-        if self.overload.should_shed(self, req.prompt, deadline_s):
+        effective_deadline = deadline_s
+        if token_deadline_s is not None:
+            effective_deadline = token_deadline_s \
+                if effective_deadline is None \
+                else min(effective_deadline, token_deadline_s)
+        if self.overload.should_shed(self, req.prompt, effective_deadline):
             self.metrics.on_submit(req.request_id)
+            if req.on_token is not None:
+                self.metrics.on_stream_start()
             self._retire(req, "shed")
             return req
         try:
@@ -380,6 +505,8 @@ class Engine:
             self.metrics.on_reject()
             raise
         self.metrics.on_submit(req.request_id)
+        if req.on_token is not None:
+            self.metrics.on_stream_start()
         return req
 
     # ------------------------------------------------------------- step
@@ -550,43 +677,77 @@ class Engine:
         # recomputes the identical chunk from the unchanged pool.  The
         # pool rebind below happens only after a successful attempt.
         last, new_pools = self.overload.prefill_watchdog.call(
-            self._prefill_step, ids, self.pool.layers, bt,
+            self._prefill_step, ids, self._target_pools(), bt,
             np.asarray([start], np.int32), np.int32(n_tok - 1))
-        self.pool.layers = [(k, v) for k, v in new_pools]
+        self._rebind_target(new_pools)
+        if self.spec is not None:
+            # the draft prefills the same chunk into its own layer slice
+            # of the SAME blocks (already CoW-protected above), so the
+            # prefix cache serves both models from one block table
+            _, new_draft = self._draft_prefill_wd.call(
+                self._draft_prefill_step, ids, self._draft_pools(), bt,
+                np.asarray([start], np.int32), np.int32(n_tok - 1))
+            self._rebind_draft(new_draft)
         req.prefill_pos = start + n_tok
         req.prefill_chunks += 1
         if req.prefill_pos < req.prompt_len:
             return
         # prompt complete: the last chunk's logits row IS the first token
-        first_tok = int(np.argmax(np.asarray(last)[0]))
+        # (token index 0 — sampled lanes fold the base key with 0, the
+        # same program generate() runs, so the streams agree from the
+        # very first token)
+        params = req.sampling
+        if params is not None:
+            first_tok = int(np.asarray(sample_at(
+                np.asarray(last).astype(np.float32),
+                np.asarray([params.temperature], np.float32),
+                np.asarray([params.top_k], np.int32),
+                np.asarray([params.top_p], np.float32),
+                req.sampling_key[None, :],
+                np.asarray([0], np.int32)))[0])
+        else:
+            first_tok = int(np.argmax(np.asarray(last)[0]))
         req.state = RUNNING
         req.generated = [first_tok]
-        self._lengths[req.slot] = req.prompt_len
-        self._pending[req.slot] = first_tok
+        slot = req.slot
+        self._lengths[slot] = req.prompt_len
+        self._pending[slot] = first_tok
+        if params is not None:
+            self._temps[slot] = params.temperature
+            self._top_ks[slot] = params.top_k
+            self._top_ps[slot] = params.top_p
+            self._keys[slot] = req.sampling_key
+        self._counters[slot] = 1
         self.metrics.on_first_token(req.request_id)
         self.metrics.on_prefill_complete(req.request_id,
                                          req.prefill_chunks)
         # publish the prompt's full blocks for future prefix hits (they
         # become immutable; the decode frontier CoWs out as needed)
         self.pool.register_prefix(req.request_id, req.prompt, req.blocks)
+        if not self._emit_token(req, first_tok):
+            self._retire(req, "error")
+            return
         # the prefill's token may already terminate the request
         self._maybe_retire(req)
 
     # ---------------------------------------------------------- decode
-    def _ensure_blocks(self):
-        """Every RUNNING slot needs a WRITABLE block for its next write
-        position: allocate when the frontier crosses into a new block,
-        copy-on-write when it sits in a block the prefix cache shares.
-        Allocation preempts YOUNGEST-first when the pool is dry —
-        oldest first, so a starving old request evicts young ones, never
-        the reverse (a young request that cannot get a block preempts
-        ITSELF before touching older work)."""
+    def _ensure_blocks(self, horizon: int = 1):
+        """Every RUNNING slot needs WRITABLE blocks for its next
+        ``horizon`` write positions (1 for plain decode; K+1 under
+        speculative decoding, where the verify step writes the pending
+        token plus K draft positions): allocate when the frontier
+        crosses into a new block, copy-on-write when a written block is
+        one the prefix cache shares.  Allocation preempts
+        YOUNGEST-first when the pool is dry — oldest first, so a
+        starving old request evicts young ones, never the reverse (a
+        young request that cannot get a block preempts ITSELF before
+        touching older work)."""
         for req in sorted(self.scheduler.running,
                           key=lambda r: r.ordinal):
             if req.slot is None or req.state != RUNNING:
                 continue
             pos = int(self._lengths[req.slot])
-            need = self.pool.blocks_for(pos + 1)
+            need = self.pool.blocks_for(pos + horizon)
             preempted = False
             while len(req.blocks) < need:
                 try:
@@ -606,29 +767,33 @@ class Engine:
                 req.blocks.extend(new)
             if preempted:
                 continue
-            # the frontier block may be shared (prefix-cache hit on the
+            # a written block may be shared (prefix-cache hit on the
             # whole prompt, or a registered prompt tail): break the
-            # share before decode writes into it
-            fi = pos // self.config.block_size
-            while True:
-                try:
-                    new = self.pool.ensure_writable(req.request_id,
-                                                    req.blocks[fi])
-                except PoolExhausted:
-                    victim = self.scheduler.pick_victim()
-                    if victim is None:
-                        raise
-                    self._preempt(victim)
-                    if victim is req:
-                        preempted = True
-                        break
-                    continue
-                break
+            # share before decode writes into it.  Freshly allocated
+            # blocks are singly-owned, so ensure_writable is a no-op
+            # past the frontier block.
+            for fi in range(pos // self.config.block_size, need):
+                while True:
+                    try:
+                        new = self.pool.ensure_writable(req.request_id,
+                                                        req.blocks[fi])
+                    except PoolExhausted:
+                        victim = self.scheduler.pick_victim()
+                        if victim is None:
+                            raise
+                        self._preempt(victim)
+                        if victim is req:
+                            preempted = True
+                            break
+                        continue
+                    break
+                if preempted:
+                    break
+                if new != req.blocks[fi]:
+                    req.blocks[fi] = new
+                    self._block_tables[req.slot, fi] = new
             if preempted:
                 continue
-            if new != req.blocks[fi]:
-                req.blocks[fi] = new
-                self._block_tables[req.slot, fi] = new
 
     def _preempt(self, victim: Request):
         """Evict-and-requeue (recompute mode): free everything, head of
@@ -642,17 +807,20 @@ class Engine:
         self._block_tables[slot] = 0
         self._lengths[slot] = 0
         self._pending[slot] = 0
+        self._clear_sampling_slot(slot)
         self.scheduler.requeue_preempted(victim)
 
-    def _decode_iteration(self):
-        self._ensure_blocks()
-        active = [r for r in self._slots
-                  if r is not None and r.state == RUNNING]
-        if not active:
-            return
-        # decode view of the block tables: slots still mid-prefill are
-        # masked to the garbage block so the bucket-wide step can never
-        # write into (possibly shared) blocks of an unfinished prompt
+    def _clear_sampling_slot(self, slot: int):
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 1.0
+        self._keys[slot] = 0
+        self._counters[slot] = 0
+
+    def _decode_block_view(self):
+        """Decode view of the block tables: slots still mid-prefill are
+        masked to the garbage block so a bucket-wide step can never
+        write into (possibly shared) blocks of an unfinished prompt."""
         bt = self._block_tables
         if any(r is not None and r.state == PREFILLING
                for r in self._slots):
@@ -660,6 +828,38 @@ class Engine:
             for i, r in enumerate(self._slots):
                 if r is not None and r.state == PREFILLING:
                     bt[i] = 0
+        return bt
+
+    def _emit_token(self, req: Request, tok: int) -> bool:
+        """Per-accepted-token hooks: reset the rolling inter-token
+        deadline and fire the streaming callback.  Returns False when
+        the callback raised — the CONSUMER failed, so the caller
+        retires the request as an error instead of crashing the engine
+        loop (poison isolation, same policy as prefill)."""
+        if req.token_deadline_s is not None:
+            req.token_deadline_t = time.monotonic() + req.token_deadline_s
+        if req.on_token is None:
+            return True
+        try:
+            req.on_token(tok)
+        except Exception as e:  # noqa: BLE001 — consumer isolation
+            req.error = f"on_token callback: {type(e).__name__}: {e}"
+            return False
+        return True
+
+    def _decode_iteration(self):
+        if self.spec is not None:
+            self._spec_iteration()
+            return
+        self._ensure_blocks()
+        active = [r for r in self._slots
+                  if r is not None and r.state == RUNNING]
+        if not active:
+            return
+        bt = self._decode_block_view()
+        if any(r.sampling is not None for r in active):
+            self._sampled_iteration(active, bt)
+            return
         with _trace("serving::decode_step"):
             # the np.asarray device→host sync happens INSIDE the timed
             # closure so the watchdog budget covers device execution,
@@ -671,9 +871,9 @@ class Engine:
                 return np.asarray(out), pools
 
             logits, new_pools = self.overload.decode_watchdog.call(
-                _timed_decode, self._pending[:, None], self.pool.layers,
-                bt, self._lengths)
-            self.pool.layers = [(k, v) for k, v in new_pools]
+                _timed_decode, self._pending[:, None],
+                self._target_pools(), bt, self._lengths)
+            self._rebind_target(new_pools)
         self.metrics.on_decode_iteration(
             len(active), self.config.max_batch_size,
             self.pool.utilization())
@@ -684,7 +884,141 @@ class Engine:
             next_tok = int(np.argmax(logits[slot]))
             req.generated.append(next_tok)
             self._pending[slot] = next_tok
+            self._counters[slot] = len(req.generated)
+            if not self._emit_token(req, next_tok):
+                self._retire(req, "error")
+                continue
             self._maybe_retire(req)
+
+    def _sampled_iteration(self, active, bt):
+        """One bucket-wide sampled decode step: identical forward pass
+        to the greedy step plus the on-device fold + filter +
+        categorical — runs whenever ANY active slot samples (greedy
+        slots ride along on the temperature-0 argmax lane, so the
+        bucket stays ONE compiled program with zero retraces)."""
+        with _trace("serving::sampled_decode_step"):
+            def _timed_decode(tokens, layers, tables, lengths, temps,
+                              tks, tps, keys, counters):
+                out, pools = self._sampled_decode_step(
+                    tokens, layers, tables, lengths, temps, tks, tps,
+                    keys, counters)
+                return np.asarray(out), pools
+
+            toks, new_pools = self._sampled_wd.call(
+                _timed_decode, self._pending[:, None],
+                self._target_pools(), bt, self._lengths, self._temps,
+                self._top_ks, self._top_ps, self._keys, self._counters)
+            self._rebind_target(new_pools)
+        self.metrics.on_decode_iteration(
+            len(active), self.config.max_batch_size,
+            self.pool.utilization())
+        for req in active:
+            slot = req.slot
+            self._lengths[slot] += 1
+            next_tok = int(toks[slot])
+            req.generated.append(next_tok)
+            self._pending[slot] = next_tok
+            self._counters[slot] = len(req.generated)
+            if not self._emit_token(req, next_tok):
+                self._retire(req, "error")
+                continue
+            self._maybe_retire(req)
+
+    def _spec_iteration(self):
+        """One speculative iteration: draft-propose (K tokens, one
+        scanned program over the draft's pool slice) → target-verify
+        ([S, K+1] chunked-shaped program with on-device acceptance) →
+        host commit of each slot's accepted tokens → block-granular KV
+        rollback of the rejected tail.  Only the committed token ids
+        and accepted lengths sync to host — less per-iteration traffic
+        than the greedy step's [S, V] logits."""
+        k_draft = self.spec.num_draft_tokens
+        self._ensure_blocks(horizon=k_draft + 1)
+        active = [r for r in self._slots
+                  if r is not None and r.state == RUNNING]
+        if not active:
+            return
+        bt = self._decode_block_view()
+        with _trace("serving::spec_step"):
+            # draft proposals + distributions stay ON DEVICE between the
+            # two steps; the verify closure's np.asarray is the only
+            # host sync of the iteration
+            def _timed_draft(tokens, layers, tables, lengths, temps,
+                             tks, tps, keys, counters):
+                return self._draft_propose_step(
+                    tokens, layers, tables, lengths, temps, tks, tps,
+                    keys, counters)
+
+            props, dprobs, new_draft = self._draft_propose_wd.call(
+                _timed_draft, self._pending[:, None], self._draft_pools(),
+                bt, self._lengths, self._temps, self._top_ks,
+                self._top_ps, self._keys, self._counters)
+            self._rebind_draft(new_draft)
+
+            def _timed_verify(pending, proposals, probs, layers, tables,
+                              lengths, temps, tks, tps, keys, counters):
+                committed, accepted, pools = self._spec_verify_step(
+                    pending, proposals, probs, layers, tables, lengths,
+                    temps, tks, tps, keys, counters)
+                return np.asarray(committed), np.asarray(accepted), pools
+
+            committed, accepted, new_target = \
+                self._spec_verify_wd.call(
+                    _timed_verify, self._pending, props, dprobs,
+                    self._target_pools(), bt, self._lengths, self._temps,
+                    self._top_ks, self._top_ps, self._keys,
+                    self._counters)
+            self._rebind_target(new_target)
+        self.metrics.on_decode_iteration(
+            len(active), self.config.max_batch_size,
+            self.pool.utilization())
+        accepted_drafts = 0
+        for req in active:
+            slot = req.slot
+            n_new = int(accepted[slot])          # 1..K+1 committed tokens
+            accepted_drafts += n_new - 1
+            self.metrics.on_spec_commit(n_new)
+            taken = 0
+            finished = False
+            for tok in committed[slot, :n_new]:
+                tok = int(tok)
+                req.generated.append(tok)
+                taken += 1
+                if not self._emit_token(req, tok):
+                    self._retire(req, "error")
+                    finished = True
+                    break
+                reason = self.scheduler.finish_reason(req)
+                if reason is not None:
+                    # eos / stop / length may land mid-commit: trailing
+                    # committed tokens are DROPPED, matching where
+                    # sequential generate() stops — zero lost, zero
+                    # duplicated (_retire frees every block)
+                    self._retire(req, reason)
+                    finished = True
+                    break
+            if finished:
+                continue
+            self._lengths[slot] += taken
+            self._pending[slot] = int(committed[slot, taken - 1])
+            self._counters[slot] = len(req.generated)
+            self._rollback_blocks(req)
+        self.metrics.on_spec_step(k_draft * len(active), accepted_drafts)
+
+    def _rollback_blocks(self, req: Request):
+        """Truncate ``req``'s KV back to its accepted frontier: blocks
+        wholly past the next write position were only ever filled with
+        rejected draft KV — free them (refcount drop; they were made
+        writable, hence singly-owned, by ``_ensure_blocks``).  Positions
+        within kept blocks need no scrub: paged attention masks
+        ``k_pos <= q_pos``, so KV past the frontier is never read and
+        the next verify overwrites it."""
+        keep = self.pool.blocks_for(int(self._lengths[req.slot]) + 1)
+        if len(req.blocks) > keep:
+            tail = req.blocks[keep:]
+            del req.blocks[keep:]
+            self.pool.free(tail, req.request_id)
+            self._block_tables[req.slot, keep:] = 0
 
     # ----------------------------------------------------------- retire
     def _maybe_retire(self, req: Request):
@@ -710,7 +1044,10 @@ class Engine:
             self._block_tables[slot] = 0
             self._lengths[slot] = 0
             self._pending[slot] = 0
+            self._clear_sampling_slot(slot)
         self.metrics.on_finish(req.request_id, req.num_generated, reason)
+        if req.on_token is not None:
+            self.metrics.on_stream_end()
         self._finished[req.request_id] = req
 
     # ------------------------------------------------------------ misc
@@ -732,6 +1069,21 @@ class Engine:
         after warmup, for EVERY prompt length (the bucket-explosion
         fix)."""
         return self._prefill_step._cache_size()
+
+    def sampled_decode_cache_size(self) -> int:
+        """Jit-cache entries of the sampled decode step — 0 for a
+        greedy-only workload (the step never runs), 1 after the first
+        sampled iteration, forever (the same no-retrace contract)."""
+        return self._sampled_decode_step._cache_size()
+
+    def spec_cache_sizes(self) -> Dict[str, int]:
+        """Jit-cache entries of the speculative steps (each 1 after
+        warmup) — empty dict when speculation is off."""
+        if self.spec is None:
+            return {}
+        return {"draft_prefill": self._draft_prefill_step._cache_size(),
+                "draft_propose": self._draft_propose_step._cache_size(),
+                "spec_verify": self._spec_verify_step._cache_size()}
 
     def health(self) -> dict:
         """Engine health snapshot (serving/overload.py): state

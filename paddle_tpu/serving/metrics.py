@@ -95,6 +95,12 @@ class ServingMetrics:
         self.step_retries = 0       # watchdog retry attempts
         self.degradation_level = 0  # gauge: current ladder level
         self.health_state = 0       # gauge: 0 serving / 1 degraded / 2 failed
+        # speculative decoding (serving/speculative.py)
+        self.spec_tokens_drafted = 0    # draft proposals verified
+        self.spec_tokens_accepted = 0   # proposals the target accepted
+        # streaming (serving/stream.py): requests with an on_token
+        # callback currently in flight
+        self.stream_active = 0
         # gauge accumulators (sampled once per decode iteration)
         self._occupancy_sum = 0.0
         self._cache_util_sum = 0.0
@@ -255,6 +261,58 @@ class ServingMetrics:
                               "submit-to-finish request latency"
                               ).observe(d["e2e_s"])
 
+    # --------------------------------------------- speculative decoding
+    def on_spec_commit(self, accepted_len: int):
+        """One slot's verify outcome: ``accepted_len`` tokens committed
+        this iteration (accepted drafts + the bonus/correction token,
+        so 1..K+1)."""
+        reg = self._obs()
+        if reg is not None:
+            reg.histogram("serving_accepted_per_step",
+                          "tokens committed per request per speculative "
+                          "verify step (accepted drafts + bonus)",
+                          buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16)
+                          ).observe(accepted_len)
+
+    def on_spec_step(self, drafted: int, accepted: int):
+        """One speculative iteration over the bucket: ``drafted`` draft
+        proposals verified, ``accepted`` of them kept.  The accept-rate
+        gauge is cumulative — the bench's headline speculation signal."""
+        self.spec_tokens_drafted += drafted
+        self.spec_tokens_accepted += accepted
+        reg = self._obs()
+        if reg is not None:
+            reg.counter("serving_spec_tokens_drafted_total",
+                        "draft-model proposals verified by the target"
+                        ).inc(drafted)
+            reg.counter("serving_spec_tokens_accepted_total",
+                        "draft proposals accepted by the target"
+                        ).inc(accepted)
+            reg.gauge("serving_spec_accept_rate",
+                      "accepted / drafted speculative tokens, "
+                      "cumulative").set(self.spec_accept_rate())
+
+    def spec_accept_rate(self) -> float:
+        return self.spec_tokens_accepted \
+            / max(self.spec_tokens_drafted, 1)
+
+    # -------------------------------------------------------- streaming
+    def on_stream_start(self):
+        self.stream_active += 1
+        reg = self._obs()
+        if reg is not None:
+            reg.gauge("serving_stream_active",
+                      "streaming requests currently in flight").set(
+                          self.stream_active)
+
+    def on_stream_end(self):
+        self.stream_active -= 1
+        reg = self._obs()
+        if reg is not None:
+            reg.gauge("serving_stream_active",
+                      "streaming requests currently in flight").set(
+                          self.stream_active)
+
     # ------------------------------------------------ overload control
     def on_watchdog_stall(self, label: str):
         """One step attempt ran past its watchdog budget."""
@@ -347,10 +405,14 @@ class ServingMetrics:
                 "goodput_tokens": self.goodput_tokens,
                 "watchdog_stalls": self.watchdog_stalls,
                 "step_retries": self.step_retries,
+                "spec_tokens_drafted": self.spec_tokens_drafted,
+                "spec_tokens_accepted": self.spec_tokens_accepted,
             },
             "gauges": {
                 "degradation_level": self.degradation_level,
                 "health_state": self.health_state,
+                "spec_accept_rate": round(self.spec_accept_rate(), 4),
+                "stream_active": self.stream_active,
                 "batch_occupancy": self.last_batch_occupancy,
                 "batch_occupancy_avg": round(self._occupancy_sum / n, 4),
                 "cache_utilization": self.last_cache_utilization,

@@ -339,6 +339,22 @@ class OverloadController:
             floor_s=config.watchdog_floor_s,
             max_retries=config.step_max_retries,
             backoff_s=config.step_retry_backoff_s)
+        self._tag = tag
+
+    def extra_watchdog(self, kind: str) -> StepWatchdog:
+        """A watchdog for an ADDITIONAL compiled step entry point (the
+        speculative draft/verify steps) with its OWN LatencyEWMA.
+        Sharing one EWMA across two programs would record the second
+        program's first-call compile as a real latency sample — the
+        exact poisoning the per-EWMA ``compile_s`` carve-out exists to
+        prevent — inflating the watchdog budget and the TTFT estimate
+        (over-shedding) for the engine's whole lifetime."""
+        return StepWatchdog(
+            f"serving::{kind}{self._tag}", LatencyEWMA(), self.health,
+            self.metrics, budget_mult=self.config.watchdog_budget_mult,
+            floor_s=self.config.watchdog_floor_s,
+            max_retries=self.config.step_max_retries,
+            backoff_s=self.config.step_retry_backoff_s)
 
     # ------------------------------------------------------ load shedding
     def can_estimate(self) -> bool:

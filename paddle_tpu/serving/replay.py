@@ -47,12 +47,20 @@ class Tenant:
     max_new_tokens: int = 8
     deadline_s: Optional[float] = None
     priority: int = 0
+    # sampled-tenant archetype (ISSUE 19): temperature > 0 routes the
+    # tenant's requests through the seeded sampling path; each request
+    # gets a trace-deterministic per-request seed so the same trace
+    # replays the same token streams byte for byte
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
 
 
 def default_tenants() -> List[Tenant]:
-    """The stock three-tenant mix (module docstring): a chatty tenant
-    with a big shared system prompt, a long-prompt tenant, and a burst
-    tenant that clumps its arrivals."""
+    """The stock four-tenant mix (module docstring): a chatty tenant
+    with a big shared system prompt, a long-prompt tenant, a burst
+    tenant that clumps its arrivals, and a sampled tenant exercising
+    the seeded temperature/top-k/top-p decode path."""
     return [
         Tenant("chat", kind="chat", requests=10,
                shared_prefix_tokens=48, tail_tokens=(4, 12),
@@ -63,6 +71,9 @@ def default_tenants() -> List[Tenant]:
         Tenant("burst", kind="burst", requests=8,
                shared_prefix_tokens=24, tail_tokens=(2, 8),
                max_new_tokens=4),
+        Tenant("sampled", kind="chat", requests=4,
+               shared_prefix_tokens=32, tail_tokens=(4, 10),
+               max_new_tokens=6, temperature=0.8, top_k=16, top_p=0.95),
     ]
 
 
@@ -77,6 +88,11 @@ class Arrival:
     deadline_s: Optional[float]
     priority: int
     request_id: str = ""
+    # seeded sampling (0.0 temperature = greedy, seed ignored)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
 
 
 def build_trace(tenants: Optional[Sequence[Tenant]] = None, *,
@@ -111,7 +127,12 @@ def build_trace(tenants: Optional[Sequence[Tenant]] = None, *,
                 prompt=np.concatenate([shared, tail]),
                 max_new_tokens=t.max_new_tokens,
                 deadline_s=t.deadline_s, priority=t.priority,
-                request_id=f"{t.name}-{i}"))
+                request_id=f"{t.name}-{i}",
+                temperature=t.temperature, top_k=t.top_k, top_p=t.top_p,
+                # per-request seed drawn from the trace rng: sampled
+                # outputs are as reproducible as the schedule itself
+                seed=(int(rng.randint(0, 2**31 - 1))
+                      if t.temperature > 0 else None)))
     # stable order: by arrival step, tenant name, then index — NOT by
     # rng state, so the submit order is reproducible and readable
     arrivals.sort(key=lambda a: (a.step, a.tenant, a.request_id))
@@ -160,7 +181,11 @@ def replay_trace(router: Router, trace: Sequence[Arrival]) -> dict:
                               max_new_tokens=a.max_new_tokens,
                               deadline_s=a.deadline_s,
                               priority=a.priority,
-                              request_id=a.request_id)
+                              request_id=a.request_id,
+                              temperature=a.temperature,
+                              do_sample=a.temperature > 0,
+                              top_k=a.top_k, top_p=a.top_p,
+                              seed=a.seed)
             except AdmissionError:
                 # bounded-queue backpressure is a legitimate outcome of
                 # an overload trace — tally it, don't crash the replay

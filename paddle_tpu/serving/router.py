@@ -373,6 +373,9 @@ class Router:
                eos_token_id: Optional[int] = None, stop_sequences=None,
                tokenizer=None, request_id: Optional[str] = None,
                temperature: float = 0.0, do_sample: bool = False,
+               top_k: int = 0, top_p: float = 1.0,
+               seed: Optional[int] = None, sampling=None,
+               on_token=None, token_deadline_s: Optional[float] = None,
                deadline_s: Optional[float] = None, priority: int = 0
                ) -> Request:
         """Place one request on the best replica (``Engine.submit``
@@ -396,6 +399,9 @@ class Router:
                       eos_token_id=eos_token_id,
                       stop_sequences=stop_sequences, tokenizer=tokenizer,
                       temperature=temperature, do_sample=do_sample,
+                      top_k=top_k, top_p=top_p, seed=seed,
+                      sampling=sampling, on_token=on_token,
+                      token_deadline_s=token_deadline_s,
                       priority=priority)
         self.metrics.on_submit()
         # ---- global admission control: shed at the FLEET boundary

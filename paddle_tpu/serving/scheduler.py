@@ -75,6 +75,21 @@ class Request:
     # (youngest within a class).  All-default workloads reduce exactly
     # to the FCFS + fairness policy above.
     priority: int = 0
+    # sampling spec (serving/sampling.SamplingParams) or None for
+    # greedy; sampling_key is the request's base PRNG key ([2] uint32),
+    # fixed at submit so preemption + recompute replays the exact token
+    # stream (keys are derived from TOKEN INDEX, not step count)
+    sampling: Optional[object] = None
+    sampling_key: Optional[np.ndarray] = field(default=None, repr=False)
+    # streaming (serving/stream.py): on_token fires once per ACCEPTED
+    # token; token_deadline_s is a ROLLING inter-token SLO — the
+    # monotonic token_deadline_t resets on every emitted token, and a
+    # stream that stalls past it times out like a busted deadline_s
+    # (it also bounds time-to-first-token, so the load shedder treats
+    # it as an effective TTFT deadline)
+    on_token: Optional[object] = field(default=None, repr=False)
+    token_deadline_s: Optional[float] = None
+    token_deadline_t: Optional[float] = field(default=None, repr=False)
     # runtime (engine-owned)
     ordinal: int = field(default_factory=lambda: next(_ordinal))
     state: str = QUEUED
@@ -105,11 +120,19 @@ class Request:
             if self.deadline_s < 0:
                 raise ValueError("deadline_s must be >= 0")
             self.deadline_t = time.monotonic() + self.deadline_s
+        if self.token_deadline_s is not None:
+            if self.token_deadline_s < 0:
+                raise ValueError("token_deadline_s must be >= 0")
+            self.token_deadline_t = time.monotonic() + self.token_deadline_s
 
     def expired(self) -> bool:
-        """Past the per-request deadline (monotonic clock)."""
-        return self.deadline_t is not None \
-            and time.monotonic() >= self.deadline_t
+        """Past the per-request deadline or the rolling inter-token
+        deadline (both on the monotonic clock)."""
+        if self.deadline_t is not None \
+                and time.monotonic() >= self.deadline_t:
+            return True
+        return self.token_deadline_t is not None \
+            and time.monotonic() >= self.token_deadline_t
 
     @property
     def prompt_len(self) -> int:

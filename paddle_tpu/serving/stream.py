@@ -1,0 +1,65 @@
+"""Server-sent-events framing over the serving engine — the thin
+streaming front door (ISSUE 19c).  No external deps: an SSE response is
+just an iterator of ``data: <json>\\n\\n`` frames, which is exactly what
+this module yields, so any WSGI/ASGI shim (or a test) can drain it.
+
+Token delivery rides the engine's ``on_token`` callback
+(``Engine.submit(on_token=...)`` fires once per ACCEPTED token — under
+speculative decoding a single engine iteration may fire several times),
+and per-token deadlines (``token_deadline_s``) thread into the existing
+shed/priority machinery: a stream that stalls past its inter-token
+deadline times out and degrades instead of queueing forever.
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Iterator
+
+DONE_FRAME = "data: [DONE]\n\n"
+
+
+def sse_event(payload) -> str:
+    """One SSE frame: ``data: <compact json>`` + blank line."""
+    return f"data: {json.dumps(payload, separators=(',', ':'))}\n\n"
+
+
+def stream_events(target, prompt, **submit_kwargs) -> Iterator[dict]:
+    """Submit ``prompt`` and yield one dict per generated token
+    (``{"token": id, "index": i}``) while driving the engine, then a
+    final ``{"finish_reason": ..., "num_tokens": ..., "request_id":
+    ...}`` summary event.
+
+    ``target`` is anything engine-shaped: an :class:`Engine`, a
+    :class:`~paddle_tpu.serving.router.Router`, or an
+    :class:`~paddle_tpu.serving.endpoint.Endpoint`.  Other requests
+    already in flight keep making progress — the drive loop is the
+    ordinary ``step()``/``poll()`` tick, streaming just drains this
+    request's tokens as they land."""
+    tick = getattr(target, "poll", None) or target.step
+    buf: deque = deque()
+    req = target.submit(prompt, on_token=buf.append, **submit_kwargs)
+    index = 0
+    from .scheduler import FINISHED
+
+    while True:
+        while buf:
+            yield {"token": int(buf.popleft()), "index": index}
+            index += 1
+        if req.state == FINISHED:
+            break
+        if not tick() and not buf and req.state != FINISHED:
+            break           # engine drained without finishing (shed)
+    while buf:
+        yield {"token": int(buf.popleft()), "index": index}
+        index += 1
+    yield {"finish_reason": req.finish_reason, "num_tokens": index,
+           "request_id": req.request_id}
+
+
+def sse_stream(target, prompt, **submit_kwargs) -> Iterator[str]:
+    """:func:`stream_events` framed as SSE ``data:`` lines, terminated
+    by the OpenAI-style ``data: [DONE]`` sentinel."""
+    for event in stream_events(target, prompt, **submit_kwargs):
+        yield sse_event(event)
+    yield DONE_FRAME

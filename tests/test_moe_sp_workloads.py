@@ -352,8 +352,9 @@ class TestDanglingAxesWarning:
 # ---------------------------------------------------------------------------
 
 class TestFiveStepAudit:
-    def test_default_steps_cover_all_five(self):
+    def test_default_steps_cover_all_kinds(self):
         assert DEFAULT_AUDIT_STEPS == ("train", "decode", "prefill",
+                                       "sampled_decode", "spec_verify",
                                        "moe", "ring")
 
     @pytest.mark.slow

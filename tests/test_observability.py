@@ -394,12 +394,13 @@ class TestServingMirror:
         "decode_iterations", "prefills",
         "prefix_cache_hits", "prefix_cache_misses",
         "prefix_cache_evictions", "prefill_chunks",
-        "watchdog_stalls", "step_retries"}
+        "watchdog_stalls", "step_retries",
+        "spec_tokens_drafted", "spec_tokens_accepted"}
     _CONTRACT_GAUGES = {
         "batch_occupancy", "batch_occupancy_avg",
         "cache_utilization", "cache_utilization_avg",
         "prefix_cached_token_ratio", "degradation_level",
-        "health_state"}
+        "health_state", "spec_accept_rate", "stream_active"}
 
     def _run_workload(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

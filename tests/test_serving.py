@@ -185,17 +185,26 @@ class TestAdmissionControl:
             eng.submit(_prompts([4])[0],
                        max_new_tokens=eng.max_model_len)
 
-    def test_sampling_rejected_greedy_accepted(self, model):
-        # generate() call-site parity: temperature=0.0 (greedy) is fine,
-        # a sampling request fails loudly instead of decoding differently
+    def test_sampling_routed_through_sampling_params(self, model):
+        # generate() call-site parity: temperature/do_sample/top_k/top_p
+        # route into SamplingParams (ISSUE 19) instead of being rejected;
+        # invalid knobs still fail loudly AT SUBMIT, not mid-decode
         eng = Engine(model, _config())
-        eng.submit(_prompts([3])[0], max_new_tokens=2, temperature=0.0)
-        with pytest.raises(ValueError, match="greedily"):
+        greedy = eng.submit(_prompts([3])[0], max_new_tokens=2,
+                            temperature=0.0)
+        assert greedy.sampling is None      # greedy stays off-path
+        hot = eng.submit(_prompts([3])[0], max_new_tokens=2,
+                         temperature=0.7, top_k=8, seed=1)
+        assert hot.sampling.temperature == 0.7 and hot.sampling.top_k == 8
+        ds = eng.submit(_prompts([3])[0], max_new_tokens=2,
+                        do_sample=True)
+        assert ds.sampling.temperature == 1.0   # reference default
+        with pytest.raises(ValueError, match="top_p"):
             eng.submit(_prompts([3])[0], max_new_tokens=2,
-                       temperature=0.7)
-        with pytest.raises(ValueError, match="greedily"):
+                       do_sample=True, top_p=0.0)
+        with pytest.raises(ValueError, match="temperature"):
             eng.submit(_prompts([3])[0], max_new_tokens=2,
-                       do_sample=True)
+                       sampling={"temperature": -1.0})
         eng.run_until_complete()
 
     def test_fcfs_completion_order(self, model):

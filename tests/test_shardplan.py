@@ -321,10 +321,11 @@ class TestAuditShardplan:
     def reports(self):
         return audit_shardplan()
 
-    def test_covers_all_five_step_kinds(self, reports):
+    def test_covers_all_default_step_kinds(self, reports):
         assert [r.name for r in reports] == [
             "hapi::train_step", "serving::decode_step",
-            "serving::prefill_step", "moe::block_step",
+            "serving::prefill_step", "serving::sampled_decode_step",
+            "serving::spec_verify_step", "moe::block_step",
             "ring::sp_step"]
 
     def test_clean_layout_has_no_unplanned_or_errors(self, reports):

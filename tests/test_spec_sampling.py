@@ -1,0 +1,527 @@
+"""ISSUE 19 — sampled + speculative decoding with SSE streaming.
+
+The done bars under test: per-request-seeded sampling is deterministic
+and slot/batch-independent (same seed -> same token stream, bitwise,
+engine == generate()); speculative decoding is token-EXACT with greedy
+generate() across accept/reject boundaries, eos and preemption; KV
+rollback after rejected drafts leaks nothing; every new compiled step
+holds one jit-cache entry forever (H106 stays enforceable on them); and
+the streaming callback delivers exactly the committed tokens in order.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.serving import (Engine, SamplingParams, ServingConfig,
+                                SpeculativeConfig)
+from paddle_tpu.serving.sampling import resolve_sampling
+from paddle_tpu.serving.speculative import _spec_acceptance
+from paddle_tpu.serving.stream import sse_event, stream_events
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    """Weight-divergent draft: same cache geometry + vocab, one layer,
+    different seed — greedy proposals rarely match the target, so the
+    REJECT/correction path runs on nearly every verify step."""
+    import dataclasses
+
+    paddle.seed(123)
+    d = LlamaForCausalLM(dataclasses.replace(LlamaConfig.tiny(),
+                                             num_hidden_layers=1))
+    d.eval()
+    return d
+
+
+def _prompts(lengths, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=(L,)).astype(np.int32)
+            for L in lengths]
+
+
+def _config(**kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_queue_len", 16)
+    return ServingConfig(**kw)
+
+
+def _spec_config(draft_model, k=3, **kw):
+    kw.setdefault("speculative",
+                  SpeculativeConfig(draft_model=draft_model,
+                                    num_draft_tokens=k))
+    return _config(**kw)
+
+
+def _greedy_ref(model, prompt, **kw):
+    out = generate(model, paddle.to_tensor(prompt[None, :]),
+                   temperature=0.0, use_static_cache=True, **kw)
+    return np.asarray(out.numpy())[0]
+
+
+# ---------------------------------------------------------------------------
+# seeded sampling: determinism + generate() parity
+# ---------------------------------------------------------------------------
+
+class TestSampledDeterminism:
+    SAMPLED = dict(temperature=0.8, top_k=12, top_p=0.9)
+
+    def test_same_seed_same_stream_bitwise(self, model):
+        p = _prompts([5])[0]
+        outs = [Engine(model, _config()).generate(
+                    [p], max_new_tokens=8, do_sample=True, seed=7,
+                    **self.SAMPLED)[0]
+                for _ in range(2)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_different_seeds_diverge(self, model):
+        p = _prompts([5])[0]
+        eng = Engine(model, _config())
+        a = eng.generate([p], max_new_tokens=8, do_sample=True, seed=7,
+                         **self.SAMPLED)[0]
+        b = eng.generate([p], max_new_tokens=8, do_sample=True, seed=8,
+                         **self.SAMPLED)[0]
+        assert not np.array_equal(a, b)
+
+    def test_batched_equals_solo(self, model):
+        """A request's stream depends only on its seed + token index —
+        not on slot placement or who shares the bucket."""
+        prompts = _prompts([3, 7, 5, 9])
+        seeds = [11, 12, 13, 14]
+        solo = [Engine(model, _config()).generate(
+                    [p], max_new_tokens=6, do_sample=True, seed=s,
+                    **self.SAMPLED)[0]
+                for p, s in zip(prompts, seeds)]
+        eng = Engine(model, _config())
+        reqs = [eng.submit(p, max_new_tokens=6, do_sample=True, seed=s,
+                           **self.SAMPLED)
+                for p, s in zip(prompts, seeds)]
+        eng.run_until_complete()
+        for req, ref in zip(reqs, solo):
+            np.testing.assert_array_equal(req.output_ids(), ref)
+
+    def test_engine_matches_generate_sampled(self, model):
+        """The sampled parity oracle: generate() and the engine share
+        the fold(base, token_index) key schedule and the jitted
+        sample_at program, so same seed -> token-exact, including with
+        top-k and top-p filters engaged."""
+        for kw in (dict(temperature=0.7),
+                   dict(temperature=0.9, top_k=8),
+                   dict(temperature=1.1, top_p=0.8),
+                   dict(temperature=0.8, top_k=12, top_p=0.9)):
+            p = _prompts([6])[0]
+            ref = generate(model, paddle.to_tensor(p[None, :]),
+                           max_new_tokens=8, do_sample=True, seed=21,
+                           use_static_cache=True, **kw)
+            ref = np.asarray(ref.numpy())[0]
+            out = Engine(model, _config()).generate(
+                [p], max_new_tokens=8, do_sample=True, seed=21, **kw)[0]
+            np.testing.assert_array_equal(out, ref), kw
+
+    def test_mixed_bucket_keeps_greedy_bit_identical(self, model):
+        """Greedy requests sharing an engine with sampled ones stay on
+        the plain decode step, bit-identical to a pure-greedy run."""
+        pg, ps = _prompts([5, 6], seed=2)
+        ref = _greedy_ref(model, pg, max_new_tokens=8)
+        eng = Engine(model, _config())
+        rg = eng.submit(pg, max_new_tokens=8)
+        eng.submit(ps, max_new_tokens=8, do_sample=True, seed=3,
+                   **self.SAMPLED)
+        eng.run_until_complete()
+        np.testing.assert_array_equal(rg.output_ids(), ref)
+
+    def test_sampled_step_compiles_once_and_only_when_used(self):
+        # fresh model: the compiled steps cache on the model object, so
+        # module-fixture engines would already hold entries
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        m.eval()
+        eng = Engine(m, _config())
+        eng.generate(_prompts([3, 5]), max_new_tokens=4)
+        assert eng.sampled_decode_cache_size() == 0   # greedy-only
+        eng.generate(_prompts([4, 6], seed=1), max_new_tokens=6,
+                     do_sample=True, seed=5, **self.SAMPLED)
+        assert eng.sampled_decode_cache_size() == 1
+        eng.generate(_prompts([9, 2], seed=2), max_new_tokens=5,
+                     do_sample=True, seed=6, temperature=1.3)
+        assert eng.sampled_decode_cache_size() == 1   # no retrace
+        assert eng._sampled_decode_step.retraces == 0
+
+    def test_resolve_sampling_front_door(self):
+        assert resolve_sampling() is None
+        assert resolve_sampling(temperature=0.0) is None
+        assert resolve_sampling(
+            sampling=SamplingParams(temperature=0.0)) is None
+        assert resolve_sampling(do_sample=True).temperature == 1.0
+        sp = resolve_sampling(sampling={"temperature": 0.5, "top_k": 4})
+        assert (sp.temperature, sp.top_k) == (0.5, 4)
+        with pytest.raises(TypeError, match="SamplingParams"):
+            resolve_sampling(sampling=0.7)
+
+
+# ---------------------------------------------------------------------------
+# acceptance rule: crafted-logits unit tests (partial-accept boundaries)
+# ---------------------------------------------------------------------------
+
+def _acc(lg, proposals, draft_probs, temps, seed=0):
+    import jax
+
+    s, k = np.shape(proposals)
+    keys = np.broadcast_to(
+        np.asarray(jax.random.PRNGKey(seed), np.uint32), (s, 2))
+    committed, accepted = _spec_acceptance(
+        jnp.asarray(lg, jnp.float32), jnp.asarray(proposals, jnp.int32),
+        jnp.asarray(draft_probs, jnp.float32),
+        jnp.asarray(temps, jnp.float32), jnp.zeros((s,), jnp.int32),
+        jnp.ones((s,), jnp.float32), jnp.asarray(keys),
+        jnp.zeros((s,), jnp.int32))
+    return np.asarray(committed), np.asarray(accepted)
+
+
+def _peaked_logits(argmaxes, v=8, hi=9.0):
+    """[K+1, V] logits whose per-position argmax is prescribed."""
+    lg = np.zeros((len(argmaxes), v), np.float32)
+    for i, a in enumerate(argmaxes):
+        lg[i, a] = hi
+    return lg
+
+
+class TestAcceptanceRule:
+    def test_greedy_boundaries_zero_partial_full(self):
+        # target argmaxes at positions 0..3; K=3 proposals per row
+        lg = np.stack([_peaked_logits([2, 5, 7, 6])] * 3)
+        proposals = np.array([[4, 5, 7],      # reject at 0
+                              [2, 5, 1],      # accept 2, reject at 2
+                              [2, 5, 7]])     # full accept
+        dp = np.full((3, 3, 8), 1 / 8, np.float32)
+        committed, accepted = _acc(lg, proposals, dp,
+                                   temps=np.zeros(3, np.float32))
+        assert accepted.tolist() == [1, 3, 4]
+        # the correction/bonus token is the target argmax at the first
+        # mismatch (or position K on full accept); the tail is padding
+        assert committed[0].tolist() == [2, 0, 0, 0]
+        assert committed[1].tolist() == [2, 5, 7, 0]
+        assert committed[2].tolist() == [2, 5, 7, 6]
+
+    def test_greedy_commit_is_greedy_continuation(self):
+        """Whatever the draft proposed, committed[:accepted] is a prefix
+        of the target's own greedy continuation — the invariant that
+        makes speculative greedy token-exact with generate()."""
+        rng = np.random.RandomState(3)
+        for _ in range(10):
+            arg = rng.randint(0, 8, size=4)
+            lg = _peaked_logits(arg)[None]
+            props = rng.randint(0, 8, size=(1, 3))
+            dp = np.full((1, 3, 8), 1 / 8, np.float32)
+            committed, accepted = _acc(lg, props, dp, np.zeros(1))
+            n = int(accepted[0])
+            assert committed[0, :n].tolist() == arg[:n].tolist()
+
+    def test_stochastic_identical_dists_accept_all(self):
+        """p == q makes the acceptance test u*q < p always true: a draft
+        sampling the target's own distribution never rejects (the
+        self-draft ceiling)."""
+        lg = np.stack([_peaked_logits([1, 2, 3, 4], hi=2.0)] * 2)
+        # draft probs = target filtered probs (temperature 1, no filter)
+        from paddle_tpu.serving.sampling import filtered_probs
+
+        t = np.ones(2, np.float32)
+        tp = np.asarray(filtered_probs(
+            jnp.asarray(lg.reshape(8, 8)), jnp.ones(8),
+            jnp.zeros(8, jnp.int32), jnp.ones(8))).reshape(2, 4, 8)
+        committed, accepted = _acc(lg, np.array([[1, 2, 3]] * 2),
+                                   tp[:, :3], t)
+        assert accepted.tolist() == [4, 4]
+
+    def test_stochastic_impossible_proposal_rejects_with_residual(self):
+        """q concentrated where p = 0: always rejected, and the bonus
+        resamples from the residual max(p - q, 0) — which here is p
+        itself, so the bonus never lands on the draft's token."""
+        lg = np.zeros((1, 4, 8), np.float32)
+        lg[:, :, 2] = 9.0                      # target mass on token 2
+        dp = np.zeros((1, 3, 8), np.float32)
+        dp[:, :, 5] = 1.0                      # draft proposes 5 surely
+        committed, accepted = _acc(lg, np.full((1, 3), 5), dp,
+                                   np.ones(1, np.float32))
+        assert accepted.tolist() == [1]
+        assert committed[0, 0] == 2
+
+
+# ---------------------------------------------------------------------------
+# speculative engine: parity across accept/reject, eos, preemption
+# ---------------------------------------------------------------------------
+
+class TestSpeculativeParity:
+    def test_greedy_parity_random_draft(self, model, draft):
+        """The accept/reject-boundary bar: a weight-divergent draft
+        rejects constantly, yet greedy output is token-exact with
+        generate() AND with the non-speculative engine."""
+        prompts = _prompts([3, 7, 5, 11, 4, 6])
+        refs = [_greedy_ref(model, p, max_new_tokens=9) for p in prompts]
+        plain = Engine(model, _config()).generate(prompts,
+                                                  max_new_tokens=9)
+        eng = Engine(model, _spec_config(draft))
+        outs = eng.generate(prompts, max_new_tokens=9)
+        for out, ref, pl in zip(outs, refs, plain):
+            np.testing.assert_array_equal(out, ref)
+            np.testing.assert_array_equal(out, pl)
+        c = eng.stats()["counters"]
+        assert c["spec_tokens_drafted"] > 0
+        assert c["spec_tokens_accepted"] < c["spec_tokens_drafted"]
+
+    def test_self_draft_hits_accept_ceiling(self, model):
+        """Weight-identical draft: every greedy proposal matches the
+        target argmax — accept rate exactly 1.0.  This is the test that
+        caught the draft-KV hole at position lengths+K (a draft cache
+        missing d_K's KV mis-proposes right after every full accept)."""
+        prompts = _prompts([3, 6, 9])
+        refs = [_greedy_ref(model, p, max_new_tokens=10) for p in prompts]
+        eng = Engine(model, _spec_config(model, k=4))
+        outs = eng.generate(prompts, max_new_tokens=10)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.metrics.spec_accept_rate() == 1.0
+
+    def test_eos_under_speculation(self, model, draft):
+        p = _prompts([5])[0]
+        ref = _greedy_ref(model, p, max_new_tokens=8)
+        eos = int(ref[5 + 2])
+        ref_eos = _greedy_ref(model, p, max_new_tokens=8,
+                              eos_token_id=eos)
+        eng = Engine(model, _spec_config(draft))
+        req = eng.submit(p, max_new_tokens=8, eos_token_id=eos)
+        eng.run_until_complete()
+        assert req.finish_reason == "eos"
+        np.testing.assert_array_equal(req.output_ids(), ref_eos)
+
+    def test_preemption_keeps_parity(self, model, draft):
+        """Tight pool: a request is evicted mid-decode and recomputed
+        — the position-indexed key schedule and greedy acceptance make
+        the replay land on the identical token stream."""
+        prompts = _prompts([4, 4], seed=7)
+        refs = [_greedy_ref(model, p, max_new_tokens=10) for p in prompts]
+        eng = Engine(model, _spec_config(
+            draft, max_batch_size=2, num_blocks=8))
+        reqs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        eng.run_until_complete()
+        for req, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(req.output_ids(), ref)
+        assert eng.stats()["counters"]["preemptions"] >= 1
+        eng.pool.check_leaks()
+
+    def test_rejected_drafts_leak_no_blocks(self, model, draft):
+        eng = Engine(model, _spec_config(draft))
+        eng.generate(_prompts([3, 7, 5, 11, 4]), max_new_tokens=7)
+        eng.pool.check_leaks()
+        assert eng.pool.num_free == eng.pool.capacity_blocks
+
+    def test_zero_retraces_after_warmup(self, model, draft):
+        eng = Engine(model, _spec_config(draft))
+        eng.generate(_prompts([3, 5]), max_new_tokens=5)
+        # snapshot AFTER warmup: the shared-on-the-model steps may hold
+        # entries from other engine configs in this module, but request
+        # churn through THIS engine must add none
+        warm = eng.spec_cache_sizes()
+        assert set(warm) == {"draft_prefill", "draft_propose",
+                             "spec_verify"}
+        assert all(v >= 1 for v in warm.values())
+        eng.generate(_prompts([9, 2, 7], seed=3), max_new_tokens=8)
+        assert eng.spec_cache_sizes() == warm
+        for step in (eng._draft_prefill_step, eng._draft_propose_step,
+                     eng._spec_verify_step):
+            assert step.retraces == 0
+
+    def test_sampled_speculation_is_seed_deterministic(self, model,
+                                                       draft):
+        """Sampled + speculative composes: rejection sampling preserves
+        the target distribution (not checked here) and the whole stack
+        stays replayable — same seed, same committed stream."""
+        p = _prompts([5])[0]
+        outs = [Engine(model, _spec_config(draft)).generate(
+                    [p], max_new_tokens=8, do_sample=True,
+                    temperature=0.8, top_k=16, seed=9)[0]
+                for _ in range(2)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_mismatched_draft_rejected_at_config(self, model):
+        import dataclasses
+
+        paddle.seed(9)
+        bad = LlamaForCausalLM(dataclasses.replace(
+            LlamaConfig.tiny(), num_key_value_heads=1,
+            num_attention_heads=1))
+        bad.eval()
+        with pytest.raises(ValueError, match="cache layout"):
+            Engine(model, _spec_config(bad))
+
+
+# ---------------------------------------------------------------------------
+# streaming: callback ordering + SSE framing
+# ---------------------------------------------------------------------------
+
+class TestStreaming:
+    def test_on_token_callback_order_matches_output(self, model):
+        p = _prompts([5])[0]
+        got = []
+        eng = Engine(model, _config())
+        req = eng.submit(p, max_new_tokens=8, on_token=got.append)
+        eng.run_until_complete()
+        assert got == req.generated
+        assert got == req.output_ids()[5:].tolist()
+
+    def test_on_token_fires_per_accepted_token_under_spec(self, model,
+                                                          draft):
+        """Speculation commits several tokens per engine iteration; the
+        callback still fires once per token, in commit order."""
+        p = _prompts([5])[0]
+        got = []
+        eng = Engine(model, _spec_config(draft))
+        req = eng.submit(p, max_new_tokens=9, on_token=got.append)
+        eng.run_until_complete()
+        assert got == req.generated
+        np.testing.assert_array_equal(
+            req.output_ids(), _greedy_ref(model, p, max_new_tokens=9))
+
+    def test_stream_events_order_and_summary(self, model):
+        p = _prompts([4])[0]
+        eng = Engine(model, _config())
+        events = list(stream_events(eng, p, max_new_tokens=6))
+        toks = [e["token"] for e in events[:-1]]
+        assert [e["index"] for e in events[:-1]] == list(range(6))
+        assert events[-1]["finish_reason"] == "length"
+        assert events[-1]["num_tokens"] == 6
+        ref = _greedy_ref(model, p, max_new_tokens=6)
+        assert toks == ref[4:].tolist()
+
+    def test_sse_frames_round_trip(self, model):
+        p = _prompts([4])[0]
+        eng = Engine(model, _config())
+        from paddle_tpu.serving import sse_stream
+
+        frames = list(sse_stream(eng, p, max_new_tokens=4))
+        assert frames[-1] == "data: [DONE]\n\n"
+        for f in frames[:-1]:
+            assert f.startswith("data: ") and f.endswith("\n\n")
+            json.loads(f[len("data: "):])
+        assert sse_event({"a": 1}) == 'data: {"a":1}\n\n'
+
+    def test_stream_active_gauge_tracks_lifecycle(self, model):
+        eng = Engine(model, _config())
+        req = eng.submit(_prompts([3])[0], max_new_tokens=3,
+                         on_token=lambda t: None)
+        assert eng.stats()["gauges"]["stream_active"] == 1
+        eng.run_until_complete()
+        assert req.finish_reason == "length"
+        assert eng.stats()["gauges"]["stream_active"] == 0
+
+    def test_poisonous_callback_retires_only_that_request(self, model):
+        eng = Engine(model, _config())
+        bad = eng.submit(_prompts([3])[0], max_new_tokens=4,
+                         on_token=lambda t: 1 / 0)
+        good = eng.submit(_prompts([5])[0], max_new_tokens=4)
+        eng.run_until_complete()
+        assert bad.finish_reason == "error"
+        assert "on_token" in bad.error
+        assert good.finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# hazards + audits: the new step kinds stay analyzable
+# ---------------------------------------------------------------------------
+
+class TestSpecHazards:
+    def test_new_builtin_steps_scan_clean(self, model, draft):
+        from paddle_tpu.serving.sampling import make_sampled_decode_step
+        from paddle_tpu.serving.speculative import (make_draft_propose_step,
+                                                    make_spec_verify_step)
+
+        for step in (make_sampled_decode_step(model),
+                     make_draft_propose_step(draft, 3),
+                     make_spec_verify_step(model, 3)):
+            assert analysis.scan_decode_step(step) == []
+
+    def test_host_sync_in_acceptance_loop_is_h106_error(self):
+        import functools
+
+        from paddle_tpu.models.generation import register_decode_step
+
+        @functools.partial(register_decode_step, kind="spec_verify")
+        def bad_verify(pending, proposals, lengths):
+            n = lengths.item()       # host sync per verify step
+            return proposals[:, :n]
+
+        diags = analysis.scan_decode_step(bad_verify)
+        assert ("H106", "error") in {(d.code, d.severity) for d in diags}
+
+    def test_step_kinds_registered(self, model, draft):
+        from paddle_tpu.models.generation import \
+            registered_decode_step_entries
+        from paddle_tpu.serving.sampling import make_sampled_decode_step
+        from paddle_tpu.serving.speculative import (make_draft_propose_step,
+                                                    make_spec_verify_step)
+
+        make_sampled_decode_step(model)
+        make_draft_propose_step(draft, 3)
+        make_spec_verify_step(model, 3)
+        kinds = {kind for _fn, kind in registered_decode_step_entries()}
+        assert {"sampled_decode", "draft_propose",
+                "spec_verify"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# replay: the sampled-tenant archetype is trace-deterministic
+# ---------------------------------------------------------------------------
+
+class TestSampledTenantReplay:
+    def test_default_mix_includes_sampled_tenant_with_seeds(self):
+        from paddle_tpu.serving.replay import build_trace, default_tenants
+
+        assert any(t.temperature > 0 for t in default_tenants())
+        trace = build_trace(seed=31, horizon=10)
+        sampled = [a for a in trace if a.tenant == "sampled"]
+        assert sampled and all(a.seed is not None and a.temperature > 0
+                               for a in sampled)
+        greedy = [a for a in trace if a.tenant != "sampled"]
+        assert all(a.seed is None for a in greedy)
+        # seeds are part of the trace: same seed, same per-request seeds
+        again = build_trace(seed=31, horizon=10)
+        assert [a.seed for a in trace] == [a.seed for a in again]
+
+    def test_sampled_arrivals_replay_token_identical(self, model):
+        """The trace's per-request seeds make sampled outputs as
+        reproducible as the schedule: replaying the same arrivals on a
+        fresh engine yields bitwise-identical streams."""
+        from paddle_tpu.serving.replay import Tenant, build_trace
+
+        trace = build_trace([Tenant("sampled", requests=3,
+                                    shared_prefix_tokens=12,
+                                    tail_tokens=(2, 6), max_new_tokens=5,
+                                    temperature=0.9, top_k=16)],
+                            seed=33, horizon=4)
+        runs = []
+        for _ in range(2):
+            eng = Engine(model, _config())
+            reqs = [eng.submit(a.prompt, max_new_tokens=a.max_new_tokens,
+                               temperature=a.temperature, do_sample=True,
+                               top_k=a.top_k, top_p=a.top_p, seed=a.seed)
+                    for a in trace]
+            eng.run_until_complete()
+            runs.append([r.output_ids() for r in reqs])
+        for a, b in zip(*runs):
+            np.testing.assert_array_equal(a, b)

@@ -359,10 +359,10 @@ class TestDcnDiagnostics:
 # ---------------------------------------------------------------------------
 
 class TestMultiHostAudit:
-    def test_all_five_steps_plan_clean(self):
+    def test_all_default_steps_plan_clean(self):
         topo = Topology(hosts=2, chips_per_host=(2, 2))
         reports = audit_shardplan(topology=topo)
-        assert len(reports) == 5
+        assert len(reports) == 7
         for r in reports:
             assert r.errors() == [], (r.name, [str(d) for d in r.errors()])
             assert all(c.planned for c in r.collectives), r.name

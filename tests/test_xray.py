@@ -396,12 +396,14 @@ class TestEngineXray:
 # ---------------------------------------------------------------------------
 
 class TestAuditDefaultSteps:
-    def test_all_five_steps_clean_under_cpu_budget(self):
+    def test_all_default_steps_clean_under_cpu_budget(self):
         reports = xray.audit_default_steps(
             chip="cpu", hbm_budget_bytes=xray.CHIPS["cpu"].hbm_bytes)
-        assert len(reports) == 5
+        assert len(reports) == 7
         names = {r.name for r in reports}
-        assert {"moe::block_step", "ring::sp_step"} <= names
+        assert {"moe::block_step", "ring::sp_step",
+                "serving::sampled_decode_step",
+                "serving::spec_verify_step"} <= names
         for r in reports:
             assert r.flops > 0
             assert r.peak_hbm_bytes < xray.CHIPS["cpu"].hbm_bytes

@@ -93,6 +93,17 @@ python examples/generate_text.py
 python examples/serve_llama.py
 python examples/serve_llama.py --prefix-cache
 
+echo "== speculative decoding + SSE streaming =="
+# draft-propose/target-verify speculation: greedy token parity with
+# generate() AND the non-speculative engine across accept/reject
+# boundaries (random small draft) plus the weight-identical-draft
+# accept-rate ceiling, zero retraces after warmup, zero KV-pool leaks
+# after rejected drafts; then one SSE round-trip over the streaming
+# front door — per-token events in callback order, [DONE]-terminated
+# (README: Sampling, speculative decoding & streaming)
+python examples/serve_llama.py --speculative
+python examples/serve_llama.py --stream
+
 echo "== overload chaos (shed + hung-step recovery) =="
 # seeded burst under an injected sustained slowdown: hopeless requests
 # are shed at admission (zero timeouts), then an injected hung decode

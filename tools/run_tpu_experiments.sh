@@ -129,6 +129,7 @@ run_queue() {
   run only_serve     BENCH_ONLY=serve_llama || return 1
   run only_prefix    BENCH_ONLY=prefix_cache || return 1
   run only_router_replay BENCH_ONLY=router_replay || return 1
+  run only_spec_decode BENCH_ONLY=spec_decode || return 1
   run only_elastic_ckpt BENCH_ONLY=elastic_ckpt || return 1
   run only_paged_attn BENCH_ONLY=paged_attn FLAGS_use_autotune=1 || return 1
   snapshot_autotune_cache paged_attn_autotune_cache
@@ -139,8 +140,8 @@ all_done() {
   local n
   for n in batch16 autotune flash_q512k512 flash_q128k512 flash_q256k1024 \
            llama1b_s4096 only_resnet only_bert only_unet only_serve \
-           only_prefix only_router_replay only_elastic_ckpt \
-           only_paged_attn baseline; do
+           only_prefix only_router_replay only_spec_decode \
+           only_elastic_ckpt only_paged_attn baseline; do
     is_done "${n}" || return 1
   done
   return 0
