@@ -808,9 +808,69 @@ def _overload_bench(on_tpu: bool):
           f"(ratio {ratio:.2f}x), shed rate off={rate_off:.2f} "
           f"on={rate_on:.2f}, p99 ttft off={p99_off * 1e3:.1f}ms "
           f"on={p99_on * 1e3:.1f}ms", file=sys.stderr)
+
+    # --- fixed-HBM int8-vs-fp32: same kv_pool_bytes budget, same
+    # KV-limited burst.  The quantized pool fits ~3.5x the blocks, so
+    # more requests decode CONCURRENTLY: occupancy = generated tokens
+    # per decode iteration, goodput = tokens per second under an
+    # injected per-step delay that dominates wall-clock (so the ratio
+    # tracks the iteration count, not host speed).  ISSUE 20's
+    # headline: both strictly higher at int8, occupancy >= 1.5x.
+    from paddle_tpu.serving.cache import BlockKVPool
+
+    hbm = 12 * BlockKVPool.block_bytes_for(
+        model.config.num_hidden_layers, 4,
+        model.config.num_key_value_heads,
+        model.config.hidden_size // model.config.num_attention_heads,
+        model.config.dtype, None)
+    quant = {}
+    for kv_dtype in (None, "int8"):
+        eng = Engine(model, ServingConfig(
+            max_batch_size=8, block_size=4, num_blocks=None,
+            kv_pool_bytes=hbm, kv_cache_dtype=kv_dtype,
+            chunk_tokens=16, max_queue_len=64))
+        burst = burst_prompts(seed=7, n=12, min_len=10, max_len=14)
+        # warm OUTSIDE the timed region: the int8 step kinds compile
+        # fresh here while the fp32 kinds were compiled by the shed
+        # phase above — timing compiles would swamp the serve loop
+        eng.submit(burst_prompts(seed=1, n=1, min_len=8, max_len=8)[0],
+                   max_new_tokens=2)
+        eng.run_until_complete()
+        warm = eng.stats()["counters"]
+        base = (warm["tokens_generated"], warm["decode_iterations"])
+        t0 = time.perf_counter()
+        # delay large enough to dominate the host-side step cost, so
+        # the goodput ratio tracks iteration count (machine-independent)
+        with FaultPlan(seed=7, step_delay_s=0.01):
+            for p in burst:
+                eng.submit(p, max_new_tokens=8)
+            eng.run_until_complete()
+        dt = time.perf_counter() - t0
+        eng.pool.check_leaks()
+        c = eng.stats()["counters"]
+        toks = c["tokens_generated"] - base[0]
+        iters = c["decode_iterations"] - base[1]
+        quant[kv_dtype] = {
+            "blocks": eng.num_blocks,
+            "occupancy": toks / iters,
+            "goodput_tps": toks / dt,
+        }
+    occ_ratio = quant["int8"]["occupancy"] / quant[None]["occupancy"]
+    gp_ratio = quant["int8"]["goodput_tps"] / quant[None]["goodput_tps"]
+    print(f"# overload fixed-HBM ({hbm} B): fp32 "
+          f"{quant[None]['blocks']} blocks occ="
+          f"{quant[None]['occupancy']:.2f} vs int8 "
+          f"{quant['int8']['blocks']} blocks occ="
+          f"{quant['int8']['occupancy']:.2f} "
+          f"(occupancy {occ_ratio:.2f}x, goodput {gp_ratio:.2f}x)",
+          file=sys.stderr)
     return round(float(ratio), 3), {
         "tokens_per_sec_per_chip": round(
-            tok_on / dt_on / _n_chips(), 1)}
+            tok_on / dt_on / _n_chips(), 1),
+        "int8_occupancy_ratio_fixed_hbm": round(occ_ratio, 3),
+        "int8_goodput_ratio_fixed_hbm": round(gp_ratio, 3),
+        "fixed_hbm_blocks_fp32": quant[None]["blocks"],
+        "fixed_hbm_blocks_int8": quant["int8"]["blocks"]}
 
 
 def _spec_decode_bench(on_tpu: bool):
@@ -1053,24 +1113,39 @@ def _paged_attn_bench(on_tpu: bool):
     rng = np.random.RandomState(0)
     tok = jnp.asarray(rng.randint(1, cfg.vocab_size, (B, 1)), jnp.int32)
 
-    def time_step(step):
-        jax.block_until_ready(step(tok, pools, bt, lengths)[0])  # compile
+    def time_step(step, p=pools):
+        jax.block_until_ready(step(tok, p, bt, lengths)[0])  # compile
         for _ in range(warmup):
-            jax.block_until_ready(step(tok, pools, bt, lengths)[0])
+            jax.block_until_ready(step(tok, p, bt, lengths)[0])
         t0 = time.perf_counter()
         for _ in range(steps):
-            jax.block_until_ready(step(tok, pools, bt, lengths)[0])
+            jax.block_until_ready(step(tok, p, bt, lengths)[0])
         return (time.perf_counter() - t0) / steps
 
     t_unfused = time_step(make_paged_decode_step(model, fused=False))
     t_fused = time_step(make_paged_decode_step(model, fused=True))
+    # quantized TPOT: the same step over an int8 pool (codes + per-row
+    # scale sidecars) — the DMA-boundary dequant path, 4x fewer KV
+    # bytes per decode step than fp32 (2x vs bf16)
+    pools_q = [(jnp.zeros((nb, bs, kvh, hd), jnp.int8),
+                jnp.zeros((nb, bs, kvh, hd), jnp.int8),
+                jnp.ones((nb, bs), jnp.float32),
+                jnp.ones((nb, bs), jnp.float32))
+               for _ in range(cfg.num_hidden_layers)]
+    t_int8 = time_step(make_paged_decode_step(model, fused=True,
+                                              kv_cache_dtype="int8"),
+                       p=pools_q)
     speedup = t_unfused / t_fused if t_fused > 0 else float("inf")
+    q_speedup = t_fused / t_int8 if t_int8 > 0 else float("inf")
     print(f"# paged_attn: decode step unfused={t_unfused * 1e3:.3f}ms "
           f"fused={t_fused * 1e3:.3f}ms speedup={speedup:.2f}x "
+          f"int8={t_int8 * 1e3:.3f}ms ({q_speedup:.2f}x vs fused) "
           f"(B={B}, ctx={ctx}, block_size={bs})", file=sys.stderr)
     return round(t_fused * 1e3, 3), {
         "unfused_tpot_ms": round(t_unfused * 1e3, 3),
         "fused_vs_unfused_speedup": round(speedup, 3),
+        "int8_kv_tpot_ms": round(t_int8 * 1e3, 3),
+        "int8_vs_fused_speedup": round(q_speedup, 3),
         "tokens_per_sec_per_chip": round(B / t_fused / _n_chips(), 1)}
 
 

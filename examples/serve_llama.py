@@ -43,6 +43,14 @@ across accept/reject boundaries, a weight-identical draft hits the 1.0
 accept-rate ceiling, rejected drafts roll their KV blocks back leak-
 free, and both new steps compile exactly once.
 
+With ``--quantized`` (the CI quantized-serving stage) the demo serves
+the same staggered workload from an int8 paged KV cache (per-block-row
+absmax scales, dequant at the attention kernels' block boundary) and a
+weight-only int8 engine, asserting greedy token parity with the fp32
+engine, zero retraces, zero pool leaks — then re-sizes both engines
+from one FIXED ``kv_pool_bytes`` HBM budget to show the quantized pool
+holding >= 1.5x the resident KV blocks.
+
 With ``--stream`` the demo drains one SSE response from the
 ``Endpoint`` front door — ``data: <json>`` frames in token order,
 terminated by ``data: [DONE]`` — and asserts the streamed tokens match
@@ -50,7 +58,7 @@ the request's final generated list, greedy and sampled.
 
 Run:  python examples/serve_llama.py
           [--prefix-cache | --overload-chaos | --fused | --router |
-           --speculative | --stream]
+           --speculative | --quantized | --stream]
 """
 import argparse
 
@@ -358,6 +366,67 @@ def speculative_demo(model):
           "step kind, zero KV leaks after rejected drafts")
 
 
+def quantized_demo(model):
+    from paddle_tpu.serving.cache import BlockKVPool
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, size=(L,)).astype(np.int32)
+               for L in (3, 8, 5, 12, 4, 9, 6, 7)]
+    max_new = 16
+
+    # --- phase 1: int8 KV (and int8 weights) vs fp32, token parity
+    outs = {}
+    engines = {}
+    configs = {
+        "fp32": {},
+        "int8-kv": dict(kv_cache_dtype="int8"),
+        "int8-kv+w8": dict(kv_cache_dtype="int8", weight_dtype="int8"),
+    }
+    for label, extra in configs.items():
+        eng = Engine(model, ServingConfig(max_batch_size=4, block_size=8,
+                                          num_blocks=64, **extra))
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.run_until_complete()
+        outs[label] = [r.output_ids()[r.prompt_len:].tolist()
+                       for r in reqs]
+        engines[label] = eng
+    for label in ("int8-kv", "int8-kv+w8"):
+        for i, (q, f) in enumerate(zip(outs[label], outs["fp32"])):
+            assert q == f, f"request {i}: {label} {q} != fp32 {f}"
+    print(f"token parity: {len(prompts)} requests, int8 KV == "
+          f"int8 KV + int8 weights == fp32")
+
+    for label, eng in engines.items():
+        assert eng._decode_step.retraces == 0, label
+        assert eng._prefill_step.retraces == 0, label
+        eng.pool.check_leaks()
+        st = eng.pool.stats()
+        g = eng.stats()["gauges"]
+        print(f"  {label:>11}: block={st['block_bytes']}B "
+              f"pool={st['capacity_bytes'] / 2**10:.0f}KiB "
+              f"kv_dtype_gauge={g['serving_kv_cache_dtype']:.0f} "
+              f"scale_bytes={g['kv_quant_scale_bytes']:.0f}")
+    print("quantized serving: zero retraces, zero pool leaks")
+
+    # --- phase 2: one fixed HBM budget, dtype-aware block derivation
+    cfg = model.config
+    budget = 48 * BlockKVPool.block_bytes_for(
+        cfg.num_hidden_layers, 8, cfg.num_key_value_heads,
+        cfg.hidden_size // cfg.num_attention_heads, cfg.dtype, None)
+    resident = {}
+    for label, kv_dtype in (("fp32", None), ("int8", "int8")):
+        eng = Engine(model, ServingConfig(max_batch_size=4, block_size=8,
+                                          num_blocks=None,
+                                          kv_pool_bytes=budget,
+                                          kv_cache_dtype=kv_dtype))
+        resident[label] = eng.num_blocks
+    ratio = resident["int8"] / resident["fp32"]
+    print(f"fixed {budget / 2**10:.0f}KiB KV budget: "
+          f"{resident['fp32']} fp32 blocks vs {resident['int8']} int8 "
+          f"blocks ({ratio:.2f}x resident)")
+    assert ratio >= 1.5, ratio
+
+
 def stream_demo(model):
     import json
 
@@ -421,6 +490,11 @@ def main():
                          "decoding: greedy token parity with generate() "
                          "and the plain engine, leak-free rollback, "
                          "self-draft accept-rate ceiling")
+    ap.add_argument("--quantized", action="store_true",
+                    help="int8 paged KV + weight-only int8 engines: "
+                         "greedy token parity with fp32, zero retraces "
+                         "and leaks, >=1.5x resident blocks at a fixed "
+                         "kv_pool_bytes budget")
     ap.add_argument("--stream", action="store_true",
                     help="SSE streaming front door: per-token data: "
                          "frames in order, summary event, [DONE] "
@@ -440,6 +514,8 @@ def main():
         router_demo(model)
     elif args.speculative:
         speculative_demo(model)
+    elif args.quantized:
+        quantized_demo(model)
     elif args.stream:
         stream_demo(model)
     else:

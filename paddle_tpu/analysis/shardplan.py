@@ -1554,13 +1554,22 @@ def _flatten_specs_like(spec, arg, out: List[Any]):
 def _serving_arg_specs(model, layout, decode_args, prefill_args):
     """Specs mirroring ``xray._serving_abstract_args``' structure: KV
     pools shard kv-heads on ``tp`` (SNIPPETS [3] style), per-sequence
-    buffers shard batch on ``data``; prefill runs batch=1, replicated."""
+    buffers shard batch on ``data``; prefill runs batch=1, replicated.
+    Quantized pool entries carry two extra per-row scale sidecars
+    ([num_blocks, block_size], no kv-head axis) that REPLICATE — the
+    spec tuples mirror the entry arity so spec flattening stays
+    one-to-one with the args."""
     from jax.sharding import PartitionSpec
 
     tp = layout.tp_axis
-    pool_spec = [(PartitionSpec(None, None, tp, None),
-                  PartitionSpec(None, None, tp, None))
-                 for _ in decode_args[1]]
+    pool_spec = []
+    for entry in decode_args[1]:
+        specs = (PartitionSpec(None, None, tp, None),
+                 PartitionSpec(None, None, tp, None))
+        if len(entry) == 4:
+            specs += (PartitionSpec(None, None),
+                      PartitionSpec(None, None))
+        pool_spec.append(specs)
     batch = layout.batch_spec()
     decode = (batch, pool_spec, batch, batch)
     prefill = (PartitionSpec(), pool_spec, PartitionSpec(),

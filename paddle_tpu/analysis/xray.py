@@ -913,16 +913,29 @@ def export_report_gauges(report: ProgramReport):
 
 
 def _serving_abstract_args(model, *, batch, num_blocks, block_size,
-                           max_blocks_per_seq, chunk_tokens):
+                           max_blocks_per_seq, chunk_tokens,
+                           kv_cache_dtype=None):
     """Engine-shaped abstract args for the paged decode and chunked
-    prefill steps (mirrors Engine.__init__'s concrete buffers)."""
+    prefill steps (mirrors Engine.__init__'s concrete buffers).
+    ``kv_cache_dtype`` of "int8"/"fp8" mirrors a QUANTIZED pool: int8
+    code pools plus per-(block, token)-row f32 scale sidecars, so the
+    liveness walk prices the real (quantized) HBM bytes per dtype."""
+    from ..kernels.kv_quant import resolve_kv_cache_dtype
     from ..models.generation import _cache_dims
 
     kv_heads, head_dim, dtype = _cache_dims(model)
+    scheme = resolve_kv_cache_dtype(kv_cache_dtype)
     sds = jax.ShapeDtypeStruct
-    pool = [(sds((num_blocks, block_size, kv_heads, head_dim), dtype),
-             sds((num_blocks, block_size, kv_heads, head_dim), dtype))
-            for _ in range(model.config.num_hidden_layers)]
+    if scheme is not None:
+        pool_sds = sds((num_blocks, block_size, kv_heads, head_dim),
+                       np.int8)
+        scale_sds = sds((num_blocks, block_size), np.float32)
+        pool = [(pool_sds, pool_sds, scale_sds, scale_sds)
+                for _ in range(model.config.num_hidden_layers)]
+    else:
+        pool = [(sds((num_blocks, block_size, kv_heads, head_dim), dtype),
+                 sds((num_blocks, block_size, kv_heads, head_dim), dtype))
+                for _ in range(model.config.num_hidden_layers)]
     decode = (sds((batch, 1), np.int32), pool,
               sds((batch, max_blocks_per_seq), np.int32),
               sds((batch,), np.int32))
@@ -1060,6 +1073,42 @@ def audit_default_steps(*, chip: str = "cpu",
                               interpret=True),
             prefill_kernel_args, name="kernel::fused_chunked_prefill",
             chip=chip, hbm_budget_bytes=hbm_budget_bytes))
+
+        # quantized serving (ISSUE 20): the int8-KV fused steps and the
+        # quantized decode kernel, so the costs registry is exercised on
+        # int8 pool operands (quantized bytes, not fp32) in the same
+        # --xray --fused CI gate
+        q_decode_args, q_prefill_args = _serving_abstract_args(
+            net, batch=4, num_blocks=32, block_size=8,
+            max_blocks_per_seq=8, chunk_tokens=32, kv_cache_dtype="int8")
+        reports.append(analyze(
+            make_paged_decode_step(net, fused=True, kv_cache_dtype="int8"),
+            q_decode_args, name="serving::decode_step[fused,int8]",
+            chip=chip, hbm_budget_bytes=hbm_budget_bytes))
+        reports.append(analyze(
+            make_chunked_prefill_step(net, fused=True,
+                                      kv_cache_dtype="int8"),
+            q_prefill_args, name="serving::prefill_step[fused,int8]",
+            chip=chip, hbm_budget_bytes=hbm_budget_bytes))
+
+        def _q_decode_kernel(q, kn, vn, kp, vp, bt, pos, cos, sin,
+                             ksc, vsc):
+            return fused_paged_decode(
+                q, kn, vn, kp, vp, bt, pos, cos, sin, use_pallas=True,
+                interpret=True, k_scale=ksc, v_scale=vsc,
+                kv_cache_dtype="int8")
+
+        q_kernel_args = kernel_args[:3] + (
+            sds32((32, 8, kvh, hd), np.int8),               # k_pool codes
+            sds32((32, 8, kvh, hd), np.int8),               # v_pool codes
+        ) + kernel_args[5:] + (
+            sds32((32, 8), f32),                            # k_scale
+            sds32((32, 8), f32),                            # v_scale
+        )
+        reports.append(analyze(
+            _q_decode_kernel, q_kernel_args,
+            name="kernel::fused_paged_decode[int8]", chip=chip,
+            hbm_budget_bytes=hbm_budget_bytes))
 
     from ..distributed.mesh import abstract_mesh
     from ..models.generation import make_moe_block_step, make_ring_sp_step

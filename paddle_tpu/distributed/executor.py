@@ -427,8 +427,19 @@ class MeshExecutor:
 
     def shard_kv_layers(self, layers):
         spec = self.kv_pool_spec()
-        return [(self.put(k, spec), self.put(v, spec))
-                for k, v in layers]
+        # Quantized pools carry (k, v, k_scale, v_scale); the per-row
+        # scale sidecars [num_blocks, block_size] have no kv-head axis
+        # to shard, so they replicate.
+        scale_spec = PartitionSpec(None, None)
+        out = []
+        for entry in layers:
+            k, v = entry[0], entry[1]
+            sharded = (self.put(k, spec), self.put(v, spec))
+            if len(entry) == 4:
+                sharded += (self.put(entry[2], scale_spec),
+                            self.put(entry[3], scale_spec))
+            out.append(sharded)
+        return out
 
     def install_serving(self, model, pool) -> "MeshExecutor":
         """Shard the serving model + paged KV pool.  Must run BEFORE the

@@ -42,13 +42,19 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 from .costs import KernelCost, register_kernel_cost
+from .kv_quant import decode_codes
 
 KERNEL_NAME = "fused_chunked_prefill"
 NEG_INF = -1e30
 
 
-def _chunk_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, bs, chunk, n_pages):
+def _chunk_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                  bs, chunk, n_pages, kv_dtype=None):
+    if kv_dtype is not None:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -60,10 +66,18 @@ def _chunk_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     # q rows are [rep * chunk, D] with row r * chunk + t; scale is
     # already folded into q by the caller, so the score math is a bare
-    # dot against this page's gathered block
+    # dot against this page's gathered block.  Quantized pools dequant
+    # right at the DMA boundary: the int8 block just landed in VMEM and
+    # the per-row scale multiply rides the same f32 upcast.
     qv = q_ref[0, 0].astype(jnp.float32)                # [RT, D]
-    kb = k_ref[0, :, 0, :].astype(jnp.float32)          # [bs, D]
-    vb = v_ref[0, :, 0, :].astype(jnp.float32)
+    if kv_dtype is not None:
+        kb = decode_codes(k_ref[0, :, 0, :], kv_dtype) * \
+            ks_ref[0][:, None]                          # [bs, D]
+        vb = decode_codes(v_ref[0, :, 0, :], kv_dtype) * \
+            vs_ref[0][:, None]
+    else:
+        kb = k_ref[0, :, 0, :].astype(jnp.float32)      # [bs, D]
+        vb = v_ref[0, :, 0, :].astype(jnp.float32)
 
     scores = jax.lax.dot_general(
         qv, kb, (((1,), (1,)), ((), ())),
@@ -94,24 +108,35 @@ def _chunk_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _pallas_chunked(q_g, k_pool, v_pool, block_table, positions, chunk,
-                    interpret):
+                    interpret, k_scale=None, v_scale=None, kv_dtype=None):
     """q_g: grouped, ROTATED, pre-scaled [B, KVH, RT, D] f32 queries;
     returns the normalized context [B, KVH, RT, D] f32."""
     B, KVH, RT, D = q_g.shape
     bs = k_pool.shape[1]
     nbs = block_table.shape[1]
 
+    in_specs = [
+        pl.BlockSpec((1, 1, RT, D),
+                     lambda b, h, p, bt, pos: (b, h, 0, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda b, h, p, bt, pos: (bt[b, p], 0, h, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda b, h, p, bt, pos: (bt[b, p], 0, h, 0)),
+    ]
+    operands = [q_g, k_pool, v_pool]
+    if kv_dtype is not None:
+        # per-row scale sidecars ride the same block-table indexing as
+        # the pools they describe ([nb, bs] -> one (1, bs) row strip)
+        in_specs += [
+            pl.BlockSpec((1, bs), lambda b, h, p, bt, pos: (bt[b, p], 0)),
+            pl.BlockSpec((1, bs), lambda b, h, p, bt, pos: (bt[b, p], 0)),
+        ]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KVH, nbs),
-        in_specs=[
-            pl.BlockSpec((1, 1, RT, D),
-                         lambda b, h, p, bt, pos: (b, h, 0, 0)),
-            pl.BlockSpec((1, bs, 1, D),
-                         lambda b, h, p, bt, pos: (bt[b, p], 0, h, 0)),
-            pl.BlockSpec((1, bs, 1, D),
-                         lambda b, h, p, bt, pos: (bt[b, p], 0, h, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, RT, D),
                                lambda b, h, p, bt, pos: (b, h, 0, 0)),
         scratch_shapes=[
@@ -122,9 +147,10 @@ def _pallas_chunked(q_g, k_pool, v_pool, block_table, positions, chunk,
     )
     L = nbs * bs
     esize = jnp.dtype(k_pool.dtype).itemsize
+    scale_bytes = 2.0 * B * KVH * L * 4 if kv_dtype is not None else 0.0
     return pl.pallas_call(
         functools.partial(_chunk_kernel, bs=bs, chunk=chunk,
-                          n_pages=nbs),
+                          n_pages=nbs, kv_dtype=kv_dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, RT, D), jnp.float32),
         compiler_params=pltpu.CompilerParams(
@@ -132,14 +158,16 @@ def _pallas_chunked(q_g, k_pool, v_pool, block_table, positions, chunk,
         if (_HAS_PLTPU and not interpret) else None,
         cost_estimate=pl.CostEstimate(
             flops=4.0 * B * KVH * RT * D * L,
-            bytes_accessed=float(2 * B * L * KVH * D * esize),
+            bytes_accessed=float(2 * B * L * KVH * D * esize)
+            + scale_bytes,
             transcendentals=float(B * KVH * RT * L)),
         interpret=interpret,
         name=KERNEL_NAME,
-    )(block_table, positions, q_g, k_pool, v_pool)
+    )(block_table, positions, *operands)
 
 
-def _xla_chunked(q_g, k_pool, v_pool, block_table, positions, chunk):
+def _xla_chunked(q_g, k_pool, v_pool, block_table, positions, chunk,
+                 k_scale=None, v_scale=None, kv_dtype=None):
     """Same grouped-query chunk attention in plain XLA: q_g is the
     ROTATED and pre-scaled [B, KVH, RT, D] f32 query (scale folded in,
     exactly as the caller hands the kernel)."""
@@ -147,8 +175,16 @@ def _xla_chunked(q_g, k_pool, v_pool, block_table, positions, chunk):
     bs = k_pool.shape[1]
     nbs = block_table.shape[1]
     L = nbs * bs
-    kb = k_pool[block_table].astype(jnp.float32)        # [B,nbs,bs,KVH,D]
-    vb = v_pool[block_table].astype(jnp.float32)
+    if kv_dtype is not None:
+        # same decode_codes * per-row-scale multiply as the kernel's
+        # DMA boundary, just on the gathered [B,nbs,bs,KVH,D] copy
+        kb = decode_codes(k_pool[block_table], kv_dtype) * \
+            k_scale[block_table][..., None, None]
+        vb = decode_codes(v_pool[block_table], kv_dtype) * \
+            v_scale[block_table][..., None, None]
+    else:
+        kb = k_pool[block_table].astype(jnp.float32)    # [B,nbs,bs,KVH,D]
+        vb = v_pool[block_table].astype(jnp.float32)
     kb = kb.reshape(B, L, KVH, D)
     vb = vb.reshape(B, L, KVH, D)
     scores = jnp.einsum("bkrd,blkd->bkrl", q_g, kb,
@@ -166,7 +202,9 @@ def _xla_chunked(q_g, k_pool, v_pool, block_table, positions, chunk):
 
 
 def fused_chunked_attention(q, k_pool, v_pool, block_table, positions,
-                            *, use_pallas=None, interpret=None):
+                            *, use_pallas=None, interpret=None,
+                            k_scale=None, v_scale=None,
+                            kv_cache_dtype=None):
     """Paged attention for one prefill chunk, fused end to end.
 
     q: [B, T, H, D] ROTATED queries for the chunk; k_pool/v_pool:
@@ -177,6 +215,12 @@ def fused_chunked_attention(q, k_pool, v_pool, block_table, positions,
     in q's dtype — the drop-in replacement for models/llama.py's
     ``_paged_attn`` gather path (identical causal masking, so padded
     chunk tails produce the same discarded garbage rows).
+
+    Quantized pools (``kv_cache_dtype`` of ``"int8"``/``"fp8"``) hand
+    in int8 code pools plus per-row ``k_scale``/``v_scale`` [nb, bs]
+    f32 sidecars; dequant happens at the kernel's block-DMA boundary
+    (and identically in the XLA fallback).  The caller has already
+    scatter-quantized the chunk's k/v into the pools.
 
     On TPU the gather + mask + softmax + context is one Pallas kernel
     with an online softmax; elsewhere the numerically-identical XLA
@@ -206,10 +250,13 @@ def fused_chunked_attention(q, k_pool, v_pool, block_table, positions,
         .reshape(B, KVH, rep * T, D).astype(jnp.float32) * scale
     if use_pallas:
         out = _pallas_chunked(q_g, k_pool, v_pool, block_table,
-                              positions, T, interpret)
+                              positions, T, interpret,
+                              k_scale=k_scale, v_scale=v_scale,
+                              kv_dtype=kv_cache_dtype)
     else:
         out = _xla_chunked(q_g, k_pool, v_pool, block_table, positions,
-                           T)
+                           T, k_scale=k_scale, v_scale=v_scale,
+                           kv_dtype=kv_cache_dtype)
     return out.reshape(B, KVH, rep, T, D).transpose(0, 3, 1, 2, 4) \
         .reshape(B, T, H, D).astype(q.dtype)
 
@@ -220,7 +267,7 @@ def fused_chunked_attention(q, k_pool, v_pool, block_table, positions,
 
 def _chunked_prefill_cost(in_avals, out_avals):
     # operand order fixed by _pallas_chunked:
-    # (block_table, positions, q_g, k_pool, v_pool)
+    # (block_table, positions, q_g, k_pool, v_pool[, k_scale, v_scale])
     bt_shape = in_avals[0][0]
     q_shape, q_dtype = in_avals[2][0], in_avals[2][1]
     pool_shape, pool_dtype = in_avals[3][0], in_avals[3][1]
@@ -235,8 +282,13 @@ def _chunked_prefill_cost(in_avals, out_avals):
         float(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in in_avals[:3])                  # table/pos/q
     # the pools are read THROUGH the block table: B*L rows each, not
-    # the whole pool allocation
+    # the whole pool allocation (esize already reflects int8 when the
+    # pool is quantized); per-row f32 scale sidecars ride along per
+    # kv-head grid step when present
     kv_bytes = 2.0 * B * L * KVH * D * esize
+    if len(in_avals) > 5:
+        kv_bytes += 2.0 * B * KVH * L * \
+            np.dtype(in_avals[5][1]).itemsize
     out_bytes = sum(
         float(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in out_avals)

@@ -24,6 +24,45 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+try:  # extended dtypes (bfloat16, float8_*, int4) live in ml_dtypes
+    import ml_dtypes as _ml_dtypes
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _ml_dtypes = None
+
+#: names numpy itself cannot resolve but kernels legitimately emit —
+#: quantized pools (int8 containers holding fp8 bit patterns), bf16
+#: accumulators, and sub-byte packed weights.  Resolved through
+#: ml_dtypes, with the PACKED bytes-per-element recorded explicitly
+#: (np.dtype(int4).itemsize says 1 because numpy pads to a byte).
+_SUB_BYTE_ELEMENT_BYTES = {"int4": 0.5, "uint4": 0.5,
+                           "float4_e2m1fn": 0.5}
+
+
+def resolve_cost_dtype(name) -> np.dtype:
+    """``np.dtype(name)`` that also understands ml_dtypes names
+    (``bfloat16``, ``float8_e4m3fn``, ``int4``, ...) which plain numpy
+    rejects.  Raises TypeError for genuinely unknown names."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        if _ml_dtypes is not None and isinstance(name, str):
+            ext = getattr(_ml_dtypes, name, None)
+            if ext is not None:
+                return np.dtype(ext)
+        raise
+
+
+def dtype_element_bytes(name) -> float:
+    """Bytes per element for cost accounting, as a float so sub-byte
+    packed dtypes (int4 = 0.5) price correctly instead of rounding up
+    to numpy's byte-padded itemsize."""
+    if isinstance(name, str) and name in _SUB_BYTE_ELEMENT_BYTES:
+        return _SUB_BYTE_ELEMENT_BYTES[name]
+    dt = resolve_cost_dtype(name)
+    if dt.name in _SUB_BYTE_ELEMENT_BYTES:
+        return _SUB_BYTE_ELEMENT_BYTES[dt.name]
+    return float(dt.itemsize)
+
 
 @dataclasses.dataclass(frozen=True)
 class KernelCost:
@@ -53,11 +92,11 @@ class KernelCost:
                 "KernelCost.transcendentals must be >= 0, got "
                 f"{self.transcendentals!r}")
         try:
-            np.dtype(self.dtype)
+            resolve_cost_dtype(self.dtype)
         except TypeError as e:
             raise ValueError(
                 f"KernelCost.dtype {self.dtype!r} is not a dtype "
-                f"name numpy recognises") from e
+                f"name numpy or ml_dtypes recognises") from e
 
 
 #: abstract operand passed to cost functions: (shape tuple, dtype name)
@@ -117,7 +156,7 @@ def _np_bytes(aval: AbstractArg) -> float:
     n = 1
     for s in shape:
         n *= int(s)
-    return float(n) * np.dtype(dtype).itemsize
+    return float(n) * dtype_element_bytes(dtype)
 
 
 def io_bytes(in_avals: Sequence[AbstractArg],

@@ -1,0 +1,124 @@
+# lint-tpu: disable-file=L004 -- kernel-layer quantization helpers
+# (like paged_attention.py); direct jax use is the point here
+"""Quantized paged-KV storage codecs shared by the serving cache, the
+fused attention kernels, and their XLA fallbacks (ISSUE 20).
+
+The paged block pools store KV as int8 CODES plus one float32 absmax
+scale per (block, token) ROW — the scale reduces over the row's
+(kv_heads x head_dim) elements.  Per-row scales are append-only: every
+KV write quantizes exactly the rows it lands on, so quantization
+happens inside the traced prefill/decode steps with no host sync
+(H106) and no rescaling of previously-written codes (a per-block
+SCALAR scale could not absorb a new token's larger absmax without
+rewriting the whole block).
+
+Two schemes, both in an int8 container so ONE pool layout serves both:
+
+* ``"int8"`` — symmetric absmax: ``scale = absmax / 127``,
+  ``code = round(clip(x / scale, -127, 127))``.
+* ``"fp8"``  — fp8-e4m3 emulation: ``scale = absmax / 448`` (e4m3's
+  max normal), codes are the e4m3 bit pattern bitcast into int8.  On
+  CPU this is exact fp8 arithmetic via jax's ml_dtypes float8_e4m3fn;
+  on TPU the same bitcast round-trips through the native fp8 type.
+
+Dequant is ``decode_codes(codes) * scale`` in float32 — a multiply
+fused into the block-DMA boundary of both Pallas kernels
+(kernels/paged_attention.py, kernels/chunked_prefill.py) and written
+IDENTICALLY in their XLA fallbacks, so CPU tier-1 tests the exact
+served math.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: canonical scheme names (``None`` = unquantized full-precision pool)
+KV_SCHEMES = ("int8", "fp8")
+
+_ALIASES = {
+    None: None, "": None, "fp32": None, "float32": None, "auto": None,
+    "int8": "int8", "i8": "int8",
+    "fp8": "fp8", "fp8_e4m3": "fp8", "float8_e4m3fn": "fp8",
+}
+
+#: clip/quantization range per scheme (e4m3 max normal is 448)
+KV_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+#: numeric gauge codes (observability: serving_kv_cache_dtype)
+KV_DTYPE_CODES = {None: 0, "int8": 1, "fp8": 2}
+
+
+def resolve_kv_cache_dtype(name):
+    """Canonicalize a ``ServingConfig.kv_cache_dtype`` spelling to
+    ``None`` / ``"int8"`` / ``"fp8"`` (ValueError on anything else)."""
+    if isinstance(name, str):
+        name = name.lower()
+    if name in _ALIASES:
+        return _ALIASES[name]
+    raise ValueError(
+        f"unsupported kv_cache_dtype {name!r}; expected one of "
+        f"{sorted(k for k in _ALIASES if isinstance(k, str))}")
+
+
+def kv_storage_dtype(scheme):
+    """Pool element dtype for ``scheme`` — int8 is the container for
+    both schemes (fp8 codes are e4m3 bit patterns bitcast into int8)."""
+    return jnp.int8 if scheme is not None else None
+
+
+def kv_scale_bytes_per_block(block_size, scheme):
+    """Scale-sidecar bytes ONE (k or v) block carries: one f32 absmax
+    per token row, zero when unquantized."""
+    return int(block_size) * 4 if scheme is not None else 0
+
+
+def quantize_kv(x, scheme):
+    """Quantize KV rows: ``x`` [..., KVH, D] float → (codes int8 of the
+    same shape, scales f32 [...]) with one absmax scale per leading
+    row.  All-zero rows get scale 1.0 so dequant stays exact."""
+    qmax = KV_QMAX[scheme]
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.where(absmax > 0.0, absmax / qmax, 1.0)
+    y = xf / scale[..., None, None]
+    if scheme == "int8":
+        codes = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    else:
+        codes = jax.lax.bitcast_convert_type(
+            jnp.clip(y, -qmax, qmax).astype(jnp.float8_e4m3fn), jnp.int8)
+    return codes, scale.astype(jnp.float32)
+
+
+def decode_codes(codes, scheme):
+    """Codes → float32, WITHOUT the scale multiply (kernels apply the
+    scale themselves with their own broadcast shape)."""
+    if scheme == "int8":
+        return codes.astype(jnp.float32)
+    return jax.lax.bitcast_convert_type(
+        codes, jnp.float8_e4m3fn).astype(jnp.float32)
+
+
+def dequantize_kv(codes, scale, scheme):
+    """Full dequant: ``codes`` [..., KVH, D] int8, ``scale`` f32 [...]
+    per-row → float32 values."""
+    return decode_codes(codes, scheme) * scale[..., None, None]
+
+
+def kv_pool_dtype_code(scheme) -> int:
+    return KV_DTYPE_CODES[scheme]
+
+
+def kv_bytes_per_element(scheme, fallback_dtype=jnp.float32) -> int:
+    """Element width of the stored KV codes (1 for both quantized
+    schemes; the pool dtype's width otherwise)."""
+    if scheme is not None:
+        return 1
+    return int(np.dtype(jnp.dtype(fallback_dtype)).itemsize)
+
+
+__all__ = ["KV_SCHEMES", "KV_QMAX", "KV_DTYPE_CODES",
+           "resolve_kv_cache_dtype", "kv_storage_dtype",
+           "kv_scale_bytes_per_block", "quantize_kv", "decode_codes",
+           "dequantize_kv", "kv_pool_dtype_code",
+           "kv_bytes_per_element"]

@@ -48,6 +48,7 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 from .costs import KernelCost, register_kernel_cost
+from .kv_quant import decode_codes, quantize_kv
 
 KERNEL_NAME = "fused_paged_decode"
 NEG_INF = -1e30
@@ -76,14 +77,41 @@ def _scatter_token(pool, new, block_table, positions):
     return flat.reshape(pool.shape)
 
 
+def _scatter_token_quant(pool, scales, new, block_table, positions,
+                         scheme):
+    """Quantize-at-write T == 1 scatter (kernels/kv_quant): int8 codes
+    into the pool row, the row's absmax scale into the [nb, bs] f32
+    sidecar — same index math and column clamp as ``_scatter_token``,
+    all inside the traced step (no host sync, H106)."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    nbs = block_table.shape[1]
+    rows = jnp.arange(block_table.shape[0])
+    col = jnp.minimum(positions // bs, nbs - 1)
+    idx = block_table[rows, col] * bs + positions % bs          # [B]
+    codes, sc = quantize_kv(new, scheme)            # [B,KVH,D], [B]
+    flat = pool.reshape(nb * bs, pool.shape[2], pool.shape[3])
+    flat = flat.at[idx].set(codes)
+    sflat = scales.reshape(nb * bs).at[idx].set(sc)
+    return flat.reshape(pool.shape), sflat.reshape(nb, bs)
+
+
 # ---------------------------------------------------------------------------
 # split-K partials: Pallas kernel
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(bt_ref, pos_ref, q_ref, cos_ref, sin_ref, k_ref, v_ref,
-                   o_ref, m_out_ref, l_out_ref,
-                   qrot_ref, acc_ref, m_ref, l_ref, *, bs, pages_per_split,
-                   scale):
+                   *rest, bs, pages_per_split, scale, kv_dtype=None):
+    # quantized pools carry two extra per-block scale operands between
+    # the KV refs and the outputs (same scalar-prefetch index map, so
+    # each grid step DMAs its block's [bs] scale row alongside the
+    # block itself)
+    if kv_dtype is not None:
+        (ks_ref, vs_ref, o_ref, m_out_ref, l_out_ref,
+         qrot_ref, acc_ref, m_ref, l_ref) = rest
+    else:
+        (o_ref, m_out_ref, l_out_ref,
+         qrot_ref, acc_ref, m_ref, l_ref) = rest
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     s = pl.program_id(1)
     p = pl.program_id(2)
@@ -101,9 +129,15 @@ def _decode_kernel(bt_ref, pos_ref, q_ref, cos_ref, sin_ref, k_ref, v_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # one gathered KV block: [bs, KVH, D] -> [KVH, bs, D]
-    kb = jnp.swapaxes(k_ref[0].astype(jnp.float32), 0, 1)
-    vb = jnp.swapaxes(v_ref[0].astype(jnp.float32), 0, 1)
+    # one gathered KV block: [bs, KVH, D] -> [KVH, bs, D].  Quantized
+    # pools dequant HERE, at the DMA boundary — codes * per-row scale
+    # in f32, so the wide KV copy never exists in HBM (ISSUE 20)
+    kq, vq = k_ref[0], v_ref[0]
+    if kv_dtype is not None:
+        kq = decode_codes(kq, kv_dtype) * ks_ref[0][:, None, None]
+        vq = decode_codes(vq, kv_dtype) * vs_ref[0][:, None, None]
+    kb = jnp.swapaxes(kq.astype(jnp.float32), 0, 1)
+    vb = jnp.swapaxes(vq.astype(jnp.float32), 0, 1)
 
     scores = jax.lax.dot_general(
         qrot_ref[:], kb, (((2,), (2,)), ((0,), (0,))),
@@ -134,7 +168,8 @@ def _decode_kernel(bt_ref, pos_ref, q_ref, cos_ref, sin_ref, k_ref, v_ref,
 
 
 def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
-                     block_table, positions, num_splits, scale, interpret):
+                     block_table, positions, num_splits, scale, interpret,
+                     k_scale=None, v_scale=None, kv_dtype=None):
     """q: UNROTATED [B, KVH, rep, D]; returns (acc [B,S,KVH,rep,D] f32,
     m [B,S,KVH,rep] f32, l [B,S,KVH,rep] f32)."""
     B, KVH, rep, D = q.shape
@@ -143,21 +178,34 @@ def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
     P = nbs // num_splits
     half = D // 2
 
+    in_specs = [
+        pl.BlockSpec((1, KVH, rep, D),
+                     lambda b, s, p, bt, pos: (b, 0, 0, 0)),
+        pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
+        pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
+        pl.BlockSpec((1, bs, KVH, D),
+                     lambda b, s, p, bt, pos, _P=P:
+                     (bt[b, s * _P + p], 0, 0, 0)),
+        pl.BlockSpec((1, bs, KVH, D),
+                     lambda b, s, p, bt, pos, _P=P:
+                     (bt[b, s * _P + p], 0, 0, 0)),
+    ]
+    operands = [q, cos_b, sin_b, k_pool, v_pool]
+    if kv_dtype is not None:
+        # per-block scale rows ride the SAME block-table index map as
+        # their blocks — one [bs] f32 row per DMA'd block
+        in_specs += [
+            pl.BlockSpec((1, bs), lambda b, s, p, bt, pos, _P=P:
+                         (bt[b, s * _P + p], 0)),
+            pl.BlockSpec((1, bs), lambda b, s, p, bt, pos, _P=P:
+                         (bt[b, s * _P + p], 0)),
+        ]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, num_splits, P),
-        in_specs=[
-            pl.BlockSpec((1, KVH, rep, D),
-                         lambda b, s, p, bt, pos: (b, 0, 0, 0)),
-            pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
-            pl.BlockSpec((1, half), lambda b, s, p, bt, pos: (b, 0)),
-            pl.BlockSpec((1, bs, KVH, D),
-                         lambda b, s, p, bt, pos, _P=P:
-                         (bt[b, s * _P + p], 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, D),
-                         lambda b, s, p, bt, pos, _P=P:
-                         (bt[b, s * _P + p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, KVH, rep, D),
                          lambda b, s, p, bt, pos: (b, s, 0, 0, 0)),
@@ -176,9 +224,11 @@ def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
     L = nbs * bs
     H = KVH * rep
     esize = jnp.dtype(k_pool.dtype).itemsize
+    # quantized pools also stream one f32 scale per (pool, token) row
+    scale_bytes = 2.0 * B * L * 4 if kv_dtype is not None else 0.0
     acc, m_b, l_b = pl.pallas_call(
         functools.partial(_decode_kernel, bs=bs, pages_per_split=P,
-                          scale=scale),
+                          scale=scale, kv_dtype=kv_dtype),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, num_splits, KVH, rep, D),
@@ -193,11 +243,12 @@ def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
         if (_HAS_PLTPU and not interpret) else None,
         cost_estimate=pl.CostEstimate(
             flops=4.0 * B * H * D * L,
-            bytes_accessed=float(2 * B * L * KVH * D * esize),
+            bytes_accessed=float(2 * B * L * KVH * D * esize
+                                 + scale_bytes),
             transcendentals=float(B * H * L)),
         interpret=interpret,
         name=KERNEL_NAME,
-    )(block_table, positions, q, cos_b, sin_b, k_pool, v_pool)
+    )(block_table, positions, *operands)
     return acc, m_b[..., 0], l_b[..., 0]
 
 
@@ -206,16 +257,24 @@ def _pallas_partials(q_rot_unused, q, cos_b, sin_b, k_pool, v_pool,
 # ---------------------------------------------------------------------------
 
 def _xla_partials(q_rot, k_pool, v_pool, block_table, positions,
-                  num_splits):
+                  num_splits, k_scale=None, v_scale=None, kv_dtype=None):
     """Same split-K partials in plain XLA: q_rot is the ROTATED and
     pre-scaled [B, KVH, rep, D] f32 query (scale folded in, exactly as
-    the kernel does at p == 0)."""
+    the kernel does at p == 0).  Quantized pools dequant at the gather
+    with the IDENTICAL codes * per-row-scale f32 multiply the kernel
+    fuses into its block DMA, so CPU covers the exact served math."""
     B = q_rot.shape[0]
     bs = k_pool.shape[1]
     nbs = block_table.shape[1]
     Lp = (nbs // num_splits) * bs                       # keys per split
-    kb = k_pool[block_table].astype(jnp.float32)        # [B,nbs,bs,KVH,D]
-    vb = v_pool[block_table].astype(jnp.float32)
+    if kv_dtype is not None:
+        kb = decode_codes(k_pool[block_table], kv_dtype) \
+            * k_scale[block_table][..., None, None]     # [B,nbs,bs,KVH,D]
+        vb = decode_codes(v_pool[block_table], kv_dtype) \
+            * v_scale[block_table][..., None, None]
+    else:
+        kb = k_pool[block_table].astype(jnp.float32)    # [B,nbs,bs,KVH,D]
+        vb = v_pool[block_table].astype(jnp.float32)
     kb = kb.reshape(B, num_splits, Lp, kb.shape[3], kb.shape[4])
     vb = vb.reshape(B, num_splits, Lp, vb.shape[3], vb.shape[4])
     scores = jnp.einsum("bkrd,bslkd->bskrl", q_rot, kb,
@@ -324,7 +383,8 @@ def autotune_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
 
 def fused_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
                        positions, cos, sin, *, num_splits=None,
-                       use_pallas=None, interpret=None):
+                       use_pallas=None, interpret=None,
+                       k_scale=None, v_scale=None, kv_cache_dtype=None):
     """One fused decode step of paged attention.
 
     q: [B, 1, H, D] UNROTATED queries; k_new/v_new: [B, 1, KVH, D]
@@ -340,6 +400,13 @@ def fused_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
     frontier and are masked off).  On TPU the gather + q-RoPE +
     attention is one Pallas kernel; elsewhere the numerically-identical
     XLA split-K lowering runs instead.
+
+    Quantized pools (``kv_cache_dtype`` = ``"int8"``/``"fp8"``,
+    kernels/kv_quant): ``k_pool``/``v_pool`` hold int8 codes and
+    ``k_scale``/``v_scale`` the [nb, bs] per-row f32 absmax scales.
+    The new token quantizes at write and dequant fuses into the block
+    DMA; the return grows to (attn_out, new_k_pool, new_v_pool,
+    new_k_scale, new_v_scale).
     """
     from ..core.flags import flag
 
@@ -369,36 +436,57 @@ def fused_paged_decode(q, k_new, v_new, k_pool, v_pool, block_table,
         num_splits = _default_splits(nbs)
 
     # per-sequence RoPE rows + scatter of the rotated new token (tiny:
-    # B rows — XLA prologue shared verbatim by both lowerings)
+    # B rows — XLA prologue shared verbatim by both lowerings).  A
+    # quantized pool quantizes the token's row here, at write time,
+    # inside the traced step.
     c = cos[positions]                                  # [B, half] f32
     s = sin[positions]
     k_rot = _rotate_half(k_new[:, 0].astype(jnp.float32),
                          c[:, None, :], s[:, None, :]).astype(k_new.dtype)
-    new_k_pool = _scatter_token(k_pool, k_rot, block_table, positions)
-    new_v_pool = _scatter_token(v_pool, v_new[:, 0], block_table,
-                                positions)
+    if kv_cache_dtype is not None:
+        new_k_pool, new_k_scale = _scatter_token_quant(
+            k_pool, k_scale, k_rot, block_table, positions,
+            kv_cache_dtype)
+        new_v_pool, new_v_scale = _scatter_token_quant(
+            v_pool, v_scale, v_new[:, 0], block_table, positions,
+            kv_cache_dtype)
+    else:
+        new_k_pool = _scatter_token(k_pool, k_rot, block_table, positions)
+        new_v_pool = _scatter_token(v_pool, v_new[:, 0], block_table,
+                                    positions)
+        new_k_scale = new_v_scale = None
 
     q_g = q[:, 0].reshape(B, KVH, rep, D)               # GQA grouping
     if use_pallas:
         acc, m, l = _pallas_partials(
             None, q_g, c, s, new_k_pool, new_v_pool, block_table,
-            positions, num_splits, scale, interpret)
+            positions, num_splits, scale, interpret,
+            k_scale=new_k_scale, v_scale=new_v_scale,
+            kv_dtype=kv_cache_dtype)
     else:
         q_rot = _rotate_half(q_g.astype(jnp.float32),
                              c[:, None, None, :],
                              s[:, None, None, :]) * scale
         acc, m, l = _xla_partials(q_rot, new_k_pool, new_v_pool,
-                                  block_table, positions, num_splits)
+                                  block_table, positions, num_splits,
+                                  k_scale=new_k_scale,
+                                  v_scale=new_v_scale,
+                                  kv_dtype=kv_cache_dtype)
     out = _combine_splits(acc, m, l)                    # [B,KVH,rep,D]
-    return (out.reshape(B, 1, H, D).astype(q.dtype),
-            new_k_pool, new_v_pool)
+    out = out.reshape(B, 1, H, D).astype(q.dtype)
+    if kv_cache_dtype is not None:
+        return out, new_k_pool, new_v_pool, new_k_scale, new_v_scale
+    return out, new_k_pool, new_v_pool
 
 
 def paged_decode_reference(q, k_new, v_new, k_pool, v_pool, block_table,
-                           positions, cos, sin):
+                           positions, cos, sin, *, k_scale=None,
+                           v_scale=None, kv_cache_dtype=None):
     """The UNFUSED scatter/gather decode math of models/llama.py's
     paged branch (rope gather path, full-buffer masked softmax) — the
-    parity oracle for both fused lowerings."""
+    parity oracle for both fused lowerings.  With a quantized pool it
+    quantizes the write and dequantizes the WHOLE gathered view up
+    front (the naive two-pass the fused path avoids)."""
     B, T, H, D = q.shape
     positions = jnp.asarray(positions, jnp.int32)
     pos = positions[:, None] + jnp.arange(T)            # [B, 1]
@@ -406,10 +494,21 @@ def paged_decode_reference(q, k_new, v_new, k_pool, v_pool, block_table,
     s = sin[pos][:, :, None, :]
     q_r = _rotate_half(q.astype(jnp.float32), c, s).astype(q.dtype)
     k_r = _rotate_half(k_new.astype(jnp.float32), c, s).astype(k_new.dtype)
-    kp = _scatter_token(k_pool, k_r[:, 0], block_table, positions)
-    vp = _scatter_token(v_pool, v_new[:, 0], block_table, positions)
-    kb = kp[block_table].reshape(B, -1, kp.shape[2], kp.shape[3])
-    vb = vp[block_table].reshape(B, -1, vp.shape[2], vp.shape[3])
+    if kv_cache_dtype is not None:
+        kp, ks = _scatter_token_quant(k_pool, k_scale, k_r[:, 0],
+                                      block_table, positions,
+                                      kv_cache_dtype)
+        vp, vs = _scatter_token_quant(v_pool, v_scale, v_new[:, 0],
+                                      block_table, positions,
+                                      kv_cache_dtype)
+        kd = decode_codes(kp, kv_cache_dtype) * ks[:, :, None, None]
+        vd = decode_codes(vp, kv_cache_dtype) * vs[:, :, None, None]
+    else:
+        kp = _scatter_token(k_pool, k_r[:, 0], block_table, positions)
+        vp = _scatter_token(v_pool, v_new[:, 0], block_table, positions)
+        kd, vd = kp, vp
+    kb = kd[block_table].reshape(B, -1, kp.shape[2], kp.shape[3])
+    vb = vd[block_table].reshape(B, -1, vp.shape[2], vp.shape[3])
     rep = H // kb.shape[2]
     if rep > 1:
         kb = jnp.repeat(kb, rep, axis=2)
@@ -431,7 +530,10 @@ def paged_decode_reference(q, k_new, v_new, k_pool, v_pool, block_table,
 
 def _paged_decode_cost(in_avals, out_avals):
     # operand order fixed by _pallas_partials:
-    # (block_table, positions, q, cos, sin, k_pool, v_pool)
+    # (block_table, positions, q, cos, sin, k_pool, v_pool
+    #  [, k_scale, v_scale])  — the two trailing scale operands mark a
+    # QUANTIZED pool (kernels/kv_quant), whose int8 element size flows
+    # through ``esize`` below so the roofline prices quantized bytes
     bt_shape = in_avals[0][0]
     q_shape, q_dtype = in_avals[2][0], in_avals[2][1]
     pool_shape, pool_dtype = in_avals[5][0], in_avals[5][1]
@@ -448,9 +550,14 @@ def _paged_decode_cost(in_avals, out_avals):
     # the pools are read THROUGH the block table: B*L rows each, not
     # the whole pool allocation
     kv_bytes = 2.0 * B * L * KVH * D * esize
+    if len(in_avals) > 7:                               # quantized pool
+        # one f32 absmax per (pool, token) row streams with its block
+        kv_bytes += 2.0 * B * L * np.dtype(in_avals[7][1]).itemsize
     out_bytes = sum(
         float(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
         for shape, dt in out_avals)
+    # compute dtype stays q's (the kernel dequantizes to f32 for the
+    # dots); the QUANTIZED width is already priced into kv_bytes
     return KernelCost(flops=flops, bytes_accessed=in_bytes + kv_bytes
                       + out_bytes, transcendentals=trans,
                       dtype=str(q_dtype))

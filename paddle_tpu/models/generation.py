@@ -314,7 +314,37 @@ def make_prefill_step(model):
     return step
 
 
-def make_paged_decode_step(model, fused=None):
+def _wrap_paged(pools, block_tables, kv_dtype):
+    """Pool entries -> PagedKVCache views: (k, v) tuples for full-
+    precision pools, (k, v, k_scale, v_scale) for quantized ones
+    (serving/cache.py BlockKVPool.layers).  Called at TRACE time only —
+    the branch is on the build-time kv_dtype constant, never a traced
+    value, and lives outside the H106-audited step source."""
+    from .llama import PagedKVCache
+
+    if kv_dtype is not None:
+        return [PagedKVCache(k, v, block_tables, ks, vs,
+                             kv_dtype=kv_dtype)
+                for k, v, ks, vs in pools]
+    return [PagedKVCache(k, v, block_tables) for k, v in pools]
+
+
+def _unwrap_paged(caches, kv_dtype):
+    """Inverse of :func:`_wrap_paged`: repack updated cache views into
+    pool-entry tuples for the engine to rebind."""
+    if kv_dtype is not None:
+        return [(c.k, c.v, c.k_scale, c.v_scale) for c in caches]
+    return [(c.k, c.v) for c in caches]
+
+
+def _kv_dtype_suffix(kv_dtype):
+    """Cache-attr / step-kind suffix: fp32 and quantized engines must
+    never share a cached compiled step (their pool treedefs differ, so
+    a shared attr would guarantee a retrace on the second engine)."""
+    return f"_{kv_dtype}" if kv_dtype is not None else ""
+
+
+def make_paged_decode_step(model, fused=None, kv_cache_dtype=None):
     """The continuous-batching decode step: one token for a BUCKET of
     sequences, each at its own position, over the shared block-pool
     cache (models/llama.py PagedKVCache).  step(tok[B,1] int32, pools
@@ -328,41 +358,50 @@ def make_paged_decode_step(model, fused=None):
     kernel + RMSNorm epilogues (XLA fallback off-TPU), False forces the
     unfused reference path, None resolves FLAGS_use_fused_serving once
     at build time.  The mode is baked into the trace, so fused and
-    unfused steps are distinct cached executables."""
+    unfused steps are distinct cached executables.
+
+    ``kv_cache_dtype`` (None / "int8" / "fp8") selects quantized pool
+    entries: pools become [(k, v, k_scale, v_scale)] per layer, writes
+    quantize in-trace and reads dequantize at the kernel DMA boundary
+    (kernels/kv_quant.py).  Like ``fused``, the dtype is baked into the
+    attr/kind so mixed-precision engines over one model never collide
+    on a cached step."""
     from ..kernels.fusion import resolve_serving_fusion, serving_fusion
+    from ..kernels.kv_quant import resolve_kv_cache_dtype
 
     fused = resolve_serving_fusion(fused)
-    attr = "_paged_decode_step_fused" if fused else "_paged_decode_step"
+    kv_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
+    attr = ("_paged_decode_step_fused" if fused
+            else "_paged_decode_step") + _kv_dtype_suffix(kv_dtype)
     step = getattr(model, attr, None)
     if step is not None and _fingerprint_matches(
             model, getattr(model, attr + "_fp", None)):
         return step
     fp = _weights_fingerprint(model)
 
-    from .llama import PagedKVCache
-
     from ..core.dispatch import no_grad_ctx
 
     # resolved OUTSIDE the step: its source is AST-audited (H106) and a
     # build-time ternary must not read as per-token Python branching
-    kind = "paged_decode_fused" if fused else "paged_decode"
+    kind = ("paged_decode_fused" if fused else "paged_decode") \
+        + _kv_dtype_suffix(kv_dtype)
 
     @jax.jit
     @functools.partial(register_decode_step, kind=kind)
     def step(tok, pools, block_tables, lengths):
         with no_grad_ctx(), serving_fusion(fused):
-            wrapped = [PagedKVCache(k, v, block_tables) for k, v in pools]
+            wrapped = _wrap_paged(pools, block_tables, kv_dtype)
             logits, new_caches = model(Tensor(tok), caches=wrapped,
                                        position_offset=lengths)
             return (logits._value[:, -1].astype(jnp.float32),
-                    [(c.k, c.v) for c in new_caches])
+                    _unwrap_paged(new_caches, kv_dtype))
 
     setattr(model, attr, step)
     setattr(model, attr + "_fp", fp)
     return step
 
 
-def make_chunked_prefill_step(model, fused=None):
+def make_chunked_prefill_step(model, fused=None, kv_cache_dtype=None):
     """Chunked prefill straight into the paged block pool: ONE fixed
     chunk shape serves every prompt length, so prefill compiles O(1)
     programs instead of one per length bucket (each bucket was a new
@@ -389,31 +428,36 @@ def make_chunked_prefill_step(model, fused=None):
     fused block-gather + online-softmax kernel
     (kernels/chunked_prefill — mined by analysis/fusionminer as the #1
     remaining candidate); padded positions still scatter to the garbage
-    block and mask off exactly as on the gather path."""
+    block and mask off exactly as on the gather path.
+
+    ``kv_cache_dtype`` selects quantized pool entries exactly as in
+    :func:`make_paged_decode_step` (padded positions scatter their
+    garbage CODES + scale into block 0 the same way)."""
     from ..kernels.fusion import resolve_serving_fusion, serving_fusion
+    from ..kernels.kv_quant import resolve_kv_cache_dtype
 
     fused = resolve_serving_fusion(fused)
-    attr = "_chunked_prefill_step_fused" if fused \
-        else "_chunked_prefill_step"
+    kv_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
+    attr = ("_chunked_prefill_step_fused" if fused
+            else "_chunked_prefill_step") + _kv_dtype_suffix(kv_dtype)
     step = getattr(model, attr, None)
     if step is not None and _fingerprint_matches(
             model, getattr(model, attr + "_fp", None)):
         return step
     fp = _weights_fingerprint(model)
 
-    from .llama import PagedKVCache
-
     from ..core.dispatch import no_grad_ctx
 
     # see make_paged_decode_step: keep the build-time ternary out of
     # the H106-audited step source
-    kind = "chunked_prefill_fused" if fused else "chunked_prefill"
+    kind = ("chunked_prefill_fused" if fused else "chunked_prefill") \
+        + _kv_dtype_suffix(kv_dtype)
 
     @jax.jit
     @functools.partial(register_decode_step, kind=kind)
     def step(ids, pools, block_table, start, last_index):
         with no_grad_ctx(), serving_fusion(fused):
-            wrapped = [PagedKVCache(k, v, block_table) for k, v in pools]
+            wrapped = _wrap_paged(pools, block_table, kv_dtype)
             valid = (jnp.arange(ids.shape[1]) <= last_index)[None, :]
             logits, new_caches = model(Tensor(ids),
                                        attn_mask=Tensor(valid),
@@ -422,7 +466,7 @@ def make_chunked_prefill_step(model, fused=None):
             last = jax.lax.dynamic_index_in_dim(
                 logits._value, last_index, axis=1, keepdims=False)
             return (last.astype(jnp.float32),
-                    [(c.k, c.v) for c in new_caches])
+                    _unwrap_paged(new_caches, kv_dtype))
 
     setattr(model, attr, step)
     setattr(model, attr + "_fp", fp)

@@ -165,25 +165,39 @@ class PagedKVCache:
     Unallocated/retired table entries may point anywhere (the engine uses
     a reserved garbage block): attention masks keys past each sequence's
     frontier, so stale pool contents are never observable.
+
+    Quantized pools (``kv_dtype`` of ``"int8"``/``"fp8"``) carry int8
+    CODE pools plus per-(block, token)-row f32 absmax scales
+    (``k_scale``/``v_scale`` [num_blocks, block_size]); writes quantize
+    in-trace and reads dequantize at the kernel DMA boundary
+    (kernels/kv_quant.py).  ``kv_dtype`` is pytree aux data, so fp32
+    and quantized caches trace as DIFFERENT treedefs and can never
+    silently share a compiled step.
     """
 
-    __slots__ = ("k", "v", "block_table")
+    __slots__ = ("k", "v", "block_table", "k_scale", "v_scale",
+                 "kv_dtype")
 
-    def __init__(self, k, v, block_table):
+    def __init__(self, k, v, block_table, k_scale=None, v_scale=None,
+                 kv_dtype=None):
         self.k = k              # [num_blocks, block_size, kv_heads, head_dim]
         self.v = v
         self.block_table = block_table      # [B, max_blocks] int32
+        self.k_scale = k_scale  # [num_blocks, block_size] f32 or None
+        self.v_scale = v_scale
+        self.kv_dtype = kv_dtype            # None / "int8" / "fp8"
 
     @property
     def block_size(self):
         return self.k.shape[1]
 
     def tree_flatten(self):
-        return (self.k, self.v, self.block_table), None
+        return (self.k, self.v, self.block_table, self.k_scale,
+                self.v_scale), self.kv_dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(*children, kv_dtype=aux)
 
 
 jax.tree_util.register_pytree_node(
@@ -287,16 +301,31 @@ class LlamaAttention(nn.Layer):
                 bt = cache.block_table
                 offs = jnp.asarray(position_offset)
 
-                def _fused_decode(qv, kv, vv, kp, vp):
+                def _fused_decode(qv, kv, vv, kp, vp, *scales):
                     from ..kernels.paged_attention import fused_paged_decode
 
+                    ks, vs = scales if scales else (None, None)
                     return fused_paged_decode(qv, kv, vv, kp, vp, bt,
-                                              offs, cos, sin)
+                                              offs, cos, sin,
+                                              k_scale=ks, v_scale=vs,
+                                              kv_cache_dtype=cache.kv_dtype)
 
-                out, k_pool, v_pool = apply(
-                    "fused_paged_attention", _fused_decode, q, k, v,
-                    Tensor(cache.k), Tensor(cache.v))
-                new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
+                if cache.kv_dtype is not None:
+                    # quantized pools: the kernel scatter-quantizes the
+                    # new token's row and returns updated scale sidecars
+                    out, k_pool, v_pool, k_sc, v_sc = apply(
+                        "fused_paged_attention", _fused_decode, q, k, v,
+                        Tensor(cache.k), Tensor(cache.v),
+                        Tensor(cache.k_scale), Tensor(cache.v_scale))
+                    new_cache = PagedKVCache(
+                        k_pool._value, v_pool._value, bt,
+                        k_sc._value, v_sc._value, kv_dtype=cache.kv_dtype)
+                else:
+                    out, k_pool, v_pool = apply(
+                        "fused_paged_attention", _fused_decode, q, k, v,
+                        Tensor(cache.k), Tensor(cache.v))
+                    new_cache = PagedKVCache(k_pool._value, v_pool._value,
+                                             bt)
                 out = out.reshape([B, T, -1])
                 return self.o_proj(out), new_cache
 
@@ -353,9 +382,45 @@ class LlamaAttention(nn.Layer):
                                 new.shape[3]).astype(pool.dtype))
                 return flat.reshape(pool.shape)
 
-            k_pool = apply("paged_kv_update", _scatter, Tensor(cache.k), k)
-            v_pool = apply("paged_kv_update", _scatter, Tensor(cache.v), v)
-            new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
+            k_sc = v_sc = None
+            if cache.kv_dtype is not None:
+                # quantize-at-write: codes and per-row scales scatter
+                # through the SAME flat index (padded rows land their
+                # code+scale in garbage block 0, masked from attention)
+                def _scatter_q(pool, scales, new):
+                    from ..kernels.kv_quant import quantize_kv
+
+                    nb = pool.shape[0]
+                    rows = jnp.arange(bt.shape[0])[:, None]
+                    col = jnp.minimum(pos // bs, bt.shape[1] - 1)
+                    idx = bt[rows, col] * bs + pos % bs     # [B, T]
+                    if wmask is not None:
+                        idx = jnp.where(wmask, idx, 0)
+                    codes, sc = quantize_kv(new, cache.kv_dtype)
+                    flat = pool.reshape(nb * bs, pool.shape[2],
+                                        pool.shape[3])
+                    flat = flat.at[idx.reshape(-1)].set(
+                        codes.reshape(-1, codes.shape[2], codes.shape[3]))
+                    sflat = scales.reshape(nb * bs).at[
+                        idx.reshape(-1)].set(sc.reshape(-1))
+                    return flat.reshape(pool.shape), \
+                        sflat.reshape(scales.shape)
+
+                k_pool, k_sc = apply("paged_kv_update_quant", _scatter_q,
+                                     Tensor(cache.k),
+                                     Tensor(cache.k_scale), k)
+                v_pool, v_sc = apply("paged_kv_update_quant", _scatter_q,
+                                     Tensor(cache.v),
+                                     Tensor(cache.v_scale), v)
+                new_cache = PagedKVCache(k_pool._value, v_pool._value,
+                                         bt, k_sc._value, v_sc._value,
+                                         kv_dtype=cache.kv_dtype)
+            else:
+                k_pool = apply("paged_kv_update", _scatter,
+                               Tensor(cache.k), k)
+                v_pool = apply("paged_kv_update", _scatter,
+                               Tensor(cache.v), v)
+                new_cache = PagedKVCache(k_pool._value, v_pool._value, bt)
 
             if T > 1:
                 from ..distributed.mesh import get_mesh
@@ -371,26 +436,42 @@ class LlamaAttention(nn.Layer):
                     # kernel (XLA fallback off-TPU) — the #1 candidate
                     # mined by analysis/fusionminer on the fused
                     # prefill trace
-                    def _fused_chunk(qv, kp, vp):
+                    def _fused_chunk(qv, kp, vp, *scales):
                         from ..kernels.chunked_prefill import \
                             fused_chunked_attention
 
-                        return fused_chunked_attention(qv, kp, vp, bt,
-                                                       offsets)
+                        ks, vs = scales if scales else (None, None)
+                        return fused_chunked_attention(
+                            qv, kp, vp, bt, offsets, k_scale=ks,
+                            v_scale=vs, kv_cache_dtype=cache.kv_dtype)
 
+                    chunk_args = (q, k_pool, v_pool)
+                    if cache.kv_dtype is not None:
+                        chunk_args += (k_sc, v_sc)
                     out = apply("fused_chunked_attention", _fused_chunk,
-                                q, k_pool, v_pool)
+                                *chunk_args)
                     out = out.reshape([B, T, -1])
                     return self.o_proj(out), new_cache
 
-            def _paged_attn(qv, kp, vp):
+            def _paged_attn(qv, kp, vp, *scales):
                 # contiguous per-sequence views of the block pool: the
                 # same full-buffer masked attention as the static cache,
-                # just gathered through the block table
-                kb = kp[bt].reshape(bt.shape[0], -1, kp.shape[2],
-                                    kp.shape[3])
-                vb = vp[bt].reshape(bt.shape[0], -1, vp.shape[2],
-                                    vp.shape[3])
+                # just gathered through the block table (quantized
+                # pools dequantize the gathered copy — this is the
+                # unfused parity oracle for the fused kernels)
+                kb, vb = kp[bt], vp[bt]         # [B, nbs, bs, kvh, hd]
+                if cache.kv_dtype is not None:
+                    from ..kernels.kv_quant import decode_codes
+
+                    ksc, vsc = scales
+                    kb = (decode_codes(kb, cache.kv_dtype)
+                          * ksc[bt][..., None, None]).astype(qv.dtype)
+                    vb = (decode_codes(vb, cache.kv_dtype)
+                          * vsc[bt][..., None, None]).astype(qv.dtype)
+                kb = kb.reshape(bt.shape[0], -1, kp.shape[2],
+                                kp.shape[3])
+                vb = vb.reshape(bt.shape[0], -1, vp.shape[2],
+                                vp.shape[3])
                 rep = qv.shape[2] // kb.shape[2]
                 if rep > 1:
                     kb = jnp.repeat(kb, rep, axis=2)
@@ -406,7 +487,10 @@ class LlamaAttention(nn.Layer):
                 probs = jax.nn.softmax(scores, axis=-1).astype(qv.dtype)
                 return jnp.einsum("bhts,bshd->bthd", probs, vb)
 
-            out = apply("paged_attention", _paged_attn, q, k_pool, v_pool)
+            attn_args = (q, k_pool, v_pool)
+            if cache.kv_dtype is not None:
+                attn_args += (k_sc, v_sc)
+            out = apply("paged_attention", _paged_attn, *attn_args)
             out = out.reshape([B, T, -1])
             return self.o_proj(out), new_cache
 

@@ -51,6 +51,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.kv_quant import (kv_bytes_per_element,
+                                kv_scale_bytes_per_block,
+                                kv_storage_dtype, resolve_kv_cache_dtype)
+
 
 class PoolExhausted(Exception):
     """No free or evictable blocks: the caller must preempt or wait."""
@@ -59,7 +63,8 @@ class PoolExhausted(Exception):
 class BlockKVPool:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 kv_cache_dtype: Optional[str] = None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the reserved "
                              "garbage sink)")
@@ -68,13 +73,30 @@ class BlockKVPool:
         self.block_size = block_size
         self.kv_heads = kv_heads
         self.head_dim = head_dim
-        self.dtype = dtype
+        #: quant scheme: None (full precision) / "int8" / "fp8"
+        self.kv_cache_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
+        #: the MODEL's kv dtype (what dequant produces / fp32 pools hold)
+        self.model_dtype = dtype
+        #: the STORAGE dtype the pool arrays actually carry
+        self.dtype = kv_storage_dtype(self.kv_cache_dtype) or dtype
         self.enable_prefix_cache = enable_prefix_cache
-        z = jnp.zeros((num_blocks, block_size, kv_heads, head_dim), dtype)
-        # per-layer (k, v) physical pools — the arrays handed to the
-        # compiled decode step and rebound to its outputs every token
-        self.layers: List[Tuple[jax.Array, jax.Array]] = [
-            (z, z) for _ in range(num_layers)]
+        # content-hash chains are seeded with the dtype tag, so an int8
+        # pool can never match blocks registered under an fp32 config
+        # (or the other scheme) — the seed IS the namespace
+        self._hash_seed = self.kv_dtype_tag.encode()
+        z = jnp.zeros((num_blocks, block_size, kv_heads, head_dim),
+                      self.dtype)
+        # per-layer physical pools — the arrays handed to the compiled
+        # decode step and rebound to its outputs every token.  Entries
+        # are (k, v) for full-precision pools and (k, v, k_scale,
+        # v_scale) for quantized ones: int8 code pools plus one f32
+        # absmax scale per (block, token) row (kernels/kv_quant.py)
+        if self.kv_cache_dtype is not None:
+            s = jnp.ones((num_blocks, block_size), jnp.float32)
+            self.layers: List[Tuple[jax.Array, ...]] = [
+                (z, z, s, s) for _ in range(num_layers)]
+        else:
+            self.layers = [(z, z) for _ in range(num_layers)]
         # LIFO free list over blocks 1..n-1 (block 0 reserved)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         # block id -> set of owning request ids (refcount = len)
@@ -117,6 +139,56 @@ class BlockKVPool:
 
     def utilization(self) -> float:
         return self.num_used / self.capacity_blocks
+
+    # --------------------------------------------------- byte accounting
+    @property
+    def kv_dtype_tag(self) -> str:
+        """Stable string identity of this pool's KV storage format —
+        the prefix-cache hash namespace and the router's fleet-dtype
+        key (``"int8"``, ``"fp8"``, or ``"fp32:<model dtype>"``)."""
+        if self.kv_cache_dtype is not None:
+            return self.kv_cache_dtype
+        return f"fp32:{jnp.dtype(self.model_dtype).name}"
+
+    @staticmethod
+    def block_bytes_for(num_layers: int, block_size: int, kv_heads: int,
+                        head_dim: int, dtype=jnp.float32,
+                        kv_cache_dtype: Optional[str] = None) -> int:
+        """HBM bytes ONE logical block costs across all layers (k and v
+        pools plus quantized scale sidecars) — computable before the
+        pool exists, so the engine can size ``num_blocks`` from a fixed
+        ``kv_pool_bytes`` budget per dtype."""
+        scheme = resolve_kv_cache_dtype(kv_cache_dtype)
+        esize = kv_bytes_per_element(scheme, dtype)
+        per_side = block_size * kv_heads * head_dim * esize \
+            + kv_scale_bytes_per_block(block_size, scheme)
+        return int(num_layers * 2 * per_side)
+
+    def block_bytes(self) -> int:
+        """HBM bytes one block costs in THIS pool (all layers, k + v,
+        including quantized scale rows)."""
+        return self.block_bytes_for(self.num_layers, self.block_size,
+                                    self.kv_heads, self.head_dim,
+                                    self.model_dtype, self.kv_cache_dtype)
+
+    def capacity_bytes(self) -> int:
+        return self.capacity_blocks * self.block_bytes()
+
+    def used_bytes(self) -> int:
+        """Bytes referenced by live requests — the quantity degradation
+        watermarks compare against :meth:`capacity_bytes` (a quantized
+        pool burns ~4x fewer bytes per resident token, so the ladder
+        engages later at the same request load)."""
+        return self.num_used * self.block_bytes()
+
+    def byte_utilization(self) -> float:
+        """Fraction of the pool's KV byte capacity referenced by live
+        requests.  Blocks are homogeneous within one pool so this equals
+        :meth:`utilization` numerically, but it is the BYTE-denominated
+        pressure signal: two pools sized from the same ``kv_pool_bytes``
+        budget at different dtypes report comparable pressure per byte,
+        not per block."""
+        return self.used_bytes() / self.capacity_bytes()
 
     def blocks_for(self, num_tokens: int) -> int:
         """Blocks needed to hold ``num_tokens`` cache positions."""
@@ -259,7 +331,7 @@ class BlockKVPool:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         bs = self.block_size
         out: List[bytes] = []
-        parent = b""
+        parent = self._hash_seed
         for i in range(len(tokens) // bs):
             parent = self._chain_hash(parent, tokens[i * bs:(i + 1) * bs])
             out.append(parent)
@@ -345,7 +417,7 @@ class BlockKVPool:
     def _copy_block(self, src: int, dst: int):
         new = _copy_block_impl(tuple(self.layers), np.int32(src),
                                np.int32(dst))
-        self.layers = [(k, v) for k, v in new]
+        self.layers = [tuple(entry) for entry in new]
 
     def admission_plan(self, tokens, extra_tokens: int = 1):
         """Admission-control view of one prompt: ``(matched_blocks,
@@ -369,6 +441,11 @@ class BlockKVPool:
             "utilization": round(self.utilization(), 4),
             "prefix_evictions": self.evictions,
             "cow_copies": self.cow_copies,
+            "kv_dtype": self.kv_dtype_tag,
+            "block_bytes": self.block_bytes(),
+            "used_bytes": self.used_bytes(),
+            "capacity_bytes": self.capacity_bytes(),
+            "byte_utilization": round(self.byte_utilization(), 4),
         }
 
     def prefix_summary(self, max_roots: int = 8) -> dict:
@@ -385,6 +462,7 @@ class BlockKVPool:
         roots = [h.hex() for h in reversed(self._roots)]
         return {
             "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype_tag,
             "cached_blocks": self.num_cached,
             "indexed_blocks": len(self._hash_index),
             "roots": roots[:max_roots],
@@ -394,6 +472,8 @@ class BlockKVPool:
 
 @jax.jit
 def _copy_block_impl(layers, src, dst):
-    # one executable per pool geometry: src/dst ride in as traced scalars
-    return [(k.at[dst].set(k[src]), v.at[dst].set(v[src]))
-            for k, v in layers]
+    # one executable per pool geometry: src/dst ride in as traced
+    # scalars.  Entries are (k, v) or (k, v, k_scale, v_scale) — a CoW
+    # copy of a quantized block must move the scale rows with the codes
+    return [tuple(a.at[dst].set(a[src]) for a in entry)
+            for entry in layers]

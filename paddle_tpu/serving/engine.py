@@ -158,6 +158,27 @@ class ServingConfig:
     # scanned program) + target-verify ([S, K+1], one chunked-shaped
     # program) with on-device acceptance and block-granular KV rollback.
     speculative: Any = None
+    # ---- quantized serving (ISSUE 20; kernels/kv_quant) ----
+    # KV-cache storage dtype: None serves full precision; "int8"/"fp8"
+    # store the paged pools as int8 codes + per-(block, token)-row f32
+    # absmax scales, quantizing at KV-write time inside the traced
+    # steps and dequantizing at the attention kernels' DMA boundary.
+    # The prefix-cache hash chain is namespaced by this dtype, so a
+    # quantized pool never matches fp32-registered blocks.
+    kv_cache_dtype: Optional[str] = None
+    # weight-only quantization: "int8" converts every Column/Row-
+    # parallel linear to absmax per-out-channel int8 codes dequantized
+    # in the matmul prologue (paddle_tpu/quantization/serving.py) —
+    # the paddle Int8Linear inference analog.  Applied IN PLACE to the
+    # model at engine construction, before the steps trace.
+    weight_dtype: Optional[str] = None
+    # fixed KV HBM budget: when set, ``num_blocks`` is DERIVED as
+    # kv_pool_bytes // pool-block-bytes (dtype-aware, scale sidecars
+    # included).  The like-for-like capacity knob behind the int8-vs-
+    # fp32 occupancy/goodput comparison: same bytes, ~4x the blocks at
+    # int8, so the degradation ladder engages later under the same
+    # burst.
+    kv_pool_bytes: Optional[int] = None
 
 
 class Engine:
@@ -165,8 +186,17 @@ class Engine:
     cache contract of models/llama.py (StaticKVCache + PagedKVCache)."""
 
     def __init__(self, model, config: Optional[ServingConfig] = None):
+        from ..kernels.kv_quant import resolve_kv_cache_dtype
+
         self.model = model
         self.config = cfg = config or ServingConfig()
+        self.kv_cache_dtype = resolve_kv_cache_dtype(cfg.kv_cache_dtype)
+        if cfg.weight_dtype:
+            # in place, idempotent, BEFORE the steps trace (they capture
+            # the weights as jit constants)
+            from ..quantization.serving import quantize_model_weights
+
+            quantize_model_weights(model, cfg.weight_dtype)
         kv_heads, head_dim, dtype = _cache_dims(model)
         model_max = getattr(model.config, "max_position_embeddings", None)
         self.max_model_len = min(
@@ -197,13 +227,40 @@ class Engine:
                     f"draft max_position_embeddings ({draft_max}) < "
                     f"max_model_len ({self.max_model_len})")
             num_layers += spec.draft_model.config.num_hidden_layers
+        if spec is not None and self.kv_cache_dtype is not None:
+            raise ValueError(
+                "speculative decoding with a quantized KV cache is not "
+                "supported yet (the draft/verify rollback paths assume "
+                "full-precision pool entries); drop kv_cache_dtype or "
+                "speculative")
+        # fixed-HBM sizing: a kv_pool_bytes budget derives num_blocks
+        # from the per-dtype block bytes (quantized pools fit ~4x the
+        # blocks in the same budget — the occupancy headline)
+        self.num_blocks = cfg.num_blocks
+        if cfg.kv_pool_bytes is not None:
+            per_block = BlockKVPool.block_bytes_for(
+                num_layers, cfg.block_size, kv_heads, head_dim, dtype,
+                self.kv_cache_dtype)
+            self.num_blocks = int(cfg.kv_pool_bytes) // per_block
+            if self.num_blocks < 2:
+                raise ValueError(
+                    f"kv_pool_bytes={cfg.kv_pool_bytes} fits only "
+                    f"{self.num_blocks} block(s) of {per_block} bytes; "
+                    "need >= 2 (block 0 is the reserved garbage sink)")
         self.pool = BlockKVPool(
-            num_layers, cfg.num_blocks, cfg.block_size,
+            num_layers, self.num_blocks, cfg.block_size,
             kv_heads, head_dim, dtype,
-            enable_prefix_cache=cfg.enable_prefix_cache)
+            enable_prefix_cache=cfg.enable_prefix_cache,
+            kv_cache_dtype=self.kv_cache_dtype)
         self.scheduler = Scheduler(self.pool,
                                    max_queue_len=cfg.max_queue_len)
         self.metrics = ServingMetrics()
+        from ..kernels.kv_quant import (kv_pool_dtype_code,
+                                        kv_scale_bytes_per_block)
+
+        self.metrics.on_kv_cache_config(
+            kv_pool_dtype_code(self.kv_cache_dtype),
+            kv_scale_bytes_per_block(cfg.block_size, self.kv_cache_dtype))
         self.overload = OverloadController(cfg, self.metrics)
         S = cfg.max_batch_size
         self._slots: List[Optional[Request]] = [None] * S
@@ -237,15 +294,18 @@ class Engine:
         # [1, chunk_tokens] shape for EVERY prompt length, where the old
         # bucketed prefill compiled one program per length bucket.
         self._decode_step = warn_on_retrace(
-            make_paged_decode_step(model, fused=cfg.fused_kernels),
+            make_paged_decode_step(model, fused=cfg.fused_kernels,
+                                   kv_cache_dtype=self.kv_cache_dtype),
             after=1, label="serving::decode_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
         self._prefill_step = warn_on_retrace(
-            make_chunked_prefill_step(model, fused=cfg.fused_kernels),
+            make_chunked_prefill_step(model, fused=cfg.fused_kernels,
+                                      kv_cache_dtype=self.kv_cache_dtype),
             after=1, label="serving::prefill_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
         self._sampled_decode_step = warn_on_retrace(
-            make_sampled_decode_step(model, fused=cfg.fused_kernels),
+            make_sampled_decode_step(model, fused=cfg.fused_kernels,
+                                     kv_cache_dtype=self.kv_cache_dtype),
             after=1, label="serving::sampled_decode_step",
             on_retrace="raise" if cfg.strict_no_retrace else "count")
         # every ADDITIONAL compiled step gets its own watchdog: the
@@ -301,9 +361,10 @@ class Engine:
         layout = req.resolved_layout()
         decode_args, prefill_args = xray._serving_abstract_args(
             self.model, batch=cfg.max_batch_size,
-            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            num_blocks=self.num_blocks, block_size=cfg.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
-            chunk_tokens=self.chunk_tokens)
+            chunk_tokens=self.chunk_tokens,
+            kv_cache_dtype=self.kv_cache_dtype)
         decode_specs, prefill_specs = shardplan._serving_arg_specs(
             self.model, layout, decode_args, prefill_args)
         reports = [
@@ -350,9 +411,10 @@ class Engine:
         cfg = self.config
         decode_args, prefill_args = xray._serving_abstract_args(
             self.model, batch=cfg.max_batch_size,
-            num_blocks=cfg.num_blocks, block_size=cfg.block_size,
+            num_blocks=self.num_blocks, block_size=cfg.block_size,
             max_blocks_per_seq=self.max_blocks_per_seq,
-            chunk_tokens=self.chunk_tokens)
+            chunk_tokens=self.chunk_tokens,
+            kv_cache_dtype=self.kv_cache_dtype)
         reports = [
             xray.analyze(self._decode_step, decode_args,
                          name="serving::decode_step", chip=cfg.xray_chip,
@@ -384,7 +446,8 @@ class Engine:
         return self.pool.layers[self._n_target_layers:]
 
     def _rebind_target(self, new_pools):
-        new = [(k, v) for k, v in new_pools]
+        # entries are (k, v) or (k, v, k_scale, v_scale) — arity-agnostic
+        new = [tuple(entry) for entry in new_pools]
         if self.spec is None:
             self.pool.layers = new
         else:
@@ -392,7 +455,7 @@ class Engine:
 
     def _rebind_draft(self, new_pools):
         self.pool.layers = self.pool.layers[:self._n_target_layers] \
-            + [(k, v) for k, v in new_pools]
+            + [tuple(entry) for entry in new_pools]
 
     # ----------------------------------------------------------- submit
     def submit(self, prompt, max_new_tokens: int = 32,

@@ -101,6 +101,11 @@ class ServingMetrics:
         # streaming (serving/stream.py): requests with an on_token
         # callback currently in flight
         self.stream_active = 0
+        # quantized serving (kernels/kv_quant): numeric dtype code of
+        # the engine's KV pool (0 fp32 / 1 int8 / 2 fp8) and the f32
+        # scale-sidecar bytes one block carries (0 unquantized)
+        self.kv_cache_dtype_code = 0
+        self.kv_quant_scale_bytes = 0
         # gauge accumulators (sampled once per decode iteration)
         self._occupancy_sum = 0.0
         self._cache_util_sum = 0.0
@@ -350,6 +355,23 @@ class ServingMetrics:
                       "engine health (0 serving / 1 degraded / "
                       "2 failed)").set(code)
 
+    def on_kv_cache_config(self, dtype_code: int, scale_bytes: int):
+        """Engine construction reports its KV-pool storage format:
+        ``dtype_code`` per kernels.kv_quant.KV_DTYPE_CODES (0 fp32 /
+        1 int8 / 2 fp8), ``scale_bytes`` = f32 absmax sidecar bytes per
+        block per (k or v) pool side."""
+        self.kv_cache_dtype_code = int(dtype_code)
+        self.kv_quant_scale_bytes = int(scale_bytes)
+        reg = self._obs()
+        if reg is not None:
+            reg.gauge("serving_kv_cache_dtype",
+                      "KV-pool storage dtype code (0 fp32 / 1 int8 / "
+                      "2 fp8)").set(self.kv_cache_dtype_code)
+            reg.gauge("kv_quant_scale_bytes",
+                      "per-block f32 absmax scale sidecar bytes of one "
+                      "quantized KV pool side (0 unquantized)").set(
+                          self.kv_quant_scale_bytes)
+
     def on_decode_iteration(self, active: int, batch_size: int,
                             cache_utilization: float):
         self.decode_iterations += 1
@@ -421,6 +443,8 @@ class ServingMetrics:
                 "prefix_cached_token_ratio": round(
                     self._cached_tokens_sum
                     / max(self._prompt_tokens_sum, 1), 4),
+                "serving_kv_cache_dtype": self.kv_cache_dtype_code,
+                "kv_quant_scale_bytes": self.kv_quant_scale_bytes,
             },
             "requests": {rid: t.to_dict()
                          for rid, t in self.requests.items()},

@@ -283,7 +283,15 @@ class DegradationLadder:
         """One hysteresis step against current pool pressure, applying
         the newly-reached level's action.  Returns the level."""
         self._ticks += 1
-        pressure = engine.pool.utilization()
+        # BYTE-denominated pressure: used KV bytes over the pool's byte
+        # capacity (scale sidecars included), so the watermark is a
+        # statement about HBM, not block counts.  Two engines sized
+        # from the same kv_pool_bytes budget at different KV dtypes see
+        # comparable pressure per resident byte — the quantized one
+        # fits ~4x the blocks, so the SAME burst crosses the high
+        # watermark later at int8 than at fp32 (dtype-aware ladder,
+        # ISSUE 20).
+        pressure = engine.pool.byte_utilization()
         # STRICTLY above the high watermark: the default high=1.0 can
         # never be exceeded (a fully-referenced pool is the engine's
         # normal preemption-managed regime, and tiny test pools live
@@ -412,7 +420,10 @@ class OverloadController:
             "ewma_chunk_s": self.chunk_ewma.value,
             "ewma_decode_s": self.decode_ewma.value,
             "queue_depth": len(engine.scheduler.waiting),
-            "kv_pressure": engine.pool.utilization(),
+            "kv_pressure": engine.pool.byte_utilization(),
+            "kv_dtype": engine.pool.kv_dtype_tag,
+            "kv_used_bytes": engine.pool.used_bytes(),
+            "kv_capacity_bytes": engine.pool.capacity_bytes(),
         }
 
 

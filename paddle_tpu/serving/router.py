@@ -293,7 +293,7 @@ class Router:
         generated token)."""
         idx = set(rep.engine.pool.prefix_summary()["hashes"])
         n = 0
-        for h in chain_hex:
+        for h in self._replica_chain(rep, chain_hex):
             if h not in idx and h not in rep.pending_hashes:
                 break
             n += 1
@@ -331,13 +331,31 @@ class Router:
             penalty += _DEGRADED_PENALTY_TOKENS
         return cost + penalty * per_tok
 
-    def _chain_hex(self, prompt: np.ndarray) -> List[str]:
-        """The prompt's chained block hashes (hex) — pure content
-        hashing, identical on every replica (equal block_size)."""
-        return [h.hex()
-                for h in self.replicas[0].engine.pool.hash_chain(prompt)]
+    def _chain_hex(self, prompt: np.ndarray) -> Dict[str, List[str]]:
+        """The prompt's chained block hashes (hex), keyed by the pool's
+        KV dtype tag.  Hashing is pure content chaining — identical on
+        every replica with equal block_size AND equal KV dtype — but
+        the chains are seeded per dtype (an int8 pool must never match
+        an fp32-registered block), so a mixed-dtype fleet needs one
+        chain per distinct tag.  Computed once per tag per prompt."""
+        chains: Dict[str, List[str]] = {}
+        for rep in self.replicas:
+            pool = rep.engine.pool
+            tag = getattr(pool, "kv_dtype_tag", "fp32")
+            if tag not in chains:
+                chains[tag] = [h.hex() for h in pool.hash_chain(prompt)]
+        return chains
 
-    def _rank(self, prompt: np.ndarray, chain_hex: List[str]
+    @staticmethod
+    def _replica_chain(rep: _Replica,
+                       chain_hex: Dict[str, List[str]]) -> List[str]:
+        """The chain matching ``rep``'s pool dtype (empty if absent —
+        a replica added after chains were computed scores no affinity
+        rather than walking a foreign-dtype chain)."""
+        tag = getattr(rep.engine.pool, "kv_dtype_tag", "fp32")
+        return chain_hex.get(tag, [])
+
+    def _rank(self, prompt: np.ndarray, chain_hex: Dict[str, List[str]]
               ) -> List[Tuple[_Replica, int, float]]:
         """Healthy replicas ranked best-first: ``(replica, affinity
         tokens, cost)``.  Equal-cost groups are shuffled by the seeded
@@ -454,7 +472,7 @@ class Router:
             # remember the placement's chain hashes as in-flight
             # affinity (bounded, oldest forgotten): follow-ups sharing
             # the prefix stick here even before prefill registers it
-            for h in chain_hex:
+            for h in self._replica_chain(rep, chain_hex):
                 rep.pending_hashes.pop(h, None)
                 rep.pending_hashes[h] = None
             while len(rep.pending_hashes) > _PENDING_HASH_CAP:

@@ -191,7 +191,7 @@ def sample_at(logits, temps, top_ks, top_ps, keys, counters):
 # compiled step
 # ---------------------------------------------------------------------------
 
-def make_sampled_decode_step(model, fused=None):
+def make_sampled_decode_step(model, fused=None, kv_cache_dtype=None):
     """Paged decode with the sampling transform fused into the program:
     step(tok[S, 1] int32, pools [(k, v)] per layer, block_tables
     [S, max_blocks] int32, lengths[S] int32, temps[S] f32, top_ks[S]
@@ -206,10 +206,14 @@ def make_sampled_decode_step(model, fused=None):
     round-trips in the token loop (H106).  Cached on the model keyed by
     a weights fingerprint, like every other step builder."""
     from ..kernels.fusion import resolve_serving_fusion, serving_fusion
+    from ..kernels.kv_quant import resolve_kv_cache_dtype
+    from ..models.generation import (_kv_dtype_suffix, _unwrap_paged,
+                                     _wrap_paged)
 
     fused = resolve_serving_fusion(fused)
-    attr = "_sampled_decode_step_fused" if fused \
-        else "_sampled_decode_step"
+    kv_dtype = resolve_kv_cache_dtype(kv_cache_dtype)
+    attr = ("_sampled_decode_step_fused" if fused
+            else "_sampled_decode_step") + _kv_dtype_suffix(kv_dtype)
     step = getattr(model, attr, None)
     if step is not None and _fingerprint_matches(
             model, getattr(model, attr + "_fp", None)):
@@ -217,20 +221,21 @@ def make_sampled_decode_step(model, fused=None):
     fp = _weights_fingerprint(model)
 
     from ..core.dispatch import no_grad_ctx
-    from ..models.llama import PagedKVCache
+
+    kind = "sampled_decode" + _kv_dtype_suffix(kv_dtype)
 
     @jax.jit
-    @functools.partial(register_decode_step, kind="sampled_decode")
+    @functools.partial(register_decode_step, kind=kind)
     def step(tok, pools, block_tables, lengths, temps, top_ks, top_ps,
              keys, counters):
         with no_grad_ctx(), serving_fusion(fused):
-            wrapped = [PagedKVCache(k, v, block_tables) for k, v in pools]
+            wrapped = _wrap_paged(pools, block_tables, kv_dtype)
             logits, new_caches = model(Tensor(tok), caches=wrapped,
                                        position_offset=lengths)
             last = logits._value[:, -1].astype(jnp.float32)
             toks = sample_tokens(last, temps, top_ks, top_ps,
                                  fold_keys(keys, counters))
-            return toks, [(c.k, c.v) for c in new_caches]
+            return toks, _unwrap_paged(new_caches, kv_dtype)
 
     setattr(model, attr, step)
     setattr(model, attr + "_fp", fp)

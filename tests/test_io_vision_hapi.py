@@ -145,6 +145,7 @@ class TestVisionModels:
         out.sum().backward()
         assert net.conv1.weight.grad is not None
 
+    @pytest.mark.slow  # three full model-zoo builds; covered by ci.sh's unfiltered suite
     def test_vgg_mobilenet_squeezenet(self):
         x = paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype("f"))
         assert vgg11(num_classes=5)(x).shape == [1, 5]

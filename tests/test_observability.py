@@ -400,7 +400,8 @@ class TestServingMirror:
         "batch_occupancy", "batch_occupancy_avg",
         "cache_utilization", "cache_utilization_avg",
         "prefix_cached_token_ratio", "degradation_level",
-        "health_state", "spec_accept_rate", "stream_active"}
+        "health_state", "spec_accept_rate", "stream_active",
+        "serving_kv_cache_dtype", "kv_quant_scale_bytes"}
 
     def _run_workload(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

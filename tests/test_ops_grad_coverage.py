@@ -239,6 +239,7 @@ class TestVisionOpsGrad:
         ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
         np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
 
+    @pytest.mark.slow  # heaviest grad kernel in the sweep; covered by ci.sh's unfiltered suite
     def test_deform_conv2d_grad(self):
         import paddle_tpu.vision.ops as vops
 

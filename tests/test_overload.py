@@ -213,6 +213,11 @@ class _FakeEngine:
         def utilization(self):
             return self.pressure
 
+        def byte_utilization(self):
+            # the ladder is byte-denominated (dtype-aware); the fake
+            # has no dtype split, so both views agree
+            return self.pressure
+
         def evict_parked(self, n=None):
             self.evict_calls += 1
             return 0

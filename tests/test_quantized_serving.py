@@ -1,0 +1,526 @@
+"""Quantized serving (ISSUE 20): int8/fp8 paged KV cache with dequant
+fused into the attention kernels' DMA boundary, weight-only int8 engine
+weights, dtype-aware HBM accounting, and the fleet surfaces on top.
+
+The done bar: an int8-KV engine is greedy-token-exact with the fp32
+engine AND with sequential ``generate()`` at zero retraces and zero
+leaked blocks; the fused kernels and their XLA fallbacks agree on
+quantized pools across num_splits/GQA; per-dtype hash namespacing keeps
+int8 pools from ever matching fp32-registered prefix blocks; at a FIXED
+``kv_pool_bytes`` budget the degradation ladder engages later at int8
+than at fp32 under the same burst; xray prices the quantized pool as
+int8 bytes; costs registrations resolve sub-byte dtypes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.kv_quant import (KV_DTYPE_CODES, decode_codes,
+                                         dequantize_kv,
+                                         kv_bytes_per_element,
+                                         kv_scale_bytes_per_block,
+                                         quantize_kv,
+                                         resolve_kv_cache_dtype)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Engine, ServingConfig
+from paddle_tpu.serving.cache import BlockKVPool
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _config(**over):
+    base = dict(max_batch_size=2, num_blocks=32, block_size=8,
+                fused_kernels=False)
+    base.update(over)
+    return ServingConfig(**base)
+
+
+def _prompts(lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 250, size=(n,)).astype(np.int32)
+            for n in lens]
+
+
+def _tokens(req):
+    return req.output_ids()[req.prompt_len:].tolist()
+
+
+def _gen(eng, prompts, n):
+    """Engine batch generate -> per-prompt generated-token lists."""
+    outs = eng.generate(prompts, max_new_tokens=n)
+    return [out[p.size:].tolist() for out, p in zip(outs, prompts)]
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+class TestKVQuantCodec:
+    def test_resolve_aliases(self):
+        for alias in (None, "", "fp32", "float32", "auto"):
+            assert resolve_kv_cache_dtype(alias) is None
+        assert resolve_kv_cache_dtype("i8") == "int8"
+        assert resolve_kv_cache_dtype("fp8_e4m3") == "fp8"
+        assert resolve_kv_cache_dtype("float8_e4m3fn") == "fp8"
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            resolve_kv_cache_dtype("int3")
+
+    @pytest.mark.parametrize("scheme", ["int8", "fp8"])
+    def test_roundtrip_error_bound(self, scheme):
+        rng = np.random.RandomState(0)
+        kv = rng.randn(6, 4, 2, 8).astype(np.float32) * 3.0
+        codes, scale = quantize_kv(kv, scheme)
+        assert np.asarray(codes).dtype == np.int8
+        assert scale.shape == (6, 4)
+        deq = np.asarray(dequantize_kv(codes, scale, scheme))
+        err = np.abs(deq - kv)
+        s = np.asarray(scale)[..., None, None]
+        if scheme == "int8":
+            # absmax row quantization: half-step error in scale units
+            assert np.all(err <= s * 0.51 + 1e-7)
+        else:
+            # e4m3: RELATIVE error (half ulp = 2^-4 of the value) plus
+            # a subnormal absolute floor in scale units
+            assert np.all(err <= np.abs(kv) * 0.0625 + s * 0.01 + 1e-7)
+
+    def test_zero_rows_are_exact(self):
+        kv = np.zeros((2, 4, 2, 8), np.float32)
+        codes, scale = quantize_kv(kv, "int8")
+        assert np.all(np.asarray(scale) == 1.0)   # never 0 (div guard)
+        assert np.all(np.asarray(decode_codes(codes, "int8")) == 0.0)
+
+    def test_bytes_accounting(self):
+        assert kv_bytes_per_element("int8") == 1
+        assert kv_bytes_per_element("fp8") == 1
+        assert kv_scale_bytes_per_block(8, "int8") == 32
+        assert kv_scale_bytes_per_block(8, None) == 0
+        assert KV_DTYPE_CODES == {None: 0, "int8": 1, "fp8": 2}
+
+
+# ---------------------------------------------------------------------------
+# pool: per-dtype block bytes + hash namespacing (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestQuantizedPool:
+    def _pool(self, kv_dtype, num_blocks=16):
+        return BlockKVPool(2, num_blocks, 8, 2, 16, "float32",
+                           kv_cache_dtype=kv_dtype)
+
+    def test_block_bytes_for(self):
+        fp32 = BlockKVPool.block_bytes_for(2, 8, 2, 16, "float32", None)
+        i8 = BlockKVPool.block_bytes_for(2, 8, 2, 16, "float32", "int8")
+        assert fp32 == 2 * 2 * (8 * 2 * 16 * 4)
+        assert i8 == 2 * 2 * (8 * 2 * 16 * 1 + 8 * 4)
+        assert fp32 / i8 > 3.5          # the occupancy headline's root
+        for p, expect in ((self._pool(None), fp32),
+                          (self._pool("int8"), i8)):
+            assert p.block_bytes() == expect
+            assert p.capacity_bytes() == expect * 15
+
+    def test_quantized_entries_carry_scales(self):
+        p = self._pool("int8")
+        for entry in p.layers:
+            k, v, ks, vs = entry
+            assert np.asarray(k).dtype == np.int8
+            assert ks.shape == (16, 8)
+            assert np.asarray(ks).dtype == np.float32
+        assert len(self._pool(None).layers[0]) == 2
+
+    def test_hash_chains_disjoint_across_dtypes(self):
+        """An int8 pool must NEVER match fp32-registered blocks: the
+        chain seed is the dtype tag, so the same prompt hashes to
+        disjoint chains per dtype."""
+        prompt = np.arange(1, 33, dtype=np.int32)
+        chains = {d: [h.hex() for h in self._pool(d).hash_chain(prompt)]
+                  for d in (None, "int8", "fp8")}
+        assert len(chains[None]) == 4
+        for a in (None, "int8", "fp8"):
+            for b in (None, "int8", "fp8"):
+                if a != b:
+                    assert not set(chains[a]) & set(chains[b])
+        # and equal-dtype pools agree (content hashing, router contract)
+        again = [h.hex() for h in self._pool("int8").hash_chain(prompt)]
+        assert again == chains["int8"]
+
+    def test_prefix_summary_reports_dtype(self):
+        assert self._pool("int8").prefix_summary()["kv_dtype"] == "int8"
+        assert self._pool(None).prefix_summary()["kv_dtype"] \
+            == "fp32:float32"
+
+    def test_stats_byte_view(self):
+        p = self._pool("int8")
+        st = p.stats()
+        assert st["kv_dtype"] == "int8"
+        assert st["used_bytes"] == 0
+        assert st["capacity_bytes"] == p.block_bytes() * 15
+        p.allocate("s", 3)
+        assert p.used_bytes() == 3 * p.block_bytes()
+        assert 0 < p.byte_utilization() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# engine parity: int8/fp8 vs fp32 vs generate(), fused and fallback
+# (tentpole + satellite 3)
+# ---------------------------------------------------------------------------
+
+_PARITY_MODEL = None
+_PARITY_REF = {}
+
+
+def _parity_model():
+    """One shared model for the parity tests: the dtype-suffixed step
+    cache makes every (fused, kv_dtype) variant compile exactly once
+    across the whole class instead of once per parametrization."""
+    global _PARITY_MODEL
+    if _PARITY_MODEL is None:
+        _PARITY_MODEL = _tiny_model()
+    return _PARITY_MODEL
+
+
+def _parity_ref(fused):
+    """fp32 engine tokens for the parity prompts, cross-checked against
+    the generate() oracle — computed once per fused flavour and shared
+    by the int8 and fp8 parametrizations."""
+    if fused not in _PARITY_REF:
+        model = _parity_model()
+        prompts = _prompts([5, 11], seed=1)
+        ref_out = _gen(Engine(model, _config(fused_kernels=fused)),
+                       prompts, 8)
+        # generate() oracle: sequential greedy decode, full precision
+        gen = [np.asarray(model.generate(
+            paddle.to_tensor(p[None, :]), max_new_tokens=8,
+            temperature=0.0).numpy())[0, p.size:].tolist()
+            for p in prompts]
+        assert ref_out == gen
+        _PARITY_REF[fused] = ref_out
+    return _PARITY_REF[fused]
+
+
+class TestQuantizedEngineParity:
+    @pytest.mark.parametrize("kv_dtype", ["int8", "fp8"])
+    @pytest.mark.parametrize("fused", [False, True])
+    def test_token_parity_and_no_leaks(self, kv_dtype, fused):
+        prompts = _prompts([5, 11], seed=1)
+        eng = Engine(_parity_model(), _config(fused_kernels=fused,
+                                              kv_cache_dtype=kv_dtype))
+        out = _gen(eng, prompts, 8)
+        assert out == _parity_ref(fused)
+        assert eng._decode_step.retraces == 0
+        assert eng._prefill_step.retraces == 0
+        eng.pool.check_leaks()
+        assert eng.pool.stats()["used_blocks"] == 0
+
+    def test_preempt_evict_requeue_round_trip_no_leaks(self):
+        """Quantized CoW/preemption: a pool too small for the burst
+        forces preemption + recompute; every request still completes,
+        token-exact, and the quantized pool leaks nothing."""
+        model = _tiny_model()
+        ref = Engine(model, _config(num_blocks=64, max_batch_size=4))
+        prompts = _prompts([9, 17, 13, 8], seed=7)
+        want = _gen(ref, prompts, 10)
+        eng = Engine(model, _config(num_blocks=8, max_batch_size=4,
+                                    kv_cache_dtype="int8"))
+        got = _gen(eng, prompts, 10)
+        assert got == want
+        assert eng.stats()["counters"]["preemptions"] > 0
+        eng.pool.check_leaks()
+        assert eng._decode_step.retraces == 0
+
+    def test_shared_model_dual_dtype_zero_retraces(self):
+        """fp32 + int8 engines on ONE model: the dtype-suffixed step
+        cache keeps the compiled programs separate (different pytree
+        treedefs must not thrash one cache slot)."""
+        model = _parity_model()
+        e_fp = Engine(model, _config())
+        e_q = Engine(model, _config(kv_cache_dtype="int8"))
+        p = _prompts([9], seed=2)
+        assert _gen(e_fp, p, 6) == _gen(e_q, p, 6)
+        assert e_fp._decode_step.retraces == 0
+        assert e_q._decode_step.retraces == 0
+
+    def test_perplexity_delta_oracle(self):
+        """Quantization drift bound in LOGPROB space, not just argmax:
+        the int8 prefill logits' greedy-token logprob stays within a
+        small delta of fp32's across prompts."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import \
+            make_chunked_prefill_step
+        from paddle_tpu.serving.cache import BlockKVPool as Pool
+
+        model = _tiny_model()
+        cfg = model.config
+        kvh = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        step_fp = make_chunked_prefill_step(model, fused=False)
+        step_q = make_chunked_prefill_step(model, fused=False,
+                                           kv_cache_dtype="int8")
+        deltas = []
+        for seed, L in ((0, 6), (1, 12), (2, 15)):
+            ids = np.zeros((1, 16), np.int32)
+            ids[0, :L] = _prompts([L], seed=seed)[0]
+            bt = np.array([[1, 2]], np.int32)
+            start = np.array([0], np.int32)
+            last = np.int32(L - 1)
+            outs = {}
+            for name, step, kv_dtype in (("fp", step_fp, None),
+                                         ("q", step_q, "int8")):
+                pool = Pool(cfg.num_hidden_layers, 4, 8, kvh, hd,
+                            "float32", kv_cache_dtype=kv_dtype)
+                logits, _ = step(jnp.asarray(ids), pool.layers,
+                                 jnp.asarray(bt), jnp.asarray(start),
+                                 last)
+                outs[name] = np.asarray(logits, np.float64)[0]
+            lp_fp = outs["fp"] - np.log(np.exp(
+                outs["fp"] - outs["fp"].max()).sum()) - outs["fp"].max()
+            lp_q = outs["q"] - np.log(np.exp(
+                outs["q"] - outs["q"].max()).sum()) - outs["q"].max()
+            tok = int(outs["fp"].argmax())
+            deltas.append(abs(lp_fp[tok] - lp_q[tok]))
+        assert max(deltas) < 0.15, deltas
+
+    def test_speculative_plus_quantized_rejected(self):
+        from paddle_tpu.serving.speculative import SpeculativeConfig
+
+        target, draft = _tiny_model(), _tiny_model(seed=1)
+        with pytest.raises(ValueError, match="speculative"):
+            Engine(target, _config(
+                kv_cache_dtype="int8",
+                speculative=SpeculativeConfig(draft_model=draft,
+                                              num_draft_tokens=2)))
+
+
+# ---------------------------------------------------------------------------
+# fixed-HBM sizing + dtype-aware ladder (tentpole + satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestFixedHbmBudget:
+    def test_kv_pool_bytes_derives_dtype_aware_blocks(self):
+        model = _tiny_model()
+        budget = 16 * BlockKVPool.block_bytes_for(
+            2, 8, 2, 16, "float32", None)
+        e_fp = Engine(model, _config(num_blocks=None,
+                                     kv_pool_bytes=budget))
+        e_q = Engine(model, _config(num_blocks=None,
+                                    kv_pool_bytes=budget,
+                                    kv_cache_dtype="int8"))
+        assert e_fp.num_blocks == 16
+        assert e_q.num_blocks >= int(16 * 1.5)   # >=1.5x resident
+        # both pools fit the SAME byte budget
+        assert e_fp.pool.capacity_bytes() <= budget
+        assert e_q.pool.capacity_bytes() <= budget
+
+    def test_budget_too_small_raises(self):
+        with pytest.raises(ValueError, match="kv_pool_bytes"):
+            Engine(_tiny_model(), _config(num_blocks=None,
+                                          kv_pool_bytes=1024))
+
+    def test_ladder_engages_later_at_int8(self):
+        """Satellite 2 regression: same burst, same kv_pool_bytes —
+        byte-denominated watermarks make the fp32 fleet climb the
+        ladder strictly higher than the int8 fleet (which fits ~3.5x
+        the blocks in the budget)."""
+        from paddle_tpu.resilience.chaos import burst_prompts
+
+        budget = 14 * BlockKVPool.block_bytes_for(
+            2, 8, 2, 16, "float32", None)
+        burst = burst_prompts(seed=5, n=8, min_len=8, max_len=16)
+        peaks = {}
+        for kv_dtype in (None, "int8"):
+            eng = Engine(_tiny_model(), _config(
+                num_blocks=None, kv_pool_bytes=budget,
+                kv_cache_dtype=kv_dtype, max_batch_size=4,
+                max_queue_len=32, kv_high_watermark=0.5,
+                kv_low_watermark=0.3))
+            reqs = [eng.submit(p, max_new_tokens=4) for p in burst]
+            eng.run_until_complete()
+            assert all(r.finish_reason == "length" for r in reqs)
+            levels = [lvl for _, lvl in eng.overload.ladder.transitions]
+            peaks[kv_dtype] = max(levels) if levels else 0
+            eng.pool.check_leaks()
+        assert peaks[None] > 0, "fp32 burst never engaged the ladder"
+        assert peaks["int8"] < peaks[None], peaks
+
+    def test_overload_snapshot_reports_dtype_bytes(self):
+        eng = Engine(_tiny_model(), _config(kv_cache_dtype="int8"))
+        snap = eng.overload.snapshot(eng)
+        assert snap["kv_dtype"] == "int8"
+        assert snap["kv_capacity_bytes"] == eng.pool.capacity_bytes()
+        assert snap["kv_used_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# metrics gauges + xray per-dtype HBM (satellite 6 + acceptance)
+# ---------------------------------------------------------------------------
+
+class TestQuantObservability:
+    def test_kv_dtype_gauges(self):
+        for kv_dtype, code in ((None, 0), ("int8", 1), ("fp8", 2)):
+            eng = Engine(_tiny_model(), _config(kv_cache_dtype=kv_dtype))
+            g = eng.stats()["gauges"]
+            assert g["serving_kv_cache_dtype"] == code
+            assert g["kv_quant_scale_bytes"] == \
+                (32 if kv_dtype else 0)     # block_size(8) * 4B
+
+    def test_xray_prices_quantized_pool(self):
+        """The decode step's peak-HBM must be int8-denominated: the
+        quantized engine's xray report carries int8 bytes and a LOWER
+        peak than fp32 at equal block counts."""
+        def peak(kv_dtype):
+            eng = Engine(_tiny_model(),
+                         _config(kv_cache_dtype=kv_dtype,
+                                 xray_on_start=True))
+            rep = {r.name: r for r in eng.xray_reports}
+            dec = rep["serving::decode_step"]
+            return dec.peak_hbm_bytes, dict(dec.peak_hbm_by_dtype)
+
+        fp_peak, fp_by = peak(None)
+        q_peak, q_by = peak("int8")
+        assert q_by.get("int8", 0) > 0
+        assert fp_by.get("int8", 0) == 0
+        assert q_peak < fp_peak
+
+
+# ---------------------------------------------------------------------------
+# router: mixed-dtype fleet affinity (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestMixedDtypeFleet:
+    def test_mixed_fleet_routes_and_matches_parity(self):
+        from paddle_tpu.serving.router import Router
+
+        model = _parity_model()
+        e_fp = Engine(model, _config(name="fp32"))
+        e_q = Engine(model, _config(name="int8", kv_cache_dtype="int8"))
+        router = Router([e_fp, e_q], seed=0)
+        prompts = _prompts([9, 9, 12], seed=3)
+        reqs = [router.submit(p, max_new_tokens=5) for p in prompts]
+        router.run_until_complete()
+        ref = Engine(model, _config())
+        want = _gen(ref, prompts, 5)
+        assert [_tokens(r) for r in reqs] == want
+        for e in (e_fp, e_q):
+            e.pool.check_leaks()
+
+    def test_affinity_walk_uses_per_dtype_chain(self):
+        """The router's chain walk must hash with EACH replica's dtype
+        seed: after a prefix registers on the int8 replica, a follow-up
+        sharing the prefix scores affinity there — impossible if the
+        router walked the fp32 chain against the int8 summary."""
+        from paddle_tpu.serving.router import Router
+
+        model = _parity_model()
+        e_q = Engine(model, _config(name="int8",
+                                    kv_cache_dtype="int8"))
+        router = Router([e_q], seed=0)
+        prompt = _prompts([17], seed=4)[0]
+        router.submit(prompt, max_new_tokens=2)
+        router.run_until_complete()
+        chains = router._chain_hex(prompt)
+        assert set(chains) == {"int8"}
+        rep = router.replicas[0]
+        aff = router._affinity_tokens(rep, prompt, chains)
+        assert aff > 0      # registered prefix found via int8 chain
+        # a foreign-dtype chain dict scores zero instead of crossing
+        assert router._affinity_tokens(
+            rep, prompt, {"fp32:float32": chains["int8"]}) == 0
+
+
+# ---------------------------------------------------------------------------
+# weight-only quantization (tentpole)
+# ---------------------------------------------------------------------------
+
+class TestWeightOnlyQuant:
+    def test_quantize_report_and_idempotence(self):
+        from paddle_tpu.quantization.serving import \
+            quantize_model_weights
+
+        model = _tiny_model()
+        rep = quantize_model_weights(model, "int8")
+        assert rep["layers"] > 0
+        assert rep["quant_bytes"] < rep["fp32_bytes"] / 3
+        assert quantize_model_weights(model, "int8") == rep   # no-op
+        with pytest.raises(ValueError, match="already quantized"):
+            quantize_model_weights(model, None)
+        q = model.model.layers[0].self_attn.q_proj
+        assert np.asarray(q.weight_int8._value).dtype == np.int8
+        # the rebound weight IS the dequantized codes (prologue math)
+        deq = (np.asarray(q.weight_int8._value, np.float32)
+               * np.asarray(q.weight_scale._value) / 127.0)
+        np.testing.assert_allclose(np.asarray(q.weight._value), deq,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_unknown_weight_dtype_rejected(self):
+        from paddle_tpu.quantization.serving import resolve_weight_dtype
+
+        assert resolve_weight_dtype("i8") == "int8"
+        assert resolve_weight_dtype(None) is None
+        with pytest.raises(ValueError, match="weight_dtype"):
+            resolve_weight_dtype("int4")
+
+    def test_weight_quantized_engine_near_parity(self):
+        """w8 drift on the tiny model leaves greedy argmax unchanged
+        (absmax per-channel on well-conditioned init weights) — and the
+        quantized fleet still zero-retraces and leaks nothing."""
+        prompts = _prompts([7, 10], seed=5)
+        ref = Engine(_parity_model(), _config())
+        want = _gen(ref, prompts, 6)
+        eng = Engine(_tiny_model(), _config(weight_dtype="int8",
+                                            kv_cache_dtype="int8"))
+        got = _gen(eng, prompts, 6)
+        assert got == want
+        assert eng._decode_step.retraces == 0
+        eng.pool.check_leaks()
+
+    def test_quantize_invalidates_cached_steps(self):
+        """An engine compiled BEFORE weight quant must not serve stale
+        fp32 constants: the in-place quantizer drops every cached
+        ``_*_step`` attr (the identity fingerprint can't see the
+        rebind)."""
+        from paddle_tpu.models.generation import make_paged_decode_step
+        from paddle_tpu.quantization.serving import \
+            quantize_model_weights
+
+        model = _tiny_model()
+        make_paged_decode_step(model, fused=False)
+        assert hasattr(model, "_paged_decode_step")
+        quantize_model_weights(model, "int8")
+        assert not hasattr(model, "_paged_decode_step")
+
+
+# ---------------------------------------------------------------------------
+# costs: sub-byte/int8 dtype resolution (satellite 6 small fix)
+# ---------------------------------------------------------------------------
+
+class TestCostDtypeResolution:
+    def test_resolver_handles_ml_dtypes_and_sub_byte(self):
+        from paddle_tpu.kernels.costs import (dtype_element_bytes,
+                                              resolve_cost_dtype)
+
+        assert dtype_element_bytes("float32") == 4.0
+        assert dtype_element_bytes("int8") == 1.0
+        assert dtype_element_bytes("bfloat16") == 2.0
+        assert dtype_element_bytes("float8_e4m3fn") == 1.0
+        assert dtype_element_bytes("int4") == 0.5
+        with pytest.raises(TypeError):
+            resolve_cost_dtype("not_a_dtype")
+
+    def test_registration_accepts_quantized_dtypes(self):
+        from paddle_tpu.kernels.costs import (KernelCost,
+                                              register_kernel_cost,
+                                              registered_kernels)
+
+        register_kernel_cost(
+            "_test_q_kernel_i8",
+            lambda i, o: KernelCost(flops=1.0, bytes_accessed=1.0,
+                                    dtype="float8_e4m3fn"),
+            sample_in=[((4, 4), "int8")],
+            sample_out=[((4, 4), "float32")])
+        assert "_test_q_kernel_i8" in registered_kernels()
+        with pytest.raises(ValueError, match="dtype"):
+            KernelCost(flops=1.0, bytes_accessed=1.0, dtype="intX")

@@ -124,6 +124,16 @@ python tools/lint_tpu.py --xray --fused
 python tools/lint_tpu.py --shardplan --steps fused_decode,fused_prefill \
   --fail-on-unplanned
 
+echo "== quantized serving (int8 KV + weight-only int8) =="
+# the int8 paged-KV engine (per-block-row absmax scales, dequant at the
+# attention kernels' DMA boundary) and the weight-only-int8 engine must
+# be greedy-token-exact with fp32 at zero retraces and zero pool leaks,
+# and a fixed kv_pool_bytes budget must fit >= 1.5x the resident blocks
+# at int8; the --xray --fused gate above already audits the QUANTIZED
+# fused decode/prefill steps and the int8 fused kernel pricing
+# (README: Quantized serving)
+python examples/serve_llama.py --quantized
+
 echo "== fusion miner (ranked F-series candidates + fused coverage) =="
 # the fusion-candidate miner over the registered serving steps: the
 # unfused traces must rank the hand-fused chains as candidates, and the

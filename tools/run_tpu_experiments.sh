@@ -133,6 +133,9 @@ run_queue() {
   run only_elastic_ckpt BENCH_ONLY=elastic_ckpt || return 1
   run only_paged_attn BENCH_ONLY=paged_attn FLAGS_use_autotune=1 || return 1
   snapshot_autotune_cache paged_attn_autotune_cache
+  # quantized serving: the overload bench's fixed-HBM int8-vs-fp32
+  # occupancy/goodput ratios plus the paged_attn int8 TPOT line above
+  run only_quant     BENCH_ONLY=overload || return 1
   BENCH_TIMEOUT=2400 run baseline BENCH_EXTRAS_BUDGET=1500 || return 1
 }
 
@@ -141,7 +144,7 @@ all_done() {
   for n in batch16 autotune flash_q512k512 flash_q128k512 flash_q256k1024 \
            llama1b_s4096 only_resnet only_bert only_unet only_serve \
            only_prefix only_router_replay only_spec_decode \
-           only_elastic_ckpt only_paged_attn baseline; do
+           only_elastic_ckpt only_paged_attn only_quant baseline; do
     is_done "${n}" || return 1
   done
   return 0
